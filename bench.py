@@ -1,0 +1,97 @@
+"""Benchmark harness: ResNet-50 training throughput on the available chip(s).
+
+Measures steps/sec/chip on the reference's profiled workload
+(``multigpu_profile.py:16-27,104-106``: ResNet-50, synthetic 224x224 images,
+batch 32 per replica) using the framework's own jitted train step, bfloat16
+compute. Prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is the
+ratio against the round-1 recorded value in BENCH_BASELINE.json when present,
+else 1.0.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributed_pytorch_tpu.models import ResNet50
+    from distributed_pytorch_tpu.parallel.mesh import make_mesh
+    from distributed_pytorch_tpu.parallel.sharding import (
+        put_global_batch,
+        replicated_sharding,
+    )
+    from distributed_pytorch_tpu.training.losses import softmax_cross_entropy_loss
+    from distributed_pytorch_tpu.training.train_step import (
+        create_train_state,
+        make_train_step,
+    )
+
+    n_chips = jax.device_count()
+    per_chip_batch = 32
+    batch = per_chip_batch * n_chips
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    optimizer = optax.sgd(1e-3, momentum=0.9)
+
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((batch, 224, 224, 3)).astype(np.float32)
+    ys = rng.integers(0, 1000, size=(batch,)).astype(np.int32)
+
+    mesh = make_mesh() if n_chips > 1 else None
+    state = create_train_state(model, optimizer, xs[:2])
+    if mesh is not None:
+        state = jax.device_put(state, replicated_sharding(mesh))
+        device_batch = put_global_batch(mesh, (xs, ys))
+    else:
+        device_batch = jax.device_put((jnp.asarray(xs), jnp.asarray(ys)))
+    step = make_train_step(model.apply, optimizer, softmax_cross_entropy_loss, mesh=mesh)
+
+    # Warmup: compile + 3 steps.
+    state, loss = step(state, device_batch)
+    jax.block_until_ready(loss)
+    for _ in range(3):
+        state, loss = step(state, device_batch)
+    jax.block_until_ready(loss)
+
+    n_steps = 20
+    start = time.perf_counter()
+    for _ in range(n_steps):
+        state, loss = step(state, device_batch)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - start
+
+    steps_per_sec_per_chip = n_steps / elapsed  # global step rate; batch scales with chips
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
+    vs_baseline = 1.0
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            recorded = json.load(f).get("value")
+        if recorded:
+            vs_baseline = steps_per_sec_per_chip / recorded
+
+    print(
+        json.dumps(
+            {
+                "metric": f"resnet50_bf16_train_steps_per_sec (batch {per_chip_batch}/chip, {n_chips} chip)",
+                "value": round(steps_per_sec_per_chip, 4),
+                "unit": "steps/s",
+                "vs_baseline": round(vs_baseline, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

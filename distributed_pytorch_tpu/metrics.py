@@ -1,0 +1,46 @@
+"""Structured training metrics.
+
+The reference computes loss but never logs it (SURVEY.md §5: ``print()``-only
+observability, an unused ``SummaryWriter`` import at
+``multigpu_profile.py:10``). We close that gap: per-epoch structured lines from
+process 0, with optional TensorBoard scalars when a writer backend is
+available.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+from distributed_pytorch_tpu.parallel.bootstrap import is_main_process
+
+
+class MetricLogger:
+    """Process-0 metric emitter: one JSON line per report + optional TensorBoard."""
+
+    def __init__(self, tensorboard_dir: Optional[str] = None):
+        self._writer = None
+        if tensorboard_dir and is_main_process():
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._writer = SummaryWriter(tensorboard_dir)
+            except Exception:  # torch TB backend optional
+                self._writer = None
+        self._start = time.perf_counter()
+
+    def log(self, step: int, **scalars: float) -> None:
+        if not is_main_process():
+            return
+        record = {"step": int(step), "elapsed_s": round(time.perf_counter() - self._start, 3)}
+        record.update({k: float(v) for k, v in scalars.items()})
+        print(json.dumps(record), flush=True)
+        if self._writer is not None:
+            for key, value in scalars.items():
+                self._writer.add_scalar(key, value, step)
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.flush()
+            self._writer.close()

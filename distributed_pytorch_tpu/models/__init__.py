@@ -1,0 +1,19 @@
+from distributed_pytorch_tpu.models.mlp import MLP
+from distributed_pytorch_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+)
+from distributed_pytorch_tpu.models.toy import ToyRegressor
+
+__all__ = [
+    "MLP",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ToyRegressor",
+]

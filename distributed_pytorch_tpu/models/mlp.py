@@ -1,0 +1,24 @@
+"""Configurable MLP — the "slightly real" model tier between the toy Linear
+regressor and ResNet-50. No reference analog (the reference jumps straight from
+``Linear(20,1)`` to torchvision ResNet-50 at ``multigpu_profile.py:13-27``);
+this fills the gap for scaling/benchmark sweeps.
+"""
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """Dense -> relu stack with a linear head."""
+
+    hidden: Sequence[int] = (256, 256)
+    features: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for i, width in enumerate(self.hidden):
+            x = nn.Dense(width, name=f"hidden_{i}")(x)
+            x = nn.relu(x)
+        return nn.Dense(self.features, name="head")(x)

@@ -1,0 +1,125 @@
+"""ResNet family — the "real model" tier.
+
+Capability twin of the torchvision ``resnet50()`` the reference swaps in for
+profiling (``multigpu_profile.py:13-27``), re-designed TPU-first rather than
+ported:
+
+* **NHWC** layout (TPU convolutions are natively channels-last; the reference's
+  NCHW is a CUDA convention);
+* optional **bfloat16** compute dtype (MXU-native) with float32 parameters and
+  batch statistics;
+* BatchNorm reductions become *global-batch* statistics when the batch is
+  sharded over the ``data`` mesh axis (XLA inserts the cross-replica mean —
+  SyncBN semantics for free).
+
+``ResNet18``/``ResNet50`` builders mirror the torchvision surface; stage
+layouts are the standard He et al. configurations.
+"""
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut (expansion 4)."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), use_bias=False, name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = self.conv(
+            self.filters, (3, 3), self.strides, use_bias=False, name="conv2"
+        )(y)
+        y = self.norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1), use_bias=False, name="conv3")(y)
+        y = self.norm(scale_init=nn.initializers.zeros, name="bn3")(y)
+
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), self.strides, use_bias=False, name="conv_proj"
+            )(residual)
+            residual = self.norm(name="bn_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    """3x3 -> 3x3 residual block (expansion 1) for ResNet-18/34."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides, use_bias=False, name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), use_bias=False, name="conv2")(y)
+        y = self.norm(scale_init=nn.initializers.zeros, name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), self.strides, use_bias=False, name="conv_proj"
+            )(residual)
+            residual = self.norm(name="bn_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Generic ResNet over NHWC inputs ``(batch, height, width, 3)``."""
+
+    stage_sizes: Sequence[int]
+    block: Callable = BottleneckBlock
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                 use_bias=False, name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, num_blocks in enumerate(self.stage_sizes):
+            for block_idx in range(num_blocks):
+                strides = (2, 2) if stage > 0 and block_idx == 0 else (1, 1)
+                x = self.block(
+                    self.num_filters * 2**stage,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                    name=f"stage{stage + 1}_block{block_idx + 1}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block=BasicBlock)
+ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block=BottleneckBlock)
+ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3), block=BottleneckBlock)

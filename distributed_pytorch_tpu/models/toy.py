@@ -1,0 +1,16 @@
+"""Toy regression model — twin of the reference's ``torch.nn.Linear(20, 1)``
+(``single_gpu.py:50``, identical in every ladder script's ``load_train_objs``).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ToyRegressor(nn.Module):
+    """A single dense layer: ``(batch, in_features) -> (batch, features)``."""
+
+    features: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        return nn.Dense(self.features, name="linear")(x)

@@ -1,0 +1,1 @@
+# Pallas/custom-op kernels live here (see distributed_pytorch_tpu/ops/).

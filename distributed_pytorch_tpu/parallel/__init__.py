@@ -1,0 +1,21 @@
+from distributed_pytorch_tpu.parallel.bootstrap import (
+    is_main_process,
+    setup_distributed,
+    shutdown_distributed,
+)
+from distributed_pytorch_tpu.parallel.mesh import make_mesh
+from distributed_pytorch_tpu.parallel.sharding import (
+    batch_sharding,
+    put_global_batch,
+    replicated_sharding,
+)
+
+__all__ = [
+    "batch_sharding",
+    "is_main_process",
+    "make_mesh",
+    "put_global_batch",
+    "replicated_sharding",
+    "setup_distributed",
+    "shutdown_distributed",
+]

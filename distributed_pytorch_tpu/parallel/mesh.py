@@ -1,0 +1,78 @@
+"""Device-mesh construction.
+
+The mesh is the TPU-native replacement for the reference's implicit
+"world of ranks" (``world_size`` at ``multigpu.py:95``, torchrun's
+``WORLD_SIZE``): instead of N independent processes coordinating through NCCL,
+we lay all addressable chips out on a named logical mesh and let XLA place
+collectives onto ICI/DCN from the mesh topology.
+
+Axis convention (reserved up front so later parallelism is additive — see
+SURVEY.md §2b):
+
+* ``data``     — data parallelism (the only axis the reference exercises, via DDP)
+* ``fsdp``     — sharded-parameter data parallelism (ZeRO analog)
+* ``tensor``   — tensor/model parallelism
+* ``sequence`` — sequence/context parallelism (ring attention)
+* ``expert``   — expert parallelism (MoE)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+MESH_AXES = ("data", "fsdp", "tensor", "sequence", "expert")
+
+
+def make_mesh(
+    axes: Optional[Mapping[str, int]] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named device mesh.
+
+    ``axes`` maps axis name -> size; at most one size may be ``-1`` (absorbs all
+    remaining devices). Default is a 1-D ``data`` mesh over every device — the
+    moral equivalent of ``init_process_group`` + DDP over
+    ``torch.cuda.device_count()`` chips (reference ``multigpu.py:95-96``).
+
+    Examples::
+
+        make_mesh()                              # {"data": all}
+        make_mesh({"data": -1, "tensor": 4})     # 2-D DP x TP
+        make_mesh({"data": 2, "sequence": 4})    # DP x ring-attention SP
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if axes is None:
+        axes = {"data": n}
+
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one mesh axis may have size -1")
+    if -1 in sizes:
+        known = math.prod(s for s in sizes if s != -1)
+        if n % known != 0:
+            raise ValueError(f"{n} devices not divisible by fixed axes product {known}")
+        sizes[sizes.index(-1)] = n // known
+    if math.prod(sizes) != n:
+        raise ValueError(f"mesh shape {dict(zip(names, sizes))} != {n} devices")
+
+    if len(sizes) == 1:
+        # Keep explicit device order for 1-D meshes (predictable shard placement).
+        device_array = np.asarray(devices)
+    else:
+        device_array = mesh_utils.create_device_mesh(sizes, devices=devices)
+    return Mesh(device_array, tuple(names))
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    """Number of data-parallel replicas in the mesh (1 if no ``data`` axis)."""
+    return mesh.shape.get("data", 1)

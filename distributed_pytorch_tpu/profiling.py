@@ -1,0 +1,74 @@
+"""Step-scheduled profiling with TensorBoard trace export.
+
+Twin of ``_create_profiler`` (reference ``multigpu_profile.py:80-91``):
+``torch.profiler.profile(schedule(wait=1, warmup=1, active=5),
+on_trace_ready=tensorboard_trace_handler(...))`` driven by
+``start()/step()/stop()`` hooks in the batch loop (``:61-62,70-71,73-74``).
+
+TPU-native: ``jax.profiler.start_trace/stop_trace`` captures libtpu/XLA device
+traces viewable in TensorBoard (XProf) or Perfetto. The wait/warmup/active step
+schedule is replicated host-side: tracing turns on after ``wait + warmup``
+steps and off ``active`` steps later. Per-host subdirectories replace the
+reference's per-device ``worker_name``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+class StepProfiler:
+    """Profile a window of training steps.
+
+    Usage (mirrors the reference's hook placement in ``run_epoch``)::
+
+        profiler = StepProfiler("log/resnet50", wait=1, warmup=1, active=5)
+        profiler.start()
+        for batch in loader:
+            ...train step...
+            profiler.step()
+        profiler.stop()
+    """
+
+    def __init__(self, logdir: str, *, wait: int = 1, warmup: int = 1, active: int = 5):
+        self.logdir = os.path.join(logdir, f"host_{jax.process_index()}")
+        self.wait = wait
+        self.warmup = warmup
+        self.active = active
+        self._step = 0
+        self._tracing = False
+
+    @property
+    def trace_started_at(self) -> int:
+        return self.wait + self.warmup
+
+    def start(self) -> None:
+        self._step = 0
+        self._maybe_transition()
+
+    def step(self) -> None:
+        """Call once per optimizer step (twin of ``profiler.step()``,
+        reference ``multigpu_profile.py:71``)."""
+        self._step += 1
+        self._maybe_transition()
+
+    def stop(self) -> None:
+        if self._tracing:
+            self._stop_trace()
+
+    def _maybe_transition(self) -> None:
+        begin = self.trace_started_at
+        end = begin + self.active
+        if not self._tracing and begin <= self._step < end:
+            os.makedirs(self.logdir, exist_ok=True)
+            jax.profiler.start_trace(self.logdir)
+            self._tracing = True
+        elif self._tracing and self._step >= end:
+            self._stop_trace()
+
+    def _stop_trace(self) -> None:
+        jax.profiler.stop_trace()
+        self._tracing = False

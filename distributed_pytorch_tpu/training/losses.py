@@ -1,0 +1,26 @@
+"""Loss functions.
+
+The reference uses ``CrossEntropyLoss`` on a single logit in most rungs
+(``single_gpu.py:24`` — a quirk: softmax of one logit is identically 1, so that
+loss is constant 0) and ``MSELoss`` only in the multinode rung
+(``multinode_torchrun.py:46`` — the only loss that matches the
+``Linear(20,1)`` regression head). We standardize on MSE for the toy
+regression task and real softmax cross-entropy for classification models.
+"""
+
+import jax.numpy as jnp
+import optax
+
+
+def mse_loss(predictions: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Mean squared error over the (global) batch."""
+    return jnp.mean(jnp.square(predictions - targets))
+
+
+def softmax_cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Cross entropy; integer targets -> sparse labels, float targets -> soft labels."""
+    if jnp.issubdtype(targets.dtype, jnp.integer):
+        per_example = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    else:
+        per_example = optax.softmax_cross_entropy(logits, targets)
+    return jnp.mean(per_example)

@@ -1,0 +1,134 @@
+"""The fused, jitted SPMD train step — the heart of the framework.
+
+TPU-native restatement of the reference hot loop (``single_gpu.py:21-26``:
+``zero_grad -> forward -> loss -> backward -> step``, plus DDP's implicit
+bucketed NCCL gradient allreduce under ``loss.backward()`` at
+``multigpu.py:42``): the whole thing is ONE pure function compiled by XLA.
+
+* "zero_grad" vanishes — gradients are pure values, not mutable buffers.
+* The allreduce vanishes *as user code* — the batch is sharded ``P("data")``
+  while parameters are replicated ``P()``, so XLA's SPMD partitioner inserts a
+  cross-replica reduce for the gradients inside the compiled executable,
+  overlapping it with the backward pass exactly as DDP's bucketing does — but
+  scheduled by the compiler onto ICI/DCN rather than hand-tuned buckets.
+* Because the loss is a *global-batch mean*, gradient semantics match DDP's
+  mean-of-grads when shards are equal-sized (they always are: the loader pads
+  by wrapping, mirroring DistributedSampler).
+* Non-trainable collections (e.g. BatchNorm ``batch_stats``) ride along in
+  ``TrainState.model_state``; under a sharded batch their reductions become
+  *global-batch* statistics (SyncBN semantics — stronger than DDP's default
+  per-replica stats).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from distributed_pytorch_tpu.parallel.sharding import (
+    batch_sharding,
+    replicated_sharding,
+)
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Replicated training state: parameters, non-trainable model state (e.g.
+    BatchNorm stats), optimizer state, step counter.
+
+    The checkpointable unit — unlike the reference, optimizer state is part of
+    it (the reference never saves optimizer state, a resume-fidelity gap noted
+    in SURVEY.md §5; harmless for SGD, wrong for Adam).
+    """
+
+    params: Any
+    model_state: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+
+def create_train_state(
+    model,
+    optimizer: optax.GradientTransformation,
+    sample_input,
+    *,
+    rng_seed: int = 0,
+) -> TrainState:
+    """Initialize params + optimizer state from a sample input batch."""
+    rng = jax.random.PRNGKey(rng_seed)
+    # Params are batch-size independent: init from a single row, under jit, so
+    # startup cost doesn't scale with the global batch (matters for ResNet-50
+    # at batch 32*n_chips).
+    sample = jnp.asarray(sample_input)[:1]
+    variables = dict(jax.jit(model.init)(rng, sample))
+    params = variables.pop("params")
+    opt_state = optimizer.init(params)
+    return TrainState(
+        params=params,
+        model_state=variables,
+        opt_state=opt_state,
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def make_train_step(
+    apply_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    loss_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    *,
+    mesh: Optional[Mesh] = None,
+    data_axis: str = "data",
+) -> Callable[[TrainState, Tuple], Tuple[TrainState, jnp.ndarray]]:
+    """Build the jitted ``(state, (inputs, targets)) -> (state', loss)`` step.
+
+    With ``mesh``, inputs/targets are expected sharded along ``data_axis`` and
+    state replicated; XLA inserts the gradient all-reduce. Without a mesh it is
+    the serial rung (``single_gpu.py`` twin) — same code, no collectives.
+
+    ``donate_argnums=(0,)`` lets XLA reuse the old state's buffers for the new
+    state (in-place update semantics, halving peak parameter memory).
+    """
+
+    def step(state: TrainState, batch) -> Tuple[TrainState, jnp.ndarray]:
+        inputs, targets = batch
+        mutable = list(state.model_state.keys())  # static at trace time
+
+        def batch_loss(params):
+            variables = {"params": params, **state.model_state}
+            if mutable:
+                predictions, new_model_state = apply_fn(
+                    variables, inputs, mutable=mutable
+                )
+            else:
+                predictions, new_model_state = apply_fn(variables, inputs), {}
+            return loss_fn(predictions, targets), new_model_state
+
+        (loss, new_model_state), grads = jax.value_and_grad(
+            batch_loss, has_aux=True
+        )(state.params)
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            params=new_params,
+            model_state=dict(new_model_state),
+            opt_state=new_opt_state,
+            step=state.step + 1,
+        )
+        return new_state, loss
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,))
+
+    replicated = replicated_sharding(mesh)
+    sharded_batch = batch_sharding(mesh, data_axis)
+    return jax.jit(
+        step,
+        in_shardings=(replicated, (sharded_batch, sharded_batch)),
+        out_shardings=(replicated, replicated),
+        donate_argnums=(0,),
+    )

@@ -1,0 +1,13 @@
+from distributed_pytorch_tpu.utils.data import (
+    MaterializedDataset,
+    RandomDataset,
+    ShardedLoader,
+)
+from distributed_pytorch_tpu.utils.platform import use_fake_cpu_devices
+
+__all__ = [
+    "MaterializedDataset",
+    "RandomDataset",
+    "ShardedLoader",
+    "use_fake_cpu_devices",
+]

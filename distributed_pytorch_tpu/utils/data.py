@@ -1,0 +1,178 @@
+"""Host-side synthetic datasets and the sharded batch loader.
+
+TPU-native replacement for the reference's data sublayer:
+
+* :class:`MaterializedDataset` — eagerly materialized ``(input, target)`` pairs,
+  capability twin of ``MyTrainDataset`` (reference ``utils.py:4-13``).
+* :class:`RandomDataset` — lazy per-index random samples, capability twin of
+  ``MyRandomDataset`` (reference ``utils.py:16-26``).
+* :class:`ShardedLoader` — batching + shuffling + per-process disjoint sharding,
+  replacing ``DataLoader(..., sampler=DistributedSampler(...))`` (reference
+  ``multigpu.py:72-79``). Shard semantics mirror ``DistributedSampler``: the
+  index list is padded *by wrapping around* so every shard sees the same number
+  of samples, and shards are strided (``indices[shard_index::num_shards]``) so
+  they are pairwise disjoint before padding.
+
+Data stays in numpy on the host; device placement (with sharding) happens in the
+Trainer so that the loader is backend-agnostic and cheap to test.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, Sequence, Tuple
+
+import numpy as np
+
+Batch = Tuple[np.ndarray, np.ndarray]
+
+
+class MaterializedDataset:
+    """Eagerly materialized random regression dataset.
+
+    Twin of ``MyTrainDataset`` (reference ``utils.py:4-13``): ``size`` pairs of
+    ``(input_dim,)`` inputs and ``(target_dim,)`` targets, generated once at
+    construction. Deterministic given ``seed``.
+    """
+
+    def __init__(self, size: int, input_dim: int = 20, target_dim: int = 1, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        self.inputs = rng.standard_normal((size, input_dim)).astype(np.float32)
+        self.targets = rng.standard_normal((size, target_dim)).astype(np.float32)
+
+    def __len__(self) -> int:
+        return self.inputs.shape[0]
+
+    def __getitem__(self, index: int) -> Batch:
+        return self.inputs[index], self.targets[index]
+
+
+class RandomDataset:
+    """Lazy random dataset: every ``__getitem__`` generates its sample on demand.
+
+    Twin of ``MyRandomDataset`` (reference ``utils.py:16-26``). Unlike the
+    reference (fresh ``torch.rand`` every access), samples here are
+    *deterministic per index* so that loss curves are reproducible and the
+    serial-vs-data-parallel parity tests are meaningful.
+
+    ``num_classes`` > 0 yields integer class targets (for classification models
+    like ResNet-50); otherwise targets are dense random vectors of
+    ``target_shape`` (the reference default ``(1000,)``).
+    """
+
+    def __init__(
+        self,
+        size: int,
+        input_shape: Sequence[int],
+        target_shape: Sequence[int] = (1000,),
+        seed: int = 0,
+        num_classes: int = 0,
+    ):
+        self.size = size
+        self.input_shape = tuple(input_shape)
+        self.target_shape = tuple(target_shape)
+        self.seed = seed
+        self.num_classes = num_classes
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __getitem__(self, index: int) -> Batch:
+        if not 0 <= index < self.size:
+            raise IndexError(index)
+        rng = np.random.default_rng([self.seed, index])
+        x = rng.standard_normal(self.input_shape).astype(np.float32)
+        if self.num_classes:
+            y = rng.integers(0, self.num_classes, size=(), dtype=np.int32)
+            return x, np.asarray(y)
+        y = rng.standard_normal(self.target_shape).astype(np.float32)
+        return x, y
+
+
+class ShardedLoader:
+    """Batched, optionally shuffled, per-process-sharded iterator over a dataset.
+
+    Replaces the reference's ``DataLoader`` + ``DistributedSampler`` pair
+    (``multigpu.py:72-79``) with ``DistributedSampler``-compatible semantics:
+
+    * total indices are padded by wrapping (repeat from the start) up to a
+      multiple of ``num_shards`` so all shards are equal length;
+    * shard ``i`` takes ``indices[i::num_shards]`` — disjoint before padding;
+    * ``set_epoch(e)`` reseeds the shuffle so every epoch (and every shard)
+      agrees on one global permutation, mirroring ``sampler.set_epoch``.
+
+    Ragged final batches and XLA: a batch whose leading dim changes forces a
+    recompile, and one that is not divisible by the mesh's data axis cannot be
+    placed with ``P("data")`` at all. Two remedies:
+
+    * ``drop_last=True`` drops the ragged final batch;
+    * ``pad_final_batch=True`` wraps the final batch around to full
+      ``batch_size`` (the same pad-by-repeat semantic DistributedSampler
+      applies across ranks). The Trainer auto-enables this when running on a
+      mesh.
+
+    With the reference's divisible defaults (2048 samples / batch 32) neither
+    changes anything.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        *,
+        shuffle: bool = False,
+        num_shards: int = 1,
+        shard_index: int = 0,
+        seed: int = 0,
+        drop_last: bool = False,
+        pad_final_batch: bool = False,
+    ):
+        if not 0 <= shard_index < num_shards:
+            raise ValueError(f"shard_index {shard_index} not in [0, {num_shards})")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.num_shards = num_shards
+        self.shard_index = shard_index
+        self.seed = seed
+        self.drop_last = drop_last
+        self.pad_final_batch = pad_final_batch
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed shuffling for ``epoch`` (twin of ``DistributedSampler.set_epoch``)."""
+        self._epoch = epoch
+
+    def shard_indices(self) -> np.ndarray:
+        """The (padded, strided) global indices owned by this shard, this epoch."""
+        n = len(self.dataset)
+        if self.shuffle:
+            order = np.random.default_rng([self.seed, self._epoch]).permutation(n)
+        else:
+            order = np.arange(n)
+        padded_total = math.ceil(n / self.num_shards) * self.num_shards
+        if padded_total > n:
+            order = np.concatenate([order, order[: padded_total - n]])
+        return order[self.shard_index :: self.num_shards]
+
+    def __len__(self) -> int:
+        per_shard = math.ceil(len(self.dataset) / self.num_shards)
+        if self.drop_last:
+            return per_shard // self.batch_size
+        return math.ceil(per_shard / self.batch_size)
+
+    def __iter__(self) -> Iterator[Batch]:
+        indices = self.shard_indices()
+        n_batches = len(self)
+        for b in range(n_batches):
+            chunk = indices[b * self.batch_size : (b + 1) * self.batch_size]
+            if self.pad_final_batch and len(chunk) < self.batch_size:
+                # np.resize repeats cyclically, so this wraps even when the
+                # whole shard is smaller than one batch.
+                chunk = np.concatenate(
+                    [chunk, np.resize(indices, self.batch_size - len(chunk))]
+                )
+            samples = [self.dataset[int(i)] for i in chunk]
+            xs = np.stack([s[0] for s in samples])
+            ys = np.stack([s[1] for s in samples])
+            yield xs, ys

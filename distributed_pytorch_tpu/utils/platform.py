@@ -1,0 +1,24 @@
+"""Backend/platform helpers.
+
+``use_fake_cpu_devices(n)`` presents ``n`` virtual CPU devices in this process
+— the framework's stand-in for a multi-chip test rig (SURVEY.md §4): it lets
+every DP/mesh code path run on a laptop or CI box with no TPU attached. Must be
+called before the first JAX backend touch (any ``jax.devices()`` /
+computation). Works even when a platform plugin overrides ``JAX_PLATFORMS``.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def use_fake_cpu_devices(n: int = 8) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,72 @@
+"""Rung 5 — profiled real-model training: ResNet-50 on synthetic images with
+step-scheduled TensorBoard traces. Twin of ``multigpu_profile.py``.
+
+* torchvision ``resnet50()`` (``multigpu_profile.py:23``) -> our flax ResNet-50
+  (NHWC, optional bfloat16 compute for the MXU);
+* ``torch.profiler`` with schedule(wait=1, warmup=1, active=5) and
+  ``tensorboard_trace_handler`` (``:80-91``) -> ``StepProfiler`` over
+  ``jax.profiler.start_trace/stop_trace`` with the same step schedule;
+* lazy ``MyRandomDataset(2048, (3,224,224))`` (``:16``) -> ``RandomDataset``
+  with NHWC ``(224,224,3)`` and integer class targets.
+
+View traces:  tensorboard --logdir log/resnet50
+
+Run:  python examples/multichip_profile.py [--epochs 3] [--batch_size 32] [--bf16]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_pytorch_tpu import RandomDataset, ShardedLoader, StepProfiler, Trainer, make_mesh
+from distributed_pytorch_tpu.models import ResNet50
+from distributed_pytorch_tpu.training.losses import softmax_cross_entropy_loss
+
+
+def load_train_objs(bf16: bool):
+    """Factory twin of ``multigpu_profile.py:13-27``."""
+    dataset = RandomDataset(2048, (224, 224, 3), num_classes=1000)
+    model = ResNet50(dtype=jnp.bfloat16 if bf16 else jnp.float32)
+    optimizer = optax.sgd(1e-3, momentum=0.9)
+    return dataset, model, optimizer
+
+
+def main(epochs: int, batch_size: int, bf16: bool, profile: bool, logdir: str):
+    mesh = make_mesh() if jax.device_count() > 1 else None
+    dataset, model, optimizer = load_train_objs(bf16)
+    loader = ShardedLoader(dataset, batch_size * jax.device_count(), drop_last=True)
+    profiler = StepProfiler(logdir, wait=1, warmup=1, active=5) if profile else None
+    trainer = Trainer(
+        model,
+        loader,
+        optimizer,
+        save_every=epochs,  # checkpoint at the end (reference saves once, :107-108)
+        checkpoint_path="resnet50_checkpoint.npz",
+        mesh=mesh,
+        loss_fn=softmax_cross_entropy_loss,
+        profiler=profiler,
+        log_every=10,
+    )
+    trainer.train(epochs)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="profiled ResNet-50 job (rung 5)")
+    parser.add_argument("--epochs", default=3, type=int)
+    parser.add_argument("--batch_size", default=32, type=int, help="per-chip batch size")
+    parser.add_argument("--bf16", action="store_true", help="bfloat16 compute (MXU-native)")
+    parser.add_argument("--no_profile", action="store_true")
+    parser.add_argument("--logdir", default="log/resnet50", type=str)
+    parser.add_argument("--fake_devices", default=0, type=int,
+                        help="debug: present N virtual CPU devices instead of real chips")
+    args = parser.parse_args()
+    if args.fake_devices:
+        from distributed_pytorch_tpu.utils.platform import use_fake_cpu_devices
+        use_fake_cpu_devices(args.fake_devices)
+    main(args.epochs, args.batch_size, args.bf16, not args.no_profile, args.logdir)

@@ -1,0 +1,50 @@
+"""Rung 1 — serial training on one chip. Twin of ``single_gpu.py``.
+
+The whole reference hot loop (``single_gpu.py:21-26``) is one jitted
+``train_step``; there is no device id to pass around — JAX places arrays on the
+default device.
+
+Run:  python examples/single_chip.py 10 2 [--batch_size 32]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import optax
+
+from distributed_pytorch_tpu import MaterializedDataset, ShardedLoader, Trainer
+from distributed_pytorch_tpu.models import ToyRegressor
+
+
+def load_train_objs():
+    """Factory twin of ``load_train_objs`` (``single_gpu.py:48-52``):
+    2048-sample toy dataset, Linear(20,1) model, SGD(lr=1e-3)."""
+    dataset = MaterializedDataset(2048)
+    model = ToyRegressor()
+    optimizer = optax.sgd(1e-3)
+    return dataset, model, optimizer
+
+
+def main(total_epochs: int, save_every: int, batch_size: int):
+    dataset, model, optimizer = load_train_objs()
+    loader = ShardedLoader(dataset, batch_size, shuffle=True)
+    trainer = Trainer(model, loader, optimizer, save_every)
+    trainer.train(total_epochs)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="serial training job (rung 1)")
+    parser.add_argument("total_epochs", type=int, help="Total epochs to train the model")
+    parser.add_argument("save_every", type=int, help="How often to save a checkpoint")
+    parser.add_argument("--batch_size", default=32, type=int,
+                        help="Input batch size on each device (default: 32)")
+    parser.add_argument("--fake_devices", default=0, type=int,
+                        help="debug: present N virtual CPU devices instead of real chips")
+    args = parser.parse_args()
+    if args.fake_devices:
+        from distributed_pytorch_tpu.utils.platform import use_fake_cpu_devices
+        use_fake_cpu_devices(args.fake_devices)
+    main(args.total_epochs, args.save_every, args.batch_size)

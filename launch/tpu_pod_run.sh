@@ -1,0 +1,27 @@
+#!/bin/bash
+# Multi-host pod-slice launcher — twin of slurm/sbatch_run.sh in the reference.
+#
+# Where the reference's SLURM job discovers a head-node IP and launches torchrun
+# on every node with a c10d rendezvous endpoint (sbatch_run.sh:9-23), a TPU pod
+# slice needs only "run the same command on every worker": each host process
+# calls jax.distributed.initialize(), which autodetects the coordinator from
+# TPU metadata. No head-node discovery, no rendezvous port, no per-node agent.
+#
+# Usage:
+#   TPU_NAME=my-v4-32 ZONE=us-central2-b ./launch/tpu_pod_run.sh 50 5
+#
+# Prereqs: the repo cloned at the same path on every worker (see
+# launch/setup_tpu_pod.md), gcloud authenticated.
+
+set -euo pipefail
+
+TPU_NAME="${TPU_NAME:?set TPU_NAME to your TPU VM/slice name}"
+ZONE="${ZONE:?set ZONE to the TPU's GCP zone}"
+REPO_DIR="${REPO_DIR:-\$HOME/distributed_pytorch_tpu}"
+TOTAL_EPOCHS="${1:-50}"
+SAVE_EVERY="${2:-5}"
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" \
+  --zone="$ZONE" \
+  --worker=all \
+  --command="cd $REPO_DIR && python examples/multihost_pod.py $TOTAL_EPOCHS $SAVE_EVERY"

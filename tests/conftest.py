@@ -1,0 +1,25 @@
+"""Test bootstrap: force an 8-device virtual CPU backend BEFORE jax imports.
+
+This is the TPU-world stand-in for a multi-chip test rig (SURVEY.md §4):
+``--xla_force_host_platform_device_count=8`` gives 8 CPU "devices", so
+mesh/sharding/collective tests (the ``multigpu.py`` tier of the reference)
+run on one host in CI.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment may ship a platform plugin (e.g. the experimental "axon" TPU
+# tunnel) that overrides JAX_PLATFORMS; pin the config explicitly before any
+# backend is initialized.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# Make the repo importable without installation.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

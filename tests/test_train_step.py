@@ -1,0 +1,114 @@
+"""train_step unit tests: loss decreases, gradients correct, DP == serial.
+
+Mirrors SURVEY.md §4's designed strategy (the reference has no tests at all).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_pytorch_tpu.models.toy import ToyRegressor
+from distributed_pytorch_tpu.parallel.mesh import make_mesh
+from distributed_pytorch_tpu.parallel.sharding import put_global_batch, replicated_sharding
+from distributed_pytorch_tpu.training.losses import mse_loss
+from distributed_pytorch_tpu.training.train_step import (
+    create_train_state,
+    make_train_step,
+)
+from distributed_pytorch_tpu.utils.data import MaterializedDataset, ShardedLoader
+
+
+def _toy_setup(lr=1e-2, seed=0):
+    model = ToyRegressor()
+    optimizer = optax.sgd(lr)
+    ds = MaterializedDataset(256, seed=seed)
+    loader = ShardedLoader(ds, 32)
+    state = create_train_state(model, optimizer, next(iter(loader))[0], rng_seed=seed)
+    return model, optimizer, loader, state
+
+
+def test_loss_decreases_serial():
+    model, optimizer, loader, state = _toy_setup()
+    step = make_train_step(model.apply, optimizer, mse_loss)
+    first = last = None
+    for epoch in range(20):
+        for xs, ys in loader:
+            state, loss = step(state, (jnp.asarray(xs), jnp.asarray(ys)))
+            if first is None:
+                first = float(loss)
+            last = float(loss)
+    assert last < first * 0.9
+
+
+def test_gradients_match_finite_differences():
+    model, optimizer, loader, state = _toy_setup()
+    xs, ys = next(iter(loader))
+    xs, ys = jnp.asarray(xs), jnp.asarray(ys)
+
+    def loss_of(params):
+        return mse_loss(model.apply({"params": params}, xs), ys)
+
+    grads = jax.grad(loss_of)(state.params)
+    flat_grads, _ = jax.tree_util.tree_flatten(grads)
+    flat_params, treedef = jax.tree_util.tree_flatten(state.params)
+    eps = 1e-3
+    # Perturb one scalar of the kernel and compare against the analytic grad.
+    kernel_idx = max(range(len(flat_params)), key=lambda i: flat_params[i].size)
+    p = flat_params[kernel_idx]
+    bumped = p.at[(0,) * p.ndim].add(eps)
+    flat_bumped = list(flat_params)
+    flat_bumped[kernel_idx] = bumped
+    fd = (loss_of(jax.tree_util.tree_unflatten(treedef, flat_bumped)) - loss_of(state.params)) / eps
+    analytic = flat_grads[kernel_idx][(0,) * p.ndim]
+    np.testing.assert_allclose(float(fd), float(analytic), rtol=1e-2, atol=1e-3)
+
+
+def test_step_counter_increments():
+    model, optimizer, loader, state = _toy_setup()
+    step = make_train_step(model.apply, optimizer, mse_loss)
+    xs, ys = next(iter(loader))
+    state, _ = step(state, (jnp.asarray(xs), jnp.asarray(ys)))
+    state, _ = step(state, (jnp.asarray(xs), jnp.asarray(ys)))
+    assert int(state.step) == 2
+
+
+def test_data_parallel_matches_serial():
+    """The DDP-parity property the reference only implies: with the same seed
+    and the same global batch, the 8-way sharded step produces the same params
+    and loss trajectory as the serial step."""
+    assert jax.device_count() == 8, "conftest must provide 8 virtual devices"
+    model, optimizer, loader, serial_state = _toy_setup()
+    # Independent-but-identical state: device_put can alias the source buffer
+    # as the device-0 shard, and the serial step donates its input state.
+    _, _, _, dp_state0 = _toy_setup()
+
+    serial_step = make_train_step(model.apply, optimizer, mse_loss)
+    mesh = make_mesh()
+    dp_step = make_train_step(model.apply, optimizer, mse_loss, mesh=mesh)
+
+    dp_state = jax.device_put(dp_state0, replicated_sharding(mesh))
+
+    losses_serial, losses_dp = [], []
+    for epoch in range(2):
+        loader.set_epoch(epoch)
+        for xs, ys in loader:
+            serial_state, l1 = serial_step(serial_state, (jnp.asarray(xs), jnp.asarray(ys)))
+            dp_state, l2 = dp_step(dp_state, put_global_batch(mesh, (xs, ys)))
+            losses_serial.append(float(l1))
+            losses_dp.append(float(l2))
+
+    np.testing.assert_allclose(losses_serial, losses_dp, rtol=1e-5, atol=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(serial_state.params),
+        jax.tree_util.tree_leaves(dp_state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_dp_batch_actually_sharded():
+    mesh = make_mesh()
+    xs = np.zeros((32, 20), np.float32)
+    arr = put_global_batch(mesh, xs)
+    assert len(arr.sharding.device_set) == 8
+    assert arr.addressable_shards[0].data.shape == (4, 20)

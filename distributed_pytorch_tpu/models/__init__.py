@@ -7,6 +7,8 @@ from distributed_pytorch_tpu.models.resnet import (
     ResNet101,
 )
 from distributed_pytorch_tpu.models.toy import ToyRegressor
+from distributed_pytorch_tpu.models.transformer import TransformerLM
+from distributed_pytorch_tpu.models.vit import ViT, ViT_L32
 
 __all__ = [
     "MLP",
@@ -16,4 +18,7 @@ __all__ = [
     "ResNet50",
     "ResNet101",
     "ToyRegressor",
+    "TransformerLM",
+    "ViT",
+    "ViT_L32",
 ]

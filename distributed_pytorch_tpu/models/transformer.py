@@ -1,0 +1,144 @@
+"""Decoder-only transformer language model — the long-context flagship.
+
+No reference analog (the reference tops out at ResNet-50 / a commented-out
+torchvision ViT, ``multigpu_profile.py:23-24``); this is the model family that
+exercises the framework's first-class long-context machinery:
+
+* attention is pluggable: dense (XLA-fused) or :func:`ring_attention`
+  (sequence-parallel over the mesh's ``sequence`` axis with ppermute rotation);
+* RoPE positions are *global* sequence positions — correct under jit whether or
+  not the sequence dim is sharded, because jitted arrays have global semantics;
+* ``remat=True`` wraps each block in ``jax.checkpoint`` (rematerialize
+  activations in backward — the HBM-for-FLOPs trade that long sequences need);
+* all matmul-bearing modules take a compute ``dtype`` (bfloat16 for the MXU),
+  while parameters and layernorm statistics stay float32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from distributed_pytorch_tpu.ops.attention import (
+    dot_product_attention,
+    ring_attention,
+)
+
+
+def apply_rope(x: jnp.ndarray, *, theta: float = 10000.0) -> jnp.ndarray:
+    """Rotary position embedding over [B, T, H, D] (global positions 0..T-1)."""
+    d_half = x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(0, d_half, dtype=jnp.float32) / d_half)
+    positions = jnp.arange(x.shape[1], dtype=jnp.float32)
+    angles = positions[:, None] * freqs[None, :]  # [T, D/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :d_half], x[..., d_half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return rotated.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    """Multi-head attention with RoPE and a pluggable core."""
+
+    n_heads: int
+    d_model: int
+    dtype: Any = jnp.float32
+    causal: bool = True
+    mesh: Optional[Mesh] = None
+    sequence_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        head_dim = self.d_model // self.n_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (self.n_heads, head_dim), dtype=self.dtype, name=name
+        )
+        q = apply_rope(dense("query")(x))
+        k = apply_rope(dense("key")(x))
+        v = dense("value")(x)
+
+        use_ring = (
+            self.mesh is not None
+            and self.sequence_axis is not None
+            and self.mesh.shape.get(self.sequence_axis, 1) > 1
+        )
+        if use_ring:
+            out = ring_attention(
+                q, k, v, mesh=self.mesh, axis_name=self.sequence_axis,
+                causal=self.causal,
+            )
+        else:
+            out = dot_product_attention(q, k, v, causal=self.causal)
+        return nn.DenseGeneral(
+            self.d_model, axis=(-2, -1), dtype=self.dtype, name="out"
+        )(out)
+
+
+class MLPBlock(nn.Module):
+    d_ff: int
+    d_model: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        h = nn.Dense(self.d_ff, dtype=self.dtype, name="up")(x)
+        h = nn.gelu(h)
+        return nn.Dense(self.d_model, dtype=self.dtype, name="down")(h)
+
+
+class TransformerBlock(nn.Module):
+    n_heads: int
+    d_model: int
+    d_ff: int
+    dtype: Any = jnp.float32
+    causal: bool = True
+    mesh: Optional[Mesh] = None
+    sequence_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x + Attention(
+            self.n_heads, self.d_model, self.dtype, self.causal,
+            self.mesh, self.sequence_axis, name="attention",
+        )(nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x))
+        x = x + MLPBlock(self.d_ff, self.d_model, self.dtype, name="mlp")(
+            nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+        )
+        return x
+
+
+class TransformerLM(nn.Module):
+    """GPT-style causal LM over token ids ``[batch, seq] -> [batch, seq, vocab]``."""
+
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 6
+    n_heads: int = 8
+    d_ff: int = 2048
+    dtype: Any = jnp.float32
+    remat: bool = False
+    mesh: Optional[Mesh] = None
+    sequence_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
+        x = nn.Embed(
+            self.vocab_size, self.d_model, dtype=self.dtype, name="embed"
+        )(tokens)
+        block = TransformerBlock
+        if self.remat:
+            block = nn.remat(TransformerBlock)
+        for i in range(self.n_layers):
+            x = block(
+                self.n_heads, self.d_model, self.d_ff, self.dtype,
+                True, self.mesh, self.sequence_axis, name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        # Logits in float32 for a numerically stable softmax-cross-entropy.
+        return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(x)

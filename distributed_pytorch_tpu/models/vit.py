@@ -1,0 +1,72 @@
+"""Vision Transformer — parity with the reference's alternative real model.
+
+The reference offers torchvision ``vit_l_32`` (306M params) as a drop-in for
+ResNet-50, left commented out at ``multigpu_profile.py:24``. This is that
+model family TPU-first: NHWC patches, bfloat16 compute option, and the same
+pluggable attention stack as :class:`TransformerLM` (non-causal here).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_pytorch_tpu.models.transformer import TransformerBlock
+
+
+class ViT(nn.Module):
+    """Vision Transformer over NHWC images ``[batch, H, W, 3]``."""
+
+    patch_size: int = 32
+    d_model: int = 1024
+    n_layers: int = 24
+    n_heads: int = 16
+    d_ff: int = 4096
+    num_classes: int = 1000
+    image_size: int = 224
+    dtype: Any = jnp.float32
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, images: jnp.ndarray) -> jnp.ndarray:
+        p = self.patch_size
+        # Patchify = non-overlapping conv; one big MXU matmul per image.
+        x = nn.Conv(
+            self.d_model, (p, p), strides=(p, p), padding="VALID",
+            dtype=self.dtype, name="patch_embed",
+        )(images.astype(self.dtype))
+        b, h, w, c = x.shape
+        x = x.reshape(b, h * w, c)
+
+        cls = self.param(
+            "cls_token", nn.initializers.zeros, (1, 1, self.d_model), jnp.float32
+        )
+        x = jnp.concatenate([jnp.tile(cls.astype(x.dtype), (b, 1, 1)), x], axis=1)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (1, x.shape[1], self.d_model),
+            jnp.float32,
+        )
+        x = x + pos.astype(x.dtype)
+
+        block = nn.remat(TransformerBlock) if self.remat else TransformerBlock
+        for i in range(self.n_layers):
+            x = block(
+                self.n_heads, self.d_model, self.d_ff, self.dtype,
+                causal=False, name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(
+            x[:, 0]
+        )
+
+
+# torchvision vit_l_32 twin (306M params, the configuration named at
+# multigpu_profile.py:24).
+ViT_L32 = partial(
+    ViT, patch_size=32, d_model=1024, n_layers=24, n_heads=16, d_ff=4096
+)

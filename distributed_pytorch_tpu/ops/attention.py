@@ -1,0 +1,157 @@
+"""Attention ops: dense reference implementation and ring attention for
+sequence/context parallelism.
+
+No reference analog — the reference's only attention is inside the
+(commented-out) torchvision ViT at ``multigpu_profile.py:24``. Long-context
+support is a first-class requirement of this framework, so the attention op is
+built for it from the start:
+
+* :func:`dot_product_attention` — plain fused-softmax attention; XLA fuses this
+  well on TPU for moderate sequence lengths.
+* :func:`ring_attention` — blockwise-streaming attention over a sharded
+  sequence axis. Each device holds ``T/n`` of the sequence; K/V shards rotate
+  around the mesh axis via ``jax.lax.ppermute`` (nearest-neighbor ICI traffic,
+  no all-gather), and softmax is accumulated online (flash-attention style
+  running max/denominator), so the full ``T x T`` score matrix never
+  materializes and memory per chip stays ``O(T/n)``. This is the
+  RingAttention construction (Liu et al. 2023), expressed with
+  ``shard_map`` + ``ppermute`` so XLA schedules the collective permutes
+  onto the ICI ring.
+
+Shapes follow the TPU-friendly convention ``[batch, seq, heads, head_dim]``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def dot_product_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Reference attention. ``q``: [B, Tq, H, D]; ``k``/``v``: [B, Tk, H, D]."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        q_pos = jnp.arange(q.shape[1])[:, None]
+        kv_pos = jnp.arange(k.shape[1])[None, :]
+        logits = jnp.where(q_pos >= kv_pos, logits, NEG_INF)
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def _ring_attention_shard(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    causal: bool,
+) -> jnp.ndarray:
+    """Per-device body (runs under shard_map): online-softmax over rotating
+    K/V blocks. ``q,k,v``: [B, T_local, H, D] shards of the global sequence."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    scale = q.shape[-1] ** -0.5
+
+    q32 = q.astype(jnp.float32)
+    q_pos = my_index * t_local + jnp.arange(t_local)
+
+    def body(step, carry):
+        o, m, l, kv = carry
+        k_blk, v_blk = kv
+        # Block `step` holds the K/V shard originally owned by device
+        # (my_index - step) mod axis_size.
+        kv_index = (my_index - step) % axis_size
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
+        if causal:
+            kv_pos = kv_index * t_local + jnp.arange(t_local)
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            logits = jnp.where(mask, logits, NEG_INF)
+        blk_max = jnp.max(logits, axis=-1)  # [B,H,Tq]
+        new_m = jnp.maximum(m, blk_max)
+        correction = jnp.exp(m - new_m)
+        p = jnp.exp(logits - new_m[..., None])  # [B,H,Tq,Tk]
+        new_l = l * correction + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+        new_o = o * correction.transpose(0, 2, 1)[..., None] + pv
+        # Rotate K/V one hop around the ring (nearest-neighbor ICI); the final
+        # block needs no rotation, so skip that pair of collectives.
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_next, v_next = jax.lax.cond(
+            step < axis_size - 1,
+            lambda kv: (
+                jax.lax.ppermute(kv[0], axis_name, perm),
+                jax.lax.ppermute(kv[1], axis_name, perm),
+            ),
+            lambda kv: kv,
+            (k_blk, v_blk),
+        )
+        return new_o, new_m, new_l, (k_next, v_next)
+
+    b, _, h, d = q.shape
+    o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
+    m0 = jnp.full((b, h, t_local), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local), jnp.float32)
+    o, m, l, _ = jax.lax.fori_loop(0, axis_size, body, (o0, m0, l0, (k, v)))
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    axis_name: str = "sequence",
+    causal: bool = False,
+    batch_axis: Optional[str] = "data",
+) -> jnp.ndarray:
+    """Sequence-parallel attention over globally-shaped arrays.
+
+    Inputs are global ``[B, T, H, D]`` arrays whose sequence dim is (to be)
+    sharded along ``axis_name``; the shard_map splits them, runs the ring, and
+    reassembles. Degenerates to one dense block when the axis has size 1.
+    """
+    seq_size = mesh.shape.get(axis_name, 1)
+    if seq_size == 1:
+        # Mesh has no (or a trivial) sequence axis: plain dense attention.
+        return dot_product_attention(q, k, v, causal=causal)
+    if q.shape[1] % seq_size != 0:
+        raise ValueError(
+            f"sequence length {q.shape[1]} not divisible by mesh axis "
+            f"{axis_name!r} ({seq_size})"
+        )
+    batch_spec = (
+        batch_axis
+        if (
+            batch_axis
+            and batch_axis in mesh.shape
+            and q.shape[0] % mesh.shape[batch_axis] == 0
+        )
+        else None
+    )
+    spec = P(batch_spec, axis_name, None, None)
+    body = functools.partial(
+        _ring_attention_shard, axis_name=axis_name, causal=causal
+    )
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

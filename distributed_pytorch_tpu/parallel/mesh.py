@@ -47,6 +47,7 @@ def make_mesh(
         make_mesh({"data": -1, "tensor": 4})     # 2-D DP x TP
         make_mesh({"data": 2, "sequence": 4})    # DP x ring-attention SP
     """
+    explicit_devices = devices is not None
     if devices is None:
         devices = jax.devices()
     n = len(devices)
@@ -62,8 +63,20 @@ def make_mesh(
         if n % known != 0:
             raise ValueError(f"{n} devices not divisible by fixed axes product {known}")
         sizes[sizes.index(-1)] = n // known
-    if math.prod(sizes) != n:
-        raise ValueError(f"mesh shape {dict(zip(names, sizes))} != {n} devices")
+    needed = math.prod(sizes)
+    if needed > n:
+        raise ValueError(f"mesh shape {dict(zip(names, sizes))} needs {needed} devices, have {n}")
+    if needed < n:
+        # Using fewer devices than exist is almost always a typo when the
+        # device list was implicit (and would strand whole processes in
+        # multi-host runs, where the prefix may exclude a host's chips);
+        # require the caller to pass `devices=` to opt in to a submesh.
+        if not explicit_devices:
+            raise ValueError(
+                f"mesh shape {dict(zip(names, sizes))} uses {needed} of {n} "
+                "devices; pass devices= explicitly to build a submesh"
+            )
+        devices = list(devices)[:needed]
 
     if len(sizes) == 1:
         # Keep explicit device order for 1-D meshes (predictable shard placement).

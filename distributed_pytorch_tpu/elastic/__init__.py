@@ -1,0 +1,20 @@
+"""Elastic launch: the ``tpurun`` agent and its native rendezvous store.
+
+TPU-native replacement for the torchrun + c10d layer the reference leans on
+(SURVEY.md §3.3; ``slurm/sbatch_run.sh:17-23``).
+"""
+
+from distributed_pytorch_tpu.elastic.agent import (
+    ElasticAgent,
+    ElasticConfig,
+    main as tpurun_main,
+)
+from distributed_pytorch_tpu.elastic.store import KVStoreClient, KVStoreServer
+
+__all__ = [
+    "ElasticAgent",
+    "ElasticConfig",
+    "KVStoreClient",
+    "KVStoreServer",
+    "tpurun_main",
+]

@@ -1,0 +1,7 @@
+"""``python -m distributed_pytorch_tpu.elastic`` — the tpurun CLI."""
+
+import sys
+
+from distributed_pytorch_tpu.elastic.agent import main
+
+sys.exit(main())

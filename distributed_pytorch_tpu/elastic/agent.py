@@ -1,0 +1,391 @@
+"""``tpurun`` — the elastic launch agent (torchrun twin).
+
+The reference's rungs 3-4 lean on ``torchrun`` for everything the scripts
+don't do themselves (SURVEY.md §3.3): env-var rendezvous, per-node worker
+spawning, failure detection, and restart-the-world recovery. This module is
+that machinery, TPU-native:
+
+* **Env contract** — workers receive ``COORDINATOR_ADDRESS`` /
+  ``NUM_PROCESSES`` / ``PROCESS_ID`` / ``LOCAL_RANK`` /
+  ``TPURUN_RESTART_COUNT`` (the torchrun ``MASTER_ADDR:PORT`` / ``WORLD_SIZE``
+  / ``RANK`` / ``LOCAL_RANK`` / ``TORCHELASTIC_RESTART_COUNT`` analog,
+  reference ``multigpu_torchrun.py:24``); ``setup_distributed()`` consumes
+  them (``parallel/bootstrap.py``).
+* **Rendezvous** — agents meet at a native C++ TCP store (``elastic/store.py``,
+  the c10d TCPStore twin) on the rendezvous host; node 0's agent runs the
+  store. Joins are counted per generation; everyone proceeds when all
+  ``nnodes`` agents have joined.
+* **Failure detection** — local: the agent polls its workers; any nonzero exit
+  is a failure. Remote: each agent heartbeats ``hb/<node>`` into the store and
+  a monitor thread watches the failure-generation key and peer heartbeats.
+* **Recovery** — torchrun's restart-all policy: on any failure the detecting
+  agent bumps the generation key; every agent kills its local workers,
+  re-rendezvouses at the new generation, and respawns, up to
+  ``--max-restarts``. Training survives because the Trainer's snapshot
+  contract (probe-on-init, epoch-offset resume — reference
+  ``multigpu_torchrun.py:30-40,57-65``) makes workers idempotent.
+
+Single node (``--standalone``) and multi-node (``--nnodes``/``--node-rank``/
+``--rdzv-endpoint host:port``, the ``sbatch_run.sh:17-23`` shape) use the
+identical code path; single-node simply has ``nnodes=1`` and the store on
+localhost.
+
+Usage::
+
+    python -m distributed_pytorch_tpu.elastic.agent --nproc-per-node 4 \
+        --max-restarts 3 train.py --epochs 10
+    # multi-node, on every node:
+    python -m distributed_pytorch_tpu.elastic.agent --nnodes 4 --node-rank $I \
+        --nproc-per-node 1 --rdzv-endpoint head:29400 train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from distributed_pytorch_tpu.elastic.store import KVStoreClient, KVStoreServer
+
+GEN_KEY = "tpurun/generation"  # bumped on every failure -> restart-the-world
+FATAL_KEY = "tpurun/fatal"  # set when restarts are exhausted or world aborts
+DONE_PREFIX = "tpurun/done/"  # done/<gen> counts agents whose workers finished
+ACK_PREFIX = "tpurun/ack/"  # ack/<gen> exit barrier: node 0 keeps the store up until all ack
+JOIN_PREFIX = "tpurun/join/"  # join/<gen> counts agents present at <gen>
+HB_PREFIX = "tpurun/hb/"  # hb/<node_rank> -> monotonically increasing beat
+
+
+@dataclass
+class ElasticConfig:
+    nproc_per_node: int = 1
+    nnodes: int = 1
+    node_rank: int = 0
+    rdzv_host: str = "127.0.0.1"
+    rdzv_port: int = 29400
+    max_restarts: int = 3
+    heartbeat_interval: float = 2.0
+    heartbeat_timeout: float = 30.0
+    env: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def world_size(self) -> int:
+        return self.nnodes * self.nproc_per_node
+
+    @property
+    def coordinator_address(self) -> str:
+        # JAX's coordination service listens next door to the rendezvous store.
+        return f"{self.rdzv_host}:{self.rdzv_port + 1}"
+
+
+class WorkerGroup:
+    """The local workers of one agent: spawn, poll, terminate."""
+
+    def __init__(self, cfg: ElasticConfig, cmd: List[str], restart_count: int):
+        self.procs: List[subprocess.Popen] = []
+        for local_rank in range(cfg.nproc_per_node):
+            env = dict(os.environ)
+            env.update(cfg.env)
+            env.update(
+                COORDINATOR_ADDRESS=cfg.coordinator_address,
+                NUM_PROCESSES=str(cfg.world_size),
+                PROCESS_ID=str(cfg.node_rank * cfg.nproc_per_node + local_rank),
+                LOCAL_RANK=str(local_rank),
+                TPURUN_RESTART_COUNT=str(restart_count),
+            )
+            self.procs.append(subprocess.Popen(cmd, env=env))
+
+    def poll(self) -> Optional[int]:
+        """None while all run / after all succeeded; first nonzero exit code if
+        any worker failed."""
+        for p in self.procs:
+            code = p.poll()
+            if code is not None and code != 0:
+                return code
+        return None
+
+    def all_done(self) -> bool:
+        return all(p.poll() == 0 for p in self.procs)
+
+    def terminate(self, grace: float = 10.0) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + grace
+        for p in self.procs:
+            timeout = max(0.0, deadline - time.monotonic())
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+
+class ElasticAgent:
+    """One per node. Runs the rendezvous/spawn/monitor/restart loop."""
+
+    def __init__(self, cfg: ElasticConfig, cmd: List[str]):
+        self.cfg = cfg
+        self.cmd = cmd
+        self.server: Optional[KVStoreServer] = None
+        if cfg.node_rank == 0:
+            self.server = KVStoreServer(cfg.rdzv_port)
+        self.store = KVStoreClient(cfg.rdzv_host, cfg.rdzv_port)
+        self._stop_hb = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._group: Optional[WorkerGroup] = None
+        self._joined_generations: set = set()
+        # rank -> (last beat value, local monotonic time it changed)
+        self._peer_beats: Dict[int, tuple] = {}
+
+    # ------------------------------------------------------------- heartbeat
+    def _heartbeat_loop(self) -> None:
+        """Publish a monotonically increasing beat counter on one persistent
+        connection; reconnect on transient store errors (a dropped beat must
+        not look like a dead node)."""
+        beat = 0
+        client: Optional[KVStoreClient] = None
+        while not self._stop_hb.wait(self.cfg.heartbeat_interval):
+            beat += 1
+            try:
+                if client is None:
+                    client = KVStoreClient(
+                        self.cfg.rdzv_host, self.cfg.rdzv_port, connect_timeout=5.0
+                    )
+                client.set(f"{HB_PREFIX}{self.cfg.node_rank}", str(beat))
+            except (ConnectionError, OSError):
+                if client is not None:
+                    client.close()
+                client = None  # retry with a fresh connection next beat
+        if client is not None:
+            client.close()
+
+    def _peer_dead(self) -> Optional[int]:
+        """Node rank of a peer whose heartbeat went stale, if any.
+
+        Staleness is judged purely on this node's monotonic clock — the beat
+        value is an opaque counter, never a timestamp — so cross-host clock
+        skew cannot declare a healthy peer dead."""
+        now = time.monotonic()
+        for rank in range(self.cfg.nnodes):
+            if rank == self.cfg.node_rank:
+                continue
+            beat = self.store.get(f"{HB_PREFIX}{rank}")
+            if beat is None:
+                continue  # not yet joined — rendezvous handles that phase
+            last_beat, seen_at = self._peer_beats.get(rank, (None, None))
+            if beat != last_beat:
+                self._peer_beats[rank] = (beat, now)
+            elif now - seen_at > self.cfg.heartbeat_timeout:
+                return rank
+        return None
+
+    # ------------------------------------------------------------- lifecycle
+    def _rendezvous(self, timeout: float = 600.0) -> int:
+        """Join the current generation and block until all ``nnodes`` agents
+        are present at it. Concurrent failures can bump the generation while
+        we wait (two agents may each bump for the same incident — ADD is
+        atomic, so the world just skips a number); re-join whatever the latest
+        generation is, joining each at most once so counts stay exact."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            generation = int(self.store.get(GEN_KEY) or 0)
+            if generation not in self._joined_generations:
+                self.store.add(f"{JOIN_PREFIX}{generation}", 1)
+                self._joined_generations.add(generation)
+            joined = self.store.wait_ge(
+                f"{JOIN_PREFIX}{generation}", self.cfg.nnodes, timeout=2.0
+            )
+            if joined is not None and int(self.store.get(GEN_KEY) or 0) == generation:
+                return generation
+        raise RuntimeError(
+            f"rendezvous timed out ({self.cfg.nnodes} nodes expected)"
+        )
+
+    def run(self) -> int:
+        cfg = self.cfg
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        self._hb_thread.start()
+        restarts = 0
+        try:
+            while True:
+                generation = self._rendezvous()
+                if cfg.node_rank == 0:
+                    print(
+                        f"[tpurun] generation {generation}: {cfg.nnodes} node(s) x "
+                        f"{cfg.nproc_per_node} proc(s), world={cfg.world_size}",
+                        flush=True,
+                    )
+                group = self._group = WorkerGroup(cfg, self.cmd, restarts)
+                failure = self._monitor(group, generation)
+                if failure is None:
+                    # Local workers all succeeded; wait for every agent.
+                    self.store.add(f"{DONE_PREFIX}{generation}", 1)
+                    result = self._await_world_done(generation)
+                    if result == "done":
+                        # Exit barrier: the store lives on node 0, so node 0
+                        # must not tear it down until every agent has seen
+                        # "done" (else their final waits die mid-request).
+                        try:
+                            self.store.add(f"{ACK_PREFIX}{generation}", 1)
+                            if self.cfg.node_rank == 0:
+                                self.store.wait_ge(
+                                    f"{ACK_PREFIX}{generation}",
+                                    self.cfg.nnodes,
+                                    timeout=60.0,
+                                )
+                        except (ConnectionError, OSError):
+                            pass  # store already gone -> world is done anyway
+                        return 0
+                    # else: someone failed after we finished -> fall through to restart
+                group.terminate()
+                if self.store.get(FATAL_KEY):
+                    print("[tpurun] aborting: world marked fatal", file=sys.stderr)
+                    return 1
+                restarts += 1
+                if restarts > cfg.max_restarts:
+                    self.store.set(FATAL_KEY, f"node{cfg.node_rank}-restarts-exhausted")
+                    print(
+                        f"[tpurun] giving up after {cfg.max_restarts} restarts",
+                        file=sys.stderr,
+                    )
+                    return 1
+                print(
+                    f"[tpurun] failure detected (gen {generation}); "
+                    f"restart {restarts}/{cfg.max_restarts}",
+                    flush=True,
+                )
+        finally:
+            self._stop_hb.set()
+            self.close()
+
+    def _monitor(self, group: WorkerGroup, generation: int) -> Optional[str]:
+        """Poll local workers + the store until success (None) or failure (str).
+
+        On local failure, bumps the generation so every other agent restarts
+        too (torchrun's restart-the-world semantics).
+        """
+        cfg = self.cfg
+        last_peer_check = 0.0
+        while True:
+            code = group.poll()
+            if code is not None:
+                self.store.add(GEN_KEY, 1)
+                return f"local worker exited with {code}"
+            if group.all_done():
+                return None
+            current_gen = int(self.store.get(GEN_KEY) or 0)
+            if current_gen != generation:
+                return "remote failure (generation bumped)"
+            if self.store.get(FATAL_KEY):
+                return "fatal"
+            now = time.monotonic()
+            if cfg.nnodes > 1 and now - last_peer_check > cfg.heartbeat_interval:
+                last_peer_check = now
+                dead = self._peer_dead()
+                if dead is not None:
+                    self.store.add(GEN_KEY, 1)
+                    return f"node {dead} heartbeat lost"
+            time.sleep(0.2)
+
+    def _await_world_done(self, generation: int) -> str:
+        """After local success: block until all agents report done ('done') or a
+        failure elsewhere bumps the generation ('restart')."""
+        while True:
+            try:
+                done = self.store.wait_ge(
+                    f"{DONE_PREFIX}{generation}", self.cfg.nnodes, timeout=1.0
+                )
+                if done is not None:
+                    return "done"
+                if int(self.store.get(GEN_KEY) or 0) != generation:
+                    return "restart"
+                if self.store.get(FATAL_KEY):
+                    return "restart"
+            except (ConnectionError, OSError):
+                # The store dies only when node 0's agent exits — and after our
+                # own workers succeeded that means the world completed.
+                return "done"
+
+    def close(self) -> None:
+        self._stop_hb.set()
+        if self._group is not None:
+            self._group.terminate()
+            self._group = None
+        try:
+            if self.server is not None:
+                self.store.shutdown_server()
+        finally:
+            self.store.close()
+            if self.server is not None:
+                self.server.close()
+
+
+def _parse_endpoint(endpoint: str) -> tuple:
+    host, _, port = endpoint.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpurun",
+        description="Elastic launcher for distributed_pytorch_tpu (torchrun twin)",
+    )
+    p.add_argument("--nproc-per-node", type=int, default=1)
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node-rank", type=int, default=0)
+    p.add_argument(
+        "--rdzv-endpoint",
+        default="127.0.0.1:29400",
+        help="host:port of the rendezvous store (runs on node 0)",
+    )
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument(
+        "--standalone",
+        action="store_true",
+        help="single-node shorthand: nnodes=1, store on an ephemeral local port",
+    )
+    p.add_argument("script", help="training script to launch")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = make_parser().parse_args(argv)
+    if args.standalone:
+        args.nnodes, args.node_rank = 1, 0
+        args.rdzv_endpoint = f"127.0.0.1:{_free_port()}"
+    host, port = _parse_endpoint(args.rdzv_endpoint)
+    cfg = ElasticConfig(
+        nproc_per_node=args.nproc_per_node,
+        nnodes=args.nnodes,
+        node_rank=args.node_rank,
+        rdzv_host=host,
+        rdzv_port=port,
+        max_restarts=args.max_restarts,
+    )
+    agent = ElasticAgent(cfg, [sys.executable, args.script] + args.script_args)
+
+    def _forward_signal(signum, frame):
+        agent.close()
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _forward_signal)
+    signal.signal(signal.SIGINT, _forward_signal)
+    return agent.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

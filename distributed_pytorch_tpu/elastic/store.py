@@ -1,0 +1,150 @@
+"""Python client + server wrapper for the native ``tpu_kvstore`` rendezvous
+store (see ``native/kvstore.cpp``).
+
+This pair replaces the reference's c10d TCPStore: the store process runs on the
+rendezvous host (``--rdzv_endpoint head:29500`` in reference
+``slurm/sbatch_run.sh:21-22``), every elastic agent connects as a client, and
+all coordination — join counting, failure-generation broadcast, barriers —
+happens through these few primitives.
+"""
+
+from __future__ import annotations
+
+import socket
+import subprocess
+import time
+import urllib.parse
+from typing import List, Optional
+
+from distributed_pytorch_tpu.native import kvstore_binary
+
+
+def _encode(s: str) -> str:
+    """Keys/values must be whitespace-free on the wire; percent-encode."""
+    return urllib.parse.quote(s, safe="")
+
+
+def _decode(s: str) -> str:
+    return urllib.parse.unquote(s)
+
+
+class KVStoreServer:
+    """Runs the native store binary as a child process and waits for readiness."""
+
+    def __init__(self, port: int, bind_addr: str = "0.0.0.0"):
+        self.port = port
+        self._proc = subprocess.Popen(
+            [kvstore_binary(), str(port), bind_addr],
+            stdout=subprocess.PIPE,
+            text=True,
+        )
+        line = self._proc.stdout.readline()
+        if "LISTENING" not in line:
+            raise RuntimeError(f"tpu_kvstore failed to start (got {line!r})")
+
+    def close(self) -> None:
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+                self._proc.wait()
+
+    def __enter__(self) -> "KVStoreServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class KVStoreClient:
+    """Blocking line-protocol client. One TCP connection per client; methods
+    are synchronous and return decoded values."""
+
+    def __init__(self, host: str, port: int, *, connect_timeout: float = 60.0):
+        deadline = time.monotonic() + connect_timeout
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                self._sock = socket.create_connection((host, port), timeout=5)
+                self._sock.settimeout(None)  # requests manage their own timeouts
+                self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._buf = b""
+                return
+            except OSError as e:  # server may not be up yet (agent races store)
+                last_err = e
+                time.sleep(0.1)
+        raise ConnectionError(f"cannot reach kvstore at {host}:{port}: {last_err}")
+
+    def _request(self, *tokens: str, timeout: Optional[float] = None) -> List[str]:
+        line = " ".join(tokens) + "\n"
+        self._sock.settimeout(timeout)
+        try:
+            self._sock.sendall(line.encode())
+            while b"\n" not in self._buf:
+                chunk = self._sock.recv(4096)
+                if not chunk:
+                    raise ConnectionError("kvstore connection closed")
+                self._buf += chunk
+        finally:
+            self._sock.settimeout(None)
+        raw, self._buf = self._buf.split(b"\n", 1)
+        parts = raw.decode().split(" ")
+        if parts[0] == "ERR":
+            raise RuntimeError(f"kvstore error: {' '.join(parts[1:])}")
+        return parts
+
+    def ping(self) -> bool:
+        return self._request("PING")[0] == "PONG"
+
+    def set(self, key: str, value: str) -> None:
+        self._request("SET", _encode(key), _encode(value))
+
+    def get(self, key: str) -> Optional[str]:
+        parts = self._request("GET", _encode(key))
+        return _decode(parts[1]) if parts[0] == "VAL" else None
+
+    def add(self, key: str, delta: int = 1) -> int:
+        return int(self._request("ADD", _encode(key), str(delta))[1])
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> Optional[str]:
+        """Block until ``key`` exists; None on timeout."""
+        args = ["WAIT", _encode(key)]
+        if timeout is not None:
+            args.append(str(int(timeout * 1000)))
+        parts = self._request(*args, timeout=None if timeout is None else timeout + 5)
+        return _decode(parts[1]) if parts[0] == "VAL" else None
+
+    def wait_ge(self, key: str, target: int, timeout: Optional[float] = None) -> Optional[int]:
+        """Block until int value of ``key`` >= target; None on timeout."""
+        args = ["WAITGE", _encode(key), str(target)]
+        if timeout is not None:
+            args.append(str(int(timeout * 1000)))
+        parts = self._request(*args, timeout=None if timeout is None else timeout + 5)
+        return int(parts[1]) if parts[0] == "VAL" else None
+
+    def delete(self, key: str) -> None:
+        self._request("DEL", _encode(key))
+
+    def keys(self, prefix: str = "") -> List[str]:
+        parts = self._request("KEYS", _encode(prefix)) if prefix else self._request("KEYS")
+        return [_decode(p) for p in parts[1:]]
+
+    def shutdown_server(self) -> None:
+        try:
+            self._request("SHUTDOWN")
+        except (ConnectionError, OSError):
+            pass  # server exiting mid-reply is fine
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "KVStoreClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,53 @@
+"""Native (C++) runtime components and their build glue.
+
+The reference inherits all of its native capability from the ``torch`` wheel
+(SURVEY.md §2a); this package is where our framework's own native runtime
+lives. Sources are compiled on first use with ``g++`` into ``_build/`` next to
+this file and cached by source mtime, so there is no separate install step
+(mirroring the zero-setup character of the reference scripts).
+
+Components:
+
+* ``kvstore.cpp``   -> ``tpu_kvstore`` binary — TCP rendezvous/KV store
+  (c10d TCPStore twin; reference ``slurm/sbatch_run.sh:21-22``).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_NATIVE_DIR, "_build")
+_BUILD_LOCK = threading.Lock()
+
+
+def _needs_rebuild(src: str, out: str) -> bool:
+    return not os.path.exists(out) or os.path.getmtime(out) < os.path.getmtime(src)
+
+
+def _compile(src_name: str, out_name: str, *, shared: bool) -> str:
+    """Compile ``src_name`` (in this dir) to ``_build/out_name`` if stale."""
+    src = os.path.join(_NATIVE_DIR, src_name)
+    out = os.path.join(_BUILD_DIR, out_name)
+    with _BUILD_LOCK:
+        if not _needs_rebuild(src, out):
+            return out
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        cmd = ["g++", "-O2", "-std=c++17", "-pthread"]
+        if shared:
+            cmd += ["-fPIC", "-shared"]
+        # Per-process temp name: the threading lock doesn't cover concurrent
+        # *processes* (two agents cold-starting on one machine), so each must
+        # link into its own file before the atomic rename.
+        tmp = f"{out}.tmp.{os.getpid()}"
+        cmd += [src, "-o", tmp]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, out)  # atomic: concurrent builders see old or new
+    return out
+
+
+def kvstore_binary() -> str:
+    """Path to the ``tpu_kvstore`` server binary (building it if needed)."""
+    return _compile("kvstore.cpp", "tpu_kvstore", shared=False)

@@ -1,0 +1,223 @@
+// tpu_kvstore — a tiny TCP key-value / rendezvous store.
+//
+// Native (C++) twin of the reference's c10d TCPStore rendezvous backend
+// (`--rdzv_backend c10d --rdzv_endpoint head:29500`, reference
+// slurm/sbatch_run.sh:21-22; MASTER_ADDR/PORT at multigpu.py:18-19): the
+// coordination primitive that the elastic agent (tpurun) uses to rendezvous
+// host agents, count joins, propagate failure generations, and barrier.
+//
+// Protocol: line-based over TCP, one request per line, space-separated tokens
+// (keys and values must not contain whitespace; the Python client
+// percent-encodes arbitrary strings).
+//
+//   PING                 -> PONG
+//   SET <key> <value>    -> OK                  (set + wake waiters)
+//   GET <key>            -> VAL <value> | NONE
+//   ADD <key> <delta>    -> VAL <int>           (atomic add, missing key = 0)
+//   WAIT <key> [ms]      -> VAL <value> | TIMEOUT   (block until key exists)
+//   WAITGE <key> <n> [ms]-> VAL <int> | TIMEOUT (block until int value >= n)
+//   DEL <key>            -> OK
+//   KEYS <prefix>        -> VAL <k1> <k2> ...   (snapshot; may be empty)
+//   SHUTDOWN             -> OK (then the server exits)
+//
+// Threading: one detached thread per connection; a single mutex +
+// condition_variable guards the map (coordination traffic is tiny — a few
+// messages per agent per rendezvous round — so contention is irrelevant).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+std::mutex g_mu;
+std::condition_variable g_cv;
+std::map<std::string, std::string> g_store;
+bool g_shutdown = false;
+int g_listen_fd = -1;
+
+std::string handle_command(const std::vector<std::string>& tok) {
+  if (tok.empty()) return "ERR empty";
+  const std::string& cmd = tok[0];
+
+  if (cmd == "PING") return "PONG";
+
+  if (cmd == "SET" && tok.size() == 3) {
+    std::lock_guard<std::mutex> lk(g_mu);
+    g_store[tok[1]] = tok[2];
+    g_cv.notify_all();
+    return "OK";
+  }
+
+  if (cmd == "GET" && tok.size() == 2) {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_store.find(tok[1]);
+    return it == g_store.end() ? "NONE" : "VAL " + it->second;
+  }
+
+  if (cmd == "ADD" && tok.size() == 3) {
+    long delta = strtol(tok[2].c_str(), nullptr, 10);
+    std::lock_guard<std::mutex> lk(g_mu);
+    long cur = 0;
+    auto it = g_store.find(tok[1]);
+    if (it != g_store.end()) cur = strtol(it->second.c_str(), nullptr, 10);
+    cur += delta;
+    g_store[tok[1]] = std::to_string(cur);
+    g_cv.notify_all();
+    return "VAL " + std::to_string(cur);
+  }
+
+  if (cmd == "WAIT" && (tok.size() == 2 || tok.size() == 3)) {
+    long timeout_ms = tok.size() == 3 ? strtol(tok[2].c_str(), nullptr, 10) : -1;
+    std::unique_lock<std::mutex> lk(g_mu);
+    auto pred = [&] { return g_shutdown || g_store.count(tok[1]) > 0; };
+    if (timeout_ms < 0) {
+      g_cv.wait(lk, pred);
+    } else if (!g_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred)) {
+      return "TIMEOUT";
+    }
+    auto it = g_store.find(tok[1]);
+    return it == g_store.end() ? "TIMEOUT" : "VAL " + it->second;
+  }
+
+  if (cmd == "WAITGE" && (tok.size() == 3 || tok.size() == 4)) {
+    long target = strtol(tok[2].c_str(), nullptr, 10);
+    long timeout_ms = tok.size() == 4 ? strtol(tok[3].c_str(), nullptr, 10) : -1;
+    std::unique_lock<std::mutex> lk(g_mu);
+    auto value = [&]() -> long {
+      auto it = g_store.find(tok[1]);
+      return it == g_store.end() ? 0 : strtol(it->second.c_str(), nullptr, 10);
+    };
+    auto pred = [&] { return g_shutdown || value() >= target; };
+    if (timeout_ms < 0) {
+      g_cv.wait(lk, pred);
+    } else if (!g_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred)) {
+      return "TIMEOUT";
+    }
+    if (value() < target) return "TIMEOUT";
+    return "VAL " + std::to_string(value());
+  }
+
+  if (cmd == "DEL" && tok.size() == 2) {
+    std::lock_guard<std::mutex> lk(g_mu);
+    g_store.erase(tok[1]);
+    return "OK";
+  }
+
+  if (cmd == "KEYS" && tok.size() <= 2) {
+    const std::string prefix = tok.size() == 2 ? tok[1] : "";
+    std::lock_guard<std::mutex> lk(g_mu);
+    std::string out = "VAL";
+    for (const auto& kv : g_store)
+      if (kv.first.rfind(prefix, 0) == 0) out += " " + kv.first;
+    return out;
+  }
+
+  if (cmd == "SHUTDOWN") {
+    {
+      std::lock_guard<std::mutex> lk(g_mu);
+      g_shutdown = true;
+      g_cv.notify_all();
+    }
+    // Wake the accept() loop so the process actually exits (a blocked accept
+    // would otherwise only notice g_shutdown at the next connection).
+    if (g_listen_fd >= 0) shutdown(g_listen_fd, SHUT_RDWR);
+    return "OK";
+  }
+
+  return "ERR bad-command";
+}
+
+void serve_connection(int fd) {
+  std::string buf;
+  char chunk[4096];
+  for (;;) {
+    // Process any complete lines already buffered.
+    size_t nl;
+    while ((nl = buf.find('\n')) != std::string::npos) {
+      std::string line = buf.substr(0, nl);
+      buf.erase(0, nl + 1);
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      std::istringstream iss(line);
+      std::vector<std::string> tok;
+      std::string t;
+      while (iss >> t) tok.push_back(t);
+      std::string resp = handle_command(tok) + "\n";
+      const char* p = resp.data();
+      size_t left = resp.size();
+      while (left > 0) {
+        ssize_t n = send(fd, p, left, MSG_NOSIGNAL);
+        if (n <= 0) { close(fd); return; }
+        p += n;
+        left -= static_cast<size_t>(n);
+      }
+      {
+        std::lock_guard<std::mutex> lk(g_mu);
+        if (g_shutdown) { close(fd); return; }
+      }
+    }
+    ssize_t n = recv(fd, chunk, sizeof(chunk), 0);
+    if (n <= 0) { close(fd); return; }
+    buf.append(chunk, static_cast<size_t>(n));
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <port> [bind_addr]\n", argv[0]);
+    return 2;
+  }
+  int port = atoi(argv[1]);
+  const char* bind_addr = argc > 2 ? argv[2] : "0.0.0.0";
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  if (srv < 0) { perror("socket"); return 1; }
+  g_listen_fd = srv;
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, bind_addr, &addr.sin_addr) != 1) {
+    fprintf(stderr, "bad bind addr %s\n", bind_addr);
+    return 2;
+  }
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(srv, 128) < 0) { perror("listen"); return 1; }
+  // Readiness line on stdout: the Python server wrapper waits for it.
+  printf("LISTENING %d\n", port);
+  fflush(stdout);
+
+  for (;;) {
+    int fd = accept(srv, nullptr, nullptr);
+    {
+      std::lock_guard<std::mutex> lk(g_mu);
+      if (g_shutdown) { if (fd >= 0) close(fd); break; }
+    }
+    if (fd < 0) continue;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread(serve_connection, fd).detach();
+  }
+  close(srv);
+  return 0;
+}

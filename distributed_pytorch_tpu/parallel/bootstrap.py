@@ -76,12 +76,19 @@ def setup_distributed(
 
 
 def _on_tpu_pod() -> bool:
-    """Heuristic for 'running as one worker of a multi-host TPU slice': the
-    Cloud TPU runtime exports worker topology env vars on every pod VM."""
-    return any(
-        key in os.environ
-        for key in ("TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID", "CLOUD_TPU_TASK_ID")
-    )
+    """Heuristic for 'running as one worker of a multi-HOST TPU slice': the
+    Cloud TPU runtime exports worker topology env vars on every pod VM.
+
+    A single-host slice also exports ``TPU_WORKER_HOSTNAMES`` (one entry), and
+    there ``jax.distributed.initialize()``'s autodetection is pointless — and
+    breaks off-cloud single-host rigs with no metadata server — so when the
+    hostname list is present it must name more than one worker. Runtimes that
+    export only a task/worker id (no hostname list) are trusted to be pods.
+    """
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES")
+    if hostnames is not None:
+        return len([h for h in hostnames.split(",") if h.strip()]) > 1
+    return any(k in os.environ for k in ("TPU_WORKER_ID", "CLOUD_TPU_TASK_ID"))
 
 
 def shutdown_distributed() -> None:

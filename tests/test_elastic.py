@@ -1,0 +1,231 @@
+"""Elastic launcher (tpurun) + native rendezvous store tests.
+
+Covers the torchrun-equivalent layer the reference outsources
+(SURVEY.md §3.3): env-var contract, rendezvous via the C++ TCP store,
+failure detection, and restart-the-world recovery with TPURUN_RESTART_COUNT.
+
+Workers here are tiny pure-Python scripts (no jax import) so the tests run in
+seconds; the full train-resume integration lives in
+``tests/test_integration_multiprocess.py``.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ----------------------------------------------------------------- KV store
+
+
+class TestKVStore:
+    @pytest.fixture()
+    def store(self):
+        from distributed_pytorch_tpu.elastic.store import KVStoreClient, KVStoreServer
+
+        port = free_port()
+        with KVStoreServer(port):
+            with KVStoreClient("127.0.0.1", port) as client:
+                yield client, port
+
+    def test_set_get_roundtrip_with_spaces(self, store):
+        client, _ = store
+        client.set("a/key", "value with spaces + specials%")
+        assert client.get("a/key") == "value with spaces + specials%"
+        assert client.get("missing") is None
+
+    def test_atomic_add(self, store):
+        client, _ = store
+        assert client.add("ctr", 2) == 2
+        assert client.add("ctr", 3) == 5
+
+    def test_wait_ge_blocks_until_target(self, store):
+        from distributed_pytorch_tpu.elastic.store import KVStoreClient
+
+        client, port = store
+        assert client.wait_ge("joined", 2, timeout=0.2) is None  # times out
+
+        def join_later():
+            time.sleep(0.2)
+            with KVStoreClient("127.0.0.1", port) as c2:
+                c2.add("joined", 1)
+                c2.add("joined", 1)
+
+        threading.Thread(target=join_later).start()
+        assert client.wait_ge("joined", 2, timeout=10) == 2
+
+    def test_concurrent_adds_from_many_clients(self, store):
+        """The rendezvous join-count must be exact under concurrency."""
+        from distributed_pytorch_tpu.elastic.store import KVStoreClient
+
+        client, port = store
+        n_clients, n_adds = 8, 25
+
+        def hammer():
+            with KVStoreClient("127.0.0.1", port) as c:
+                for _ in range(n_adds):
+                    c.add("hammer", 1)
+
+        threads = [threading.Thread(target=hammer) for _ in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert client.get("hammer") == str(n_clients * n_adds)
+
+    def test_keys_prefix(self, store):
+        client, _ = store
+        client.set("hb/0", "x")
+        client.set("hb/1", "y")
+        client.set("other", "z")
+        assert sorted(client.keys("hb/")) == ["hb/0", "hb/1"]
+
+
+# ----------------------------------------------------------------- agent
+
+
+def run_tpurun(tmp_path, worker_src: str, *args: str, timeout: float = 120):
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent(worker_src))
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return subprocess.run(
+        [sys.executable, "-m", "distributed_pytorch_tpu.elastic", *args, str(worker)],
+        env=env,
+        cwd=tmp_path,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+class TestElasticAgent:
+    def test_standalone_env_contract(self, tmp_path):
+        """Workers see the full torchrun-style env (SURVEY §2: ddp_setup env form)."""
+        result = run_tpurun(
+            tmp_path,
+            """
+            import os
+            pid = os.environ["PROCESS_ID"]
+            assert os.environ["NUM_PROCESSES"] == "3"
+            assert os.environ["LOCAL_RANK"] == pid  # single node: local == global
+            assert os.environ["TPURUN_RESTART_COUNT"] == "0"
+            assert ":" in os.environ["COORDINATOR_ADDRESS"]
+            open(f"saw.{pid}", "w").write("ok")
+            """,
+            "--standalone",
+            "--nproc-per-node",
+            "3",
+        )
+        assert result.returncode == 0, result.stderr
+        assert sorted(p.name for p in tmp_path.glob("saw.*")) == [
+            "saw.0",
+            "saw.1",
+            "saw.2",
+        ]
+
+    def test_restart_on_worker_failure(self, tmp_path):
+        """One worker fails at generation 0; the whole world restarts and
+        succeeds at generation 1 (torchrun restart-all semantics)."""
+        result = run_tpurun(
+            tmp_path,
+            """
+            import os, sys
+            restart = int(os.environ["TPURUN_RESTART_COUNT"])
+            pid = os.environ["PROCESS_ID"]
+            if restart == 0 and pid == "1":
+                sys.exit(7)
+            open(f"done.{pid}.{restart}", "w").write("ok")
+            """,
+            "--standalone",
+            "--nproc-per-node",
+            "2",
+            "--max-restarts",
+            "2",
+        )
+        assert result.returncode == 0, result.stderr
+        # Generation 1 ran both workers; worker 0's gen-0 file may or may not
+        # survive the kill, but both gen-1 files must exist.
+        names = {p.name for p in tmp_path.glob("done.*")}
+        assert {"done.0.1", "done.1.1"} <= names
+
+    def test_restarts_exhausted_is_fatal(self, tmp_path):
+        result = run_tpurun(
+            tmp_path,
+            """
+            import sys
+            sys.exit(3)  # always fails
+            """,
+            "--standalone",
+            "--nproc-per-node",
+            "1",
+            "--max-restarts",
+            "1",
+        )
+        assert result.returncode == 1
+        assert "giving up" in result.stderr
+
+    def test_two_node_rendezvous(self, tmp_path):
+        """Two agents on one machine = the sbatch_run.sh multinode shape."""
+        port = free_port()
+        worker = tmp_path / "worker.py"
+        worker.write_text(
+            textwrap.dedent(
+                """
+                import os
+                pid = os.environ["PROCESS_ID"]
+                assert os.environ["NUM_PROCESSES"] == "4"
+                open(f"n.{pid}", "w").write(os.environ["LOCAL_RANK"])
+                """
+            )
+        )
+        env = dict(os.environ, PYTHONPATH=REPO)
+
+        def launch(node_rank):
+            return subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "distributed_pytorch_tpu.elastic",
+                    "--nnodes",
+                    "2",
+                    "--node-rank",
+                    str(node_rank),
+                    "--nproc-per-node",
+                    "2",
+                    "--rdzv-endpoint",
+                    f"127.0.0.1:{port}",
+                    str(worker),
+                ],
+                env=env,
+                cwd=tmp_path,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+
+        agents = [launch(0), launch(1)]
+        for a in agents:
+            out, err = a.communicate(timeout=120)
+            assert a.returncode == 0, err
+        assert sorted(p.name for p in tmp_path.glob("n.*")) == [
+            "n.0",
+            "n.1",
+            "n.2",
+            "n.3",
+        ]
+        # LOCAL_RANK is per-node: global 0,1 -> node0 local 0,1; global 2,3 -> node1.
+        assert (tmp_path / "n.2").read_text() == "0"
+        assert (tmp_path / "n.3").read_text() == "1"

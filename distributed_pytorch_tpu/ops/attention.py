@@ -119,12 +119,17 @@ def ring_attention(
     axis_name: str = "sequence",
     causal: bool = False,
     batch_axis: Optional[str] = "data",
+    heads_axis: Optional[str] = "tensor",
 ) -> jnp.ndarray:
     """Sequence-parallel attention over globally-shaped arrays.
 
     Inputs are global ``[B, T, H, D]`` arrays whose sequence dim is (to be)
     sharded along ``axis_name``; the shard_map splits them, runs the ring, and
     reassembles. Degenerates to one dense block when the axis has size 1.
+
+    Under tensor parallelism the heads dim arrives sharded along
+    ``heads_axis``; the shard_map keeps it sharded (heads are independent in
+    attention), so SP x TP composes without gathering activations.
     """
     seq_size = mesh.shape.get(axis_name, 1)
     if seq_size == 1:
@@ -144,7 +149,16 @@ def ring_attention(
         )
         else None
     )
-    spec = P(batch_spec, axis_name, None, None)
+    heads_spec = (
+        heads_axis
+        if (
+            heads_axis
+            and heads_axis in mesh.shape
+            and q.shape[2] % mesh.shape[heads_axis] == 0
+        )
+        else None
+    )
+    spec = P(batch_spec, axis_name, heads_spec, None)
     body = functools.partial(
         _ring_attention_shard, axis_name=axis_name, causal=causal
     )

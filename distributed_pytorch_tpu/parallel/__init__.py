@@ -4,6 +4,14 @@ from distributed_pytorch_tpu.parallel.bootstrap import (
     shutdown_distributed,
 )
 from distributed_pytorch_tpu.parallel.mesh import make_mesh
+from distributed_pytorch_tpu.parallel.partitioning import (
+    TRANSFORMER_TP_RULES,
+    make_fsdp_specs,
+    make_param_specs,
+    make_state_shardings,
+    make_state_specs,
+    shard_train_state,
+)
 from distributed_pytorch_tpu.parallel.sharding import (
     batch_sharding,
     put_global_batch,
@@ -11,11 +19,17 @@ from distributed_pytorch_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "TRANSFORMER_TP_RULES",
     "batch_sharding",
     "is_main_process",
+    "make_fsdp_specs",
     "make_mesh",
+    "make_param_specs",
+    "make_state_shardings",
+    "make_state_specs",
     "put_global_batch",
     "replicated_sharding",
     "setup_distributed",
+    "shard_train_state",
     "shutdown_distributed",
 ]

@@ -1,0 +1,173 @@
+"""Parameter partitioning: path-pattern rules -> PartitionSpecs -> NamedShardings
+for the full TrainState (params, optimizer state, model state).
+
+No reference analog — the reference replicates every parameter on every rank
+(plain DDP, ``multigpu.py:36``; SURVEY.md §2b records TP/FSDP as absent). On
+TPU, parameter sharding is a *placement annotation*, not a code change: the
+jitted train step stays byte-identical, and XLA inserts the all-gathers /
+reduce-scatters implied by the shardings onto ICI. This module produces those
+annotations:
+
+* :func:`make_param_specs` — regex-on-parameter-path rules (megatron-style
+  tensor parallelism for the transformer family lives in
+  :data:`TRANSFORMER_TP_RULES`);
+* :func:`make_fsdp_specs` — ZeRO-3-style sharding: every parameter's largest
+  divisible dim is split over the ``fsdp`` axis;
+* :func:`make_state_specs` / :func:`make_state_shardings` — lift param specs
+  onto the whole TrainState. Optimizer-state subtrees that mirror the param
+  tree (optax ``trace``/``mu``/``nu``) inherit the param specs by structure
+  matching, so Adam moments are sharded exactly like their parameters.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.tree_util as jtu
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (path-regex, PartitionSpec) pairs, first match wins. Paths are
+# "/"-joined key paths, e.g. "block_0/attention/query/kernel".
+Rules = Sequence[Tuple[str, P]]
+
+#: Megatron-style tensor parallelism for :class:`TransformerLM`: QKV
+#: projections split the heads dim, the output projection splits its (heads)
+#: input dim — so attention needs no collective until the row-parallel ``out``
+#: matmul, where XLA inserts one all-reduce. Same column-then-row split for
+#: the MLP. The embedding table splits its feature (d_model) dim; the LM head
+#: splits its output (vocab) dim.
+TRANSFORMER_TP_RULES: Rules = (
+    (r".*/attention/(query|key|value)/kernel$", P(None, "tensor", None)),
+    (r".*/attention/(query|key|value)/bias$", P("tensor", None)),
+    (r".*/attention/out/kernel$", P("tensor", None, None)),
+    (r".*/mlp/up/kernel$", P(None, "tensor")),
+    (r".*/mlp/up/bias$", P("tensor")),
+    (r".*/mlp/down/kernel$", P("tensor", None)),
+    (r"^embed/embedding$", P(None, "tensor")),
+    (r"^lm_head/kernel$", P(None, "tensor")),
+    (r"^lm_head/bias$", P("tensor")),
+)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for entry in path:
+        if isinstance(entry, jtu.DictKey):
+            parts.append(str(entry.key))
+        elif isinstance(entry, jtu.SequenceKey):
+            parts.append(str(entry.idx))
+        else:  # GetAttrKey / FlattenedIndexKey
+            parts.append(str(getattr(entry, "name", entry)))
+    return "/".join(parts)
+
+
+def _check_divisible(path: str, shape, spec: P, mesh_shape) -> None:
+    for dim, axes in enumerate(spec):
+        if axes is None:
+            continue
+        for axis in (axes if isinstance(axes, tuple) else (axes,)):
+            if axis not in mesh_shape:
+                raise ValueError(
+                    f"param {path!r} spec {spec} references mesh axis "
+                    f"{axis!r}, absent from mesh axes {sorted(mesh_shape)}"
+                )
+            size = mesh_shape[axis]
+            if dim >= len(shape) or shape[dim] % size != 0:
+                raise ValueError(
+                    f"param {path!r} shape {tuple(shape)} dim {dim} is not "
+                    f"divisible by mesh axis {axis!r} (size {size})"
+                )
+
+
+def make_param_specs(params, rules: Rules, *, mesh: Optional[Mesh] = None):
+    """PartitionSpec pytree for ``params``: first rule whose regex matches the
+    "/"-joined param path wins; unmatched params are replicated (``P()``).
+
+    With ``mesh``, every matched spec is validated for divisibility up front —
+    a shape error here is far more readable than XLA's at compile time.
+    """
+    mesh_shape = dict(mesh.shape) if mesh is not None else None
+    compiled = [(re.compile(pattern), spec) for pattern, spec in rules]
+
+    def assign(path, leaf):
+        path_s = _path_str(path)
+        for pattern, spec in compiled:
+            if pattern.match(path_s):
+                if mesh_shape is not None:
+                    _check_divisible(path_s, leaf.shape, spec, mesh_shape)
+                return spec
+        return P()
+
+    return jtu.tree_map_with_path(assign, params)
+
+
+def make_fsdp_specs(params, *, mesh: Mesh, axis: str = "fsdp"):
+    """ZeRO-3-style specs: shard each parameter's largest ``axis``-divisible
+    dim; parameters with no divisible dim stay replicated. XLA turns this into
+    all-gather-before-use + reduce-scatter-of-grads (weight-update sharding),
+    the TPU analog of FSDP (SURVEY.md §2b)."""
+    size = mesh.shape[axis]
+
+    def assign(leaf):
+        shape = getattr(leaf, "shape", ())
+        best = max(
+            (d for d in range(len(shape)) if shape[d] % size == 0 and shape[d] >= size),
+            key=lambda d: shape[d],
+            default=None,
+        )
+        if best is None:
+            return P()
+        spec = [None] * len(shape)
+        spec[best] = axis
+        return P(*spec)
+
+    return jtu.tree_map(assign, params)
+
+
+def _replicated_like(tree):
+    return jtu.tree_map(lambda _: P(), tree)
+
+
+def _opt_state_specs(opt_state, params, param_specs):
+    """Map param specs onto optimizer state by structure matching: any subtree
+    of ``opt_state`` with the same treedef as ``params`` (optax momenta:
+    ``trace``, ``mu``, ``nu``) inherits ``param_specs``; everything else
+    (step counters, empty states) is replicated."""
+    params_treedef = jtu.tree_structure(params)
+
+    def is_param_like(subtree) -> bool:
+        return jtu.tree_structure(subtree) == params_treedef
+
+    return jtu.tree_map(
+        lambda sub: param_specs if is_param_like(sub) else _replicated_like(sub),
+        opt_state,
+        is_leaf=is_param_like,
+    )
+
+
+def make_state_specs(state, param_specs):
+    """Lift param specs to a TrainState-shaped PartitionSpec pytree."""
+    return type(state)(
+        params=param_specs,
+        model_state=_replicated_like(state.model_state),
+        opt_state=_opt_state_specs(state.opt_state, state.params, param_specs),
+        step=P(),
+    )
+
+
+def make_state_shardings(mesh: Mesh, state, param_specs):
+    """TrainState-shaped NamedSharding pytree — feed to ``jax.device_put`` (to
+    place/reshard a state) and to ``make_train_step(state_sharding=...)``."""
+    specs = make_state_specs(state, param_specs)
+    return jtu.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_train_state(state, shardings):
+    """Place (or reshard) a TrainState according to ``shardings``."""
+    return jax.device_put(state, shardings)

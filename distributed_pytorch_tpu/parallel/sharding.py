@@ -25,12 +25,14 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
-    """Shard dim 0 (batch) across ``axis``; later dims replicated."""
-    return NamedSharding(mesh, P(axis))
+def batch_sharding(mesh: Mesh, axis: str = "data", *, spec=None) -> NamedSharding:
+    """Shard dim 0 (batch) across ``axis``; later dims replicated. An explicit
+    ``spec`` overrides (e.g. ``P("data", "sequence")`` to co-shard tokens
+    along the ring-attention sequence axis)."""
+    return NamedSharding(mesh, spec if spec is not None else P(axis))
 
 
-def put_global_batch(mesh: Mesh, local_batch, axis: str = "data"):
+def put_global_batch(mesh: Mesh, local_batch, axis: str = "data", *, spec=None):
     """Turn this process's local numpy batch into a globally sharded jax.Array.
 
     Single-process: a straight ``device_put`` with batch sharding.
@@ -39,9 +41,11 @@ def put_global_batch(mesh: Mesh, local_batch, axis: str = "data"):
     part of the design with no reference analog (the closest is each DDP rank
     holding its own sampler shard, ``multigpu.py:78``).
 
-    ``local_batch`` may be a pytree (e.g. ``(inputs, targets)``).
+    ``local_batch`` may be a pytree (e.g. ``(inputs, targets)``). ``spec``
+    overrides the default dim-0 sharding (e.g. ``P("data", "sequence")`` to
+    co-shard the sequence dim for ring attention).
     """
-    sharding = batch_sharding(mesh, axis)
+    sharding = batch_sharding(mesh, axis, spec=spec)
     if jax.process_count() == 1:
         return jax.device_put(local_batch, sharding)
     return jax.tree_util.tree_map(

@@ -83,12 +83,22 @@ def make_train_step(
     *,
     mesh: Optional[Mesh] = None,
     data_axis: str = "data",
+    state_sharding: Optional[Any] = None,
+    batch_spec: Optional[Any] = None,
 ) -> Callable[[TrainState, Tuple], Tuple[TrainState, jnp.ndarray]]:
     """Build the jitted ``(state, (inputs, targets)) -> (state', loss)`` step.
 
     With ``mesh``, inputs/targets are expected sharded along ``data_axis`` and
     state replicated; XLA inserts the gradient all-reduce. Without a mesh it is
     the serial rung (``single_gpu.py`` twin) — same code, no collectives.
+
+    ``state_sharding`` (a TrainState-shaped NamedSharding pytree from
+    :func:`..parallel.partitioning.make_state_shardings`) switches the state
+    from replicated to sharded — tensor-parallel / FSDP placement with the
+    SAME step function; only the annotations change and XLA re-plans the
+    collectives. ``batch_spec`` (a ``PartitionSpec``) overrides the default
+    dim-0-only batch sharding — e.g. ``P("data", "sequence")`` to co-shard
+    tokens along the ring-attention sequence axis.
 
     ``donate_argnums=(0,)`` lets XLA reuse the old state's buffers for the new
     state (in-place update semantics, halving peak parameter memory).
@@ -122,13 +132,19 @@ def make_train_step(
         return new_state, loss
 
     if mesh is None:
+        if state_sharding is not None or batch_spec is not None:
+            raise ValueError(
+                "state_sharding/batch_spec require mesh=; without one the step "
+                "would silently run unsharded"
+            )
         return jax.jit(step, donate_argnums=(0,))
 
     replicated = replicated_sharding(mesh)
-    sharded_batch = batch_sharding(mesh, data_axis)
+    state_sh = state_sharding if state_sharding is not None else replicated
+    sharded_batch = batch_sharding(mesh, data_axis, spec=batch_spec)
     return jax.jit(
         step,
-        in_shardings=(replicated, (sharded_batch, sharded_batch)),
-        out_shardings=(replicated, replicated),
+        in_shardings=(state_sh, (sharded_batch, sharded_batch)),
+        out_shardings=(state_sh, replicated),
         donate_argnums=(0,),
     )

@@ -1,0 +1,157 @@
+"""Tensor-parallel / FSDP partitioning tests on the 8-device CPU mesh.
+
+Verifies that sharded-state training (a) places parameters and Adam moments
+according to the rules, and (b) produces the SAME numbers as replicated
+data-parallel training — the sharding is a placement annotation, not a
+semantic change (SURVEY.md §2b: TP/FSDP are beyond-parity capabilities).
+"""
+
+import jax
+import jax.tree_util as jtu
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_pytorch_tpu.models import TransformerLM
+from distributed_pytorch_tpu.parallel.mesh import make_mesh
+from distributed_pytorch_tpu.parallel.partitioning import (
+    TRANSFORMER_TP_RULES,
+    make_fsdp_specs,
+    make_param_specs,
+    make_state_shardings,
+    make_state_specs,
+    shard_train_state,
+)
+from distributed_pytorch_tpu.parallel.sharding import (
+    put_global_batch,
+    replicated_sharding,
+)
+from distributed_pytorch_tpu.training.losses import softmax_cross_entropy_loss
+from distributed_pytorch_tpu.training.train_step import (
+    create_train_state,
+    make_train_step,
+)
+
+
+def tiny_lm(**kw):
+    return TransformerLM(
+        vocab_size=64, d_model=16, n_layers=2, n_heads=4, d_ff=32, **kw
+    )
+
+
+def make_batch(dp=1):
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(0, 64, (4 * dp, 17), dtype=np.int32)
+    return tokens[:, :-1], tokens[:, 1:]
+
+
+def test_tp_rules_assign_expected_specs():
+    model = tiny_lm()
+    inputs, _ = make_batch()
+    state = create_train_state(model, optax.adam(1e-3), inputs)
+    specs = make_param_specs(state.params, TRANSFORMER_TP_RULES)
+    flat = {path: spec for path, spec in jtu.tree_flatten_with_path(specs)[0]}
+
+    def spec_of(path_suffix):
+        for path, spec in flat.items():
+            joined = "/".join(str(getattr(e, "key", e)) for e in path)
+            if joined.endswith(path_suffix):
+                return spec
+        raise KeyError(path_suffix)
+
+    assert spec_of("block_0/attention/query/kernel") == P(None, "tensor", None)
+    assert spec_of("block_0/attention/out/kernel") == P("tensor", None, None)
+    assert spec_of("block_1/mlp/up/kernel") == P(None, "tensor")
+    assert spec_of("block_1/mlp/down/kernel") == P("tensor", None)
+    assert spec_of("embed/embedding") == P(None, "tensor")
+    assert spec_of("lm_head/kernel") == P(None, "tensor")
+    # LayerNorm scales replicate.
+    assert spec_of("ln_final/scale") == P()
+
+
+def test_divisibility_validation_raises():
+    mesh = make_mesh({"data": 1, "tensor": 8})
+    model = tiny_lm()  # n_heads=4 < tensor=8 -> QKV heads dim not divisible
+    inputs, _ = make_batch()
+    state = create_train_state(model, optax.adam(1e-3), inputs)
+    with pytest.raises(ValueError, match="not.*divisible|divisible"):
+        make_param_specs(state.params, TRANSFORMER_TP_RULES, mesh=mesh)
+
+
+def test_adam_moments_shard_like_params():
+    mesh = make_mesh({"data": 2, "tensor": 4})
+    model = tiny_lm()
+    inputs, _ = make_batch(dp=2)
+    state = create_train_state(model, optax.adam(1e-3), inputs)
+    specs = make_param_specs(state.params, TRANSFORMER_TP_RULES, mesh=mesh)
+    state_specs = make_state_specs(state, specs)
+    # ScaleByAdamState(count, mu, nu): mu/nu mirror the param tree.
+    adam = state_specs.opt_state[0]
+    assert jtu.tree_structure(adam.mu) == jtu.tree_structure(specs)
+    leaves_mu = jtu.tree_leaves(adam.mu, is_leaf=lambda x: isinstance(x, P))
+    leaves_p = jtu.tree_leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    assert leaves_mu == leaves_p
+    assert adam.count == P()
+
+
+@pytest.mark.parametrize("mode", ["tp", "fsdp"])
+def test_sharded_training_matches_replicated(mode):
+    """DP+TP (and DP+FSDP) training must be numerically equivalent to pure-DP
+    replicated training: shardings change placement, not math."""
+    model = tiny_lm()
+    inputs, targets = make_batch(dp=2)
+    optimizer = optax.adam(1e-2)
+
+    # Replicated DP reference run.
+    mesh_dp = make_mesh({"data": 2}, devices=jax.devices()[:2])
+    state = create_train_state(model, optimizer, inputs, rng_seed=3)
+    state_dp = shard_train_state(state, replicated_sharding(mesh_dp))
+    step_dp = make_train_step(
+        model.apply, optimizer, softmax_cross_entropy_loss, mesh=mesh_dp
+    )
+    losses_dp = []
+    batch = put_global_batch(mesh_dp, (inputs, targets))
+    for _ in range(3):
+        state_dp, loss = step_dp(state_dp, batch)
+        losses_dp.append(float(loss))
+
+    # Sharded run on a 2x4 mesh.
+    axis = "tensor" if mode == "tp" else "fsdp"
+    mesh = make_mesh({"data": 2, axis: 4})
+    state2 = create_train_state(model, optimizer, inputs, rng_seed=3)
+    if mode == "tp":
+        specs = make_param_specs(state2.params, TRANSFORMER_TP_RULES, mesh=mesh)
+    else:
+        specs = make_fsdp_specs(state2.params, mesh=mesh)
+        assert any(
+            spec != P()
+            for spec in jtu.tree_leaves(specs, is_leaf=lambda x: isinstance(x, P))
+        )
+    shardings = make_state_shardings(mesh, state2, specs)
+    state2 = shard_train_state(state2, shardings)
+    step = make_train_step(
+        model.apply,
+        optimizer,
+        softmax_cross_entropy_loss,
+        mesh=mesh,
+        state_sharding=shardings,
+    )
+    batch2 = put_global_batch(mesh, (inputs, targets))
+    losses = []
+    for _ in range(3):
+        state2, loss = step(state2, batch2)
+        losses.append(float(loss))
+
+    np.testing.assert_allclose(losses, losses_dp, rtol=2e-4)
+    # Spot-check a parameter is actually sharded on device.
+    sharded_leaves = [
+        leaf
+        for leaf, spec in zip(
+            jtu.tree_leaves(state2.params),
+            jtu.tree_leaves(specs, is_leaf=lambda x: isinstance(x, P)),
+        )
+        if spec != P()
+    ]
+    assert sharded_leaves
+    assert not sharded_leaves[0].sharding.is_fully_replicated

@@ -6,12 +6,17 @@ from distributed_pytorch_tpu.models.resnet import (
     ResNet50,
     ResNet101,
 )
+from distributed_pytorch_tpu.models.moe import MOE_EP_RULES, MoEMLP
+from distributed_pytorch_tpu.models.pipeline_lm import PipelinedTransformerLM
 from distributed_pytorch_tpu.models.toy import ToyRegressor
 from distributed_pytorch_tpu.models.transformer import TransformerLM
 from distributed_pytorch_tpu.models.vit import ViT, ViT_L32
 
 __all__ = [
     "MLP",
+    "MOE_EP_RULES",
+    "MoEMLP",
+    "PipelinedTransformerLM",
     "ResNet",
     "ResNet18",
     "ResNet34",

@@ -1,0 +1,141 @@
+"""Mixture-of-Experts MLP with expert parallelism over the mesh's ``expert``
+axis.
+
+No reference analog (SURVEY.md §2b: EP absent from the reference) — this is a
+beyond-parity capability, built the TPU way:
+
+* **Routing** is Switch-Transformer-style deterministic top-1: a per-token
+  router picks one expert; each expert processes at most
+  ``capacity = ceil(tokens_per_group * capacity_factor / n_experts)`` tokens
+  per group (group = one batch row); overflow tokens fall through the residual
+  connection (their MoE output is zero).
+* **Dispatch/combine are einsums** against a one-hot ``[B, T, E, C]`` tensor —
+  dense, static-shaped, MXU-friendly; no gather/scatter, no dynamic shapes,
+  exactly what XLA tiles well.
+* **Expert parallelism is a sharding annotation**: the stacked expert kernels
+  ``[E, d_model, d_ff]`` carry ``P("expert", ...)`` specs
+  (:data:`MOE_EP_RULES`), and the dispatched activations ``[E, B, C, M]`` are
+  constrained to ``P("expert", "data")`` — XLA inserts the token all-to-all
+  (data-sharded tokens -> expert-sharded slots) and back, riding ICI, the
+  same role NCCL all-to-all plays in GPU MoE stacks.
+* The **load-balance auxiliary loss** (mean expert load x mean router prob,
+  scaled by ``aux_weight``) is sown into the ``"losses"`` collection; the
+  train step adds every term in that collection to the task loss.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_pytorch_tpu.parallel.partitioning import Rules
+
+#: Expert-parallel specs for :class:`MoEMLP` params (stacked over dim 0 = E).
+#: Compose with ``TRANSFORMER_TP_RULES`` for the dense layers: EP rules first,
+#: first match wins.
+MOE_EP_RULES: Rules = (
+    (r".*/moe/up_kernel$", P("expert", None, None)),
+    (r".*/moe/up_bias$", P("expert", None)),
+    (r".*/moe/down_kernel$", P("expert", None, None)),
+    (r".*/moe/down_bias$", P("expert", None)),
+    (r".*/moe/router/kernel$", P()),
+    (r".*/moe/router/bias$", P()),
+)
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for the dense transformer MLP block.
+
+    ``[B, T, d_model] -> [B, T, d_model]`` with top-1 routing over
+    ``n_experts`` expert MLPs of width ``d_ff``.
+    """
+
+    n_experts: int
+    d_ff: int
+    d_model: int
+    dtype: Any = jnp.float32
+    capacity_factor: float = 1.25
+    aux_weight: float = 1e-2
+    mesh: Optional[Mesh] = None
+    expert_axis: Optional[str] = "expert"
+    data_axis: Optional[str] = "data"
+
+    def _constrain(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Pin dispatched activations [E, B, C, ...] to expert x data sharding
+        so XLA materializes the all-to-all at this seam."""
+        if self.mesh is None:
+            return x
+        e_ax = self.expert_axis if self.expert_axis in self.mesh.shape else None
+        d_ax = self.data_axis if self.data_axis in self.mesh.shape else None
+        if e_ax is None and d_ax is None:
+            return x
+        spec = P(e_ax, d_ax, *([None] * (x.ndim - 2)))
+        return jax.lax.with_sharding_constraint(x, NamedSharding(self.mesh, spec))
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        n_batch, n_tokens, d_model = x.shape
+        n_exp = self.n_experts
+        capacity = max(1, math.ceil(n_tokens * self.capacity_factor / n_exp))
+
+        # --- route: deterministic top-1 per token ------------------------
+        router_logits = nn.Dense(n_exp, dtype=jnp.float32, name="router")(
+            x.astype(jnp.float32)
+        )
+        probs = jax.nn.softmax(router_logits, axis=-1)  # [B, T, E]
+        expert_index = jnp.argmax(probs, axis=-1)  # [B, T]
+        onehot = jax.nn.one_hot(expert_index, n_exp, dtype=jnp.float32)
+
+        # Load-balance aux loss (Switch eq. 4): E * mean_load . mean_prob.
+        load = jnp.mean(onehot, axis=(0, 1))  # fraction routed per expert
+        importance = jnp.mean(probs, axis=(0, 1))  # mean router prob
+        aux = n_exp * jnp.sum(load * importance)
+        self.sow("losses", "moe_aux", self.aux_weight * aux)
+
+        # Position of each token within its expert's capacity (1-based).
+        position = jnp.cumsum(onehot, axis=1) * onehot  # [B, T, E]
+        keep = (position > 0) & (position <= capacity)
+        dispatch = jnp.where(keep, 1.0, 0.0)  # [B, T, E]
+        # [B, T, E, C] one-hot over capacity slots.
+        # position is 0 for unrouted (token, expert) pairs -> index -1 -> all-
+        # zero one-hot row, which is exactly the "no slot" encoding we want.
+        slot = jax.nn.one_hot(
+            position.astype(jnp.int32) - 1, capacity, dtype=jnp.float32
+        )
+        dispatch_t = slot * dispatch[..., None]
+        gate = jnp.sum(probs * dispatch, axis=-1, keepdims=True)  # chosen prob
+        combine_t = dispatch_t * gate[..., None]
+
+        # --- dispatch -> experts -> combine ------------------------------
+        w_up = self.param(
+            "up_kernel",
+            nn.initializers.lecun_normal(),
+            (n_exp, d_model, self.d_ff),
+        )
+        b_up = self.param("up_bias", nn.initializers.zeros, (n_exp, self.d_ff))
+        w_down = self.param(
+            "down_kernel",
+            nn.initializers.lecun_normal(),
+            (n_exp, self.d_ff, d_model),
+        )
+        b_down = self.param("down_bias", nn.initializers.zeros, (n_exp, d_model))
+
+        compute = self.dtype
+        # Tokens -> expert slots: the EP all-to-all happens here.
+        expert_in = jnp.einsum(
+            "btec,btm->ebcm", dispatch_t.astype(compute), x.astype(compute)
+        )
+        expert_in = self._constrain(expert_in)
+        h = jnp.einsum("ebcm,emf->ebcf", expert_in, w_up.astype(compute))
+        h = nn.gelu(h + b_up[:, None, None, :].astype(compute))
+        out = jnp.einsum("ebcf,efm->ebcm", h, w_down.astype(compute))
+        out = out + b_down[:, None, None, :].astype(compute)
+        out = self._constrain(out)
+        # Expert slots -> tokens: the reverse all-to-all.
+        y = jnp.einsum("btec,ebcm->btm", combine_t.astype(compute), out)
+        return y.astype(x.dtype)

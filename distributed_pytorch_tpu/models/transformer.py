@@ -22,6 +22,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
+from distributed_pytorch_tpu.models.moe import MoEMLP
 from distributed_pytorch_tpu.ops.attention import (
     dot_product_attention,
     ring_attention,
@@ -100,6 +101,7 @@ class TransformerBlock(nn.Module):
     causal: bool = True
     mesh: Optional[Mesh] = None
     sequence_axis: Optional[str] = None
+    n_experts: int = 0  # >0 swaps the dense MLP for an expert-parallel MoEMLP
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -107,9 +109,14 @@ class TransformerBlock(nn.Module):
             self.n_heads, self.d_model, self.dtype, self.causal,
             self.mesh, self.sequence_axis, name="attention",
         )(nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x))
-        x = x + MLPBlock(self.d_ff, self.d_model, self.dtype, name="mlp")(
-            nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
-        )
+        if self.n_experts > 0:
+            mlp = MoEMLP(
+                self.n_experts, self.d_ff, self.d_model, self.dtype,
+                mesh=self.mesh, name="moe",
+            )
+        else:
+            mlp = MLPBlock(self.d_ff, self.d_model, self.dtype, name="mlp")
+        x = x + mlp(nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x))
         return x
 
 
@@ -125,6 +132,8 @@ class TransformerLM(nn.Module):
     remat: bool = False
     mesh: Optional[Mesh] = None
     sequence_axis: Optional[str] = None
+    n_experts: int = 0  # >0: MoE MLPs in every `moe_every`-th block
+    moe_every: int = 2
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
@@ -135,9 +144,11 @@ class TransformerLM(nn.Module):
         if self.remat:
             block = nn.remat(TransformerBlock)
         for i in range(self.n_layers):
+            # GShard-style interleaving: every `moe_every`-th block is MoE.
+            moe = self.n_experts if (i + 1) % self.moe_every == 0 else 0
             x = block(
                 self.n_heads, self.d_model, self.d_ff, self.dtype,
-                True, self.mesh, self.sequence_axis, name=f"block_{i}",
+                True, self.mesh, self.sequence_axis, moe, name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         # Logits in float32 for a numerically stable softmax-cross-entropy.

@@ -12,6 +12,10 @@ from distributed_pytorch_tpu.parallel.partitioning import (
     make_state_specs,
     shard_train_state,
 )
+from distributed_pytorch_tpu.parallel.pipeline import (
+    PIPELINE_STAGE_RULES,
+    pipeline_apply,
+)
 from distributed_pytorch_tpu.parallel.sharding import (
     batch_sharding,
     put_global_batch,
@@ -19,7 +23,9 @@ from distributed_pytorch_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "PIPELINE_STAGE_RULES",
     "TRANSFORMER_TP_RULES",
+    "pipeline_apply",
     "batch_sharding",
     "is_main_process",
     "make_fsdp_specs",

@@ -67,6 +67,10 @@ def create_train_state(
     sample = jnp.asarray(sample_input)[:1]
     variables = dict(jax.jit(model.init)(rng, sample))
     params = variables.pop("params")
+    # "losses" holds per-apply sown penalty terms (e.g. MoE load-balance
+    # loss), not persistent state — it must not ride in TrainState or flax's
+    # sow would append to it every step and change the pytree structure.
+    variables.pop("losses", None)
     opt_state = optimizer.init(params)
     return TrainState(
         params=params,
@@ -110,13 +114,17 @@ def make_train_step(
 
         def batch_loss(params):
             variables = {"params": params, **state.model_state}
-            if mutable:
-                predictions, new_model_state = apply_fn(
-                    variables, inputs, mutable=mutable
-                )
-            else:
-                predictions, new_model_state = apply_fn(variables, inputs), {}
-            return loss_fn(predictions, targets), new_model_state
+            # "losses" is always mutable so sown penalty terms surface here;
+            # it is popped before the aux state re-enters TrainState (it is
+            # per-apply, not persistent — see create_train_state).
+            predictions, new_model_state = apply_fn(
+                variables, inputs, mutable=mutable + ["losses"]
+            )
+            new_model_state = dict(new_model_state)
+            loss = loss_fn(predictions, targets)
+            for term in jax.tree_util.tree_leaves(new_model_state.pop("losses", {})):
+                loss = loss + jnp.sum(term)
+            return loss, new_model_state
 
         (loss, new_model_state), grads = jax.value_and_grad(
             batch_loss, has_aux=True

@@ -1,0 +1,138 @@
+"""Pipeline-parallelism tests on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_pytorch_tpu.models.pipeline_lm import PipelinedTransformerLM
+from distributed_pytorch_tpu.parallel.mesh import make_mesh
+from distributed_pytorch_tpu.parallel.partitioning import (
+    make_param_specs,
+    make_state_shardings,
+    shard_train_state,
+)
+from distributed_pytorch_tpu.parallel.pipeline import (
+    PIPELINE_STAGE_RULES,
+    pipeline_apply,
+)
+from distributed_pytorch_tpu.parallel.sharding import put_global_batch
+from distributed_pytorch_tpu.training.losses import softmax_cross_entropy_loss
+from distributed_pytorch_tpu.training.train_step import (
+    create_train_state,
+    make_train_step,
+)
+
+
+def test_pipeline_apply_matches_serial_chain():
+    """Pipelined execution == sequentially applying the stages."""
+    mesh = make_mesh({"stage": 4}, devices=jax.devices()[:4])
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((4, 8, 8)) * 0.3, jnp.float32)
+    b = jnp.asarray(rng.standard_normal((4, 8)) * 0.1, jnp.float32)
+    x = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+
+    def stage_fn(params, xin):
+        return jnp.tanh(xin @ params["w"] + params["b"])
+
+    out = pipeline_apply(
+        stage_fn, {"w": w, "b": b}, x,
+        mesh=mesh, num_microbatches=4, data_axis=None,
+    )
+    expected = x
+    for s in range(4):
+        expected = stage_fn({"w": w[s], "b": b[s]}, expected)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-5)
+
+
+def test_pipeline_apply_grads_match_serial():
+    """Gradients flow back through the ppermute ring and match the serial
+    chain's gradients."""
+    mesh = make_mesh({"stage": 4}, devices=jax.devices()[:4])
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.standard_normal((4, 6, 6)) * 0.3, jnp.float32)
+    x = jnp.asarray(rng.standard_normal((8, 6)), jnp.float32)
+
+    def stage_fn(params, xin):
+        return jnp.tanh(xin @ params)
+
+    def piped_loss(w):
+        return jnp.sum(
+            pipeline_apply(
+                stage_fn, w, x, mesh=mesh, num_microbatches=2, data_axis=None
+            )
+            ** 2
+        )
+
+    def serial_loss(w):
+        h = x
+        for s in range(4):
+            h = stage_fn(w[s], h)
+        return jnp.sum(h**2)
+
+    g_piped = jax.grad(piped_loss)(w)
+    g_serial = jax.grad(serial_loss)(w)
+    np.testing.assert_allclose(np.asarray(g_piped), np.asarray(g_serial), atol=1e-4)
+
+
+def test_pipelined_lm_matches_serial_fallback():
+    """The same params give the same logits with the pipeline on a stage mesh
+    vs the serial chain fallback (mesh=None)."""
+    rng = np.random.default_rng(2)
+    tokens = rng.integers(0, 32, (8, 12), dtype=np.int32)
+    kw = dict(
+        vocab_size=32, d_model=16, n_stages=4, layers_per_stage=1,
+        n_heads=2, d_ff=32, num_microbatches=2,
+    )
+    serial = PipelinedTransformerLM(**kw)
+    variables = serial.init(jax.random.PRNGKey(0), tokens)
+    logits_serial = serial.apply(variables, tokens)
+
+    mesh = make_mesh({"data": 2, "stage": 4})
+    piped = PipelinedTransformerLM(**kw, mesh=mesh)
+    logits_piped = jax.jit(piped.apply)(variables, jnp.asarray(tokens))
+    np.testing.assert_allclose(
+        np.asarray(logits_piped), np.asarray(logits_serial), atol=2e-4
+    )
+
+
+def test_pp_training_loss_decreases_with_sharded_stages():
+    """Full DP x PP train loop: stage params sharded P('stage'), loss falls."""
+    mesh = make_mesh({"data": 2, "stage": 4})
+    model = PipelinedTransformerLM(
+        vocab_size=32, d_model=16, n_stages=4, layers_per_stage=1,
+        n_heads=2, d_ff=32, num_microbatches=2, mesh=mesh,
+    )
+    rng = np.random.default_rng(3)
+    tokens = rng.integers(0, 32, (8, 13), dtype=np.int32)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    optimizer = optax.adam(1e-2)
+    state = create_train_state(model, optimizer, inputs)
+    specs = make_param_specs(state.params, PIPELINE_STAGE_RULES, mesh=mesh)
+    stage_leaves = [
+        s
+        for path, s in jtu.tree_leaves_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )
+        if "stages" in str(path)
+    ]
+    assert stage_leaves and all(s == P("stage") for s in stage_leaves)
+    shardings = make_state_shardings(mesh, state, specs)
+    state = shard_train_state(state, shardings)
+    step = make_train_step(
+        model.apply, optimizer, softmax_cross_entropy_loss,
+        mesh=mesh, state_sharding=shardings,
+    )
+    batch = put_global_batch(mesh, (inputs, targets))
+    losses = []
+    for _ in range(8):
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # Stage params are physically distributed.
+    stacked = state.params["stages"]
+    leaf = jtu.tree_leaves(stacked)[0]
+    assert not leaf.sharding.is_fully_replicated

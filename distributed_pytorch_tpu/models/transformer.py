@@ -23,10 +23,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from distributed_pytorch_tpu.models.moe import MoEMLP
-from distributed_pytorch_tpu.ops.attention import (
-    dot_product_attention,
-    ring_attention,
-)
+from distributed_pytorch_tpu.ops.attention import ring_attention
+from distributed_pytorch_tpu.ops.flash_attention import flash_attention
 
 
 def apply_rope(x: jnp.ndarray, *, theta: float = 10000.0) -> jnp.ndarray:
@@ -45,7 +43,13 @@ def apply_rope(x: jnp.ndarray, *, theta: float = 10000.0) -> jnp.ndarray:
 
 
 class Attention(nn.Module):
-    """Multi-head attention with RoPE and a pluggable core."""
+    """Multi-head attention with RoPE and a pluggable core.
+
+    Core selection: ring attention when the mesh has a non-trivial sequence
+    axis (cross-chip long context); otherwise the Pallas flash-attention
+    kernel on TPU (which itself falls back to the dense XLA path on other
+    backends or non-tiling shapes).
+    """
 
     n_heads: int
     d_model: int
@@ -75,7 +79,9 @@ class Attention(nn.Module):
                 causal=self.causal,
             )
         else:
-            out = dot_product_attention(q, k, v, causal=self.causal)
+            out = flash_attention(
+                q, k, v, causal=self.causal, mesh=self.mesh
+            )
         return nn.DenseGeneral(
             self.d_model, axis=(-2, -1), dtype=self.dtype, name="out"
         )(out)

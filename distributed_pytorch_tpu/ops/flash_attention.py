@@ -1,0 +1,369 @@
+"""Pallas flash attention for TPU: tiled online-softmax attention that never
+materializes the ``[T, T]`` score matrix in HBM.
+
+No reference analog (the reference's only attention is torchvision ViT's,
+``multigpu_profile.py:24``); this is the single-chip hot-op complement to
+:func:`.attention.ring_attention` (which handles the cross-chip sequence
+dimension — each ring hop's local block can use this kernel's math).
+
+Design (FlashAttention-2 style, built per the Pallas TPU playbook):
+
+* forward: grid over ``(batch*heads, Tq/block_q)``; each program streams K/V
+  ``block_k`` tiles from VMEM, maintaining the online-softmax running max
+  ``m``, denominator ``l``, and accumulator ``o`` in fp32 registers; writes
+  the normalized output plus the logsumexp row stats for the backward pass.
+* backward: the standard two-kernel split — one grid over Q tiles producing
+  ``dQ``, one over K/V tiles producing ``dK``/``dV`` — each recomputing
+  probabilities from the saved logsumexp (no stored score matrix), with
+  ``delta = rowsum(dO * O)`` precomputed outside.
+* all matmuls run on the MXU with ``preferred_element_type=float32``;
+  bfloat16 inputs are upcast per tile.
+
+``interpret=True`` runs the same kernels on CPU for tests; on non-TPU
+backends without interpret, :func:`flash_attention` falls back to the dense
+XLA path automatically, as it does for shapes the tiling cannot cover.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from distributed_pytorch_tpu.ops.attention import dot_product_attention
+
+NEG_INF = -1e30
+
+
+def _causal_mask(s, q_start, k_start):
+    bq, bk = s.shape
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, scale, causal):
+    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+    block_q, d = q.shape
+    seq_k = k_ref.shape[1]
+    q_start = pl.program_id(1) * block_q
+
+    def body(j, carry):
+        m, l, o = carry
+        k_start = j * block_k
+        k_blk = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [block_q, block_k]
+        if causal:
+            s = _causal_mask(s, q_start, k_start)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        o_new = o * correction[:, None] + pv
+        return m_new, l_new, o_new
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+    n_blocks = seq_k // block_k
+    if causal:
+        # Blocks entirely above the diagonal contribute nothing — skip them.
+        # (fori_loop accepts a traced bound, so this is per-program.)
+        n_blocks = jnp.minimum(
+            n_blocks, pl.cdiv(q_start + block_q, block_k)
+        )
+    m, l, o = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, o0))
+    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+    # lse rides as [BH, T, 1]: stats live in the sublane dim (lane dim 1), so
+    # per-tile blocks and multiple-of-8 dynamic offsets stay Mosaic-legal for
+    # any block size — lane-dim offsets would need 128 alignment.
+    lse_ref[0, :, 0] = m + jnp.log(l)
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k, scale, causal
+):
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    block_q, d = q.shape
+    seq_k = k_ref.shape[1]
+    q_start = pl.program_id(1) * block_q
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+
+    def body(j, dq):
+        k_start = j * block_k
+        k_blk = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        if causal:
+            s = _causal_mask(s, q_start, k_start)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jnp.zeros((block_q, d), jnp.float32)
+    n_blocks = seq_k // block_k
+    if causal:
+        n_blocks = jnp.minimum(n_blocks, pl.cdiv(q_start + block_q, block_k))
+    dq = jax.lax.fori_loop(0, n_blocks, body, dq)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, block_q, scale, causal,
+):
+    k = k_ref[0].astype(jnp.float32)  # [block_k, D]
+    v = v_ref[0].astype(jnp.float32)
+    block_k, d = k.shape
+    seq_q = q_ref.shape[1]
+    k_start = pl.program_id(1) * block_k
+
+    def body(i, carry):
+        dk, dv = carry
+        q_start = i * block_q
+        q_blk = q_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(q_start, block_q), 0]
+        delta_blk = delta_ref[0, pl.ds(q_start, block_q), 0]
+        s = (
+            jax.lax.dot_general(
+                q_blk, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [block_q, block_k]
+        if causal:
+            s = _causal_mask(s, q_start, k_start)
+        p = jnp.exp(s - lse_blk[:, None])
+        dv_new = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_blk[:, None]) * scale
+        dk_new = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    start = k_start // block_q if causal else 0
+    dk, dv = jax.lax.fori_loop(start, seq_q // block_q, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _row_spec(block, d):
+    return pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM)
+
+
+def _full_spec(t, d):
+    return pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM)
+
+
+def _vec_spec(block):
+    # Row stats ride as [BH, T, 1] (stats along sublanes, trivial lane dim):
+    # block (1, block, 1) is legal for any multiple-of-8 block because the
+    # lane dim equals the full array dim.
+    return pl.BlockSpec((1, block, 1), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM)
+
+
+def _full_vec_spec(t):
+    return pl.BlockSpec((1, t, 1), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    bh, seq, d = q.shape
+    grid = (bh, seq // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, block_k=block_k, scale=d**-0.5, causal=causal
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            _row_spec(block_q, d),
+            _full_spec(seq, d),
+            _full_spec(seq, d),
+        ],
+        out_specs=[_row_spec(block_q, d), _vec_spec(block_q)],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, seq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v, out, lse = residuals
+    bh, seq, d = q.shape
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )[:, :, None]
+    scale = d**-0.5
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, block_k=block_k, scale=scale, causal=causal
+        ),
+        grid=(bh, seq // block_q),
+        in_specs=[
+            _row_spec(block_q, d),
+            _full_spec(seq, d),
+            _full_spec(seq, d),
+            _row_spec(block_q, d),
+            _vec_spec(block_q),
+            _vec_spec(block_q),
+        ],
+        out_specs=_row_spec(block_q, d),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, block_q=block_q, scale=scale, causal=causal
+        ),
+        grid=(bh, seq // block_k),
+        in_specs=[
+            _full_spec(seq, d),
+            _row_spec(block_k, d),
+            _row_spec(block_k, d),
+            _full_spec(seq, d),
+            _full_vec_spec(seq),
+            _full_vec_spec(seq),
+        ],
+        out_specs=[_row_spec(block_k, d), _row_spec(block_k, d)],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _fit_block(block: int, t: int) -> int | None:
+    """Largest multiple of 8 that is <= ``block`` and divides ``t``
+    (None when no such size exists — caller falls back to dense)."""
+    b = min(block, t)
+    b -= b % 8
+    while b >= 8 and t % b != 0:
+        b -= 8
+    return b if b >= 8 else None
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+    mesh=None,
+    batch_axis: str | None = "data",
+    heads_axis: str | None = "tensor",
+) -> jnp.ndarray:
+    """Tiled attention over ``[B, T, H, D]`` (same convention as
+    :func:`.attention.dot_product_attention`, to which it is numerically
+    equivalent).
+
+    Falls back to the dense XLA path when no multiple-of-8 block divides the
+    sequence length, or when running on a non-TPU backend without
+    ``interpret``.
+
+    GSPMD cannot partition a ``pallas_call``, so under a sharded jit the bare
+    kernel would make XLA all-gather the global batch onto every chip. Pass
+    ``mesh`` (as the :class:`Attention` module does) to run the kernel under
+    ``shard_map`` instead: each device computes only its ``batch_axis`` /
+    ``heads_axis`` shard, preserving data/tensor parallelism.
+    """
+    b, t, h, d = q.shape
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            # No TPU and no explicit interpret request: the dense XLA path is
+            # far faster than the Pallas interpreter — use it.
+            return dot_product_attention(q, k, v, causal=causal)
+        interpret = False
+    block_q = _fit_block(block_q, t)
+    block_k = _fit_block(block_k, t)
+    if block_q is None or block_k is None:
+        return dot_product_attention(q, k, v, causal=causal)
+
+    def run_local(ql, kl, vl):
+        bl, tl, hl, dl = ql.shape
+
+        def to3(x):
+            return x.transpose(0, 2, 1, 3).reshape(bl * hl, tl, dl)
+
+        out = _flash(to3(ql), to3(kl), to3(vl), causal, block_q, block_k, interpret)
+        return out.reshape(bl, hl, tl, dl).transpose(0, 2, 1, 3)
+
+    if mesh is None:
+        return run_local(q, k, v)
+
+    def axis_if_divisible(axis, size):
+        return (
+            axis
+            if (axis and axis in mesh.shape and size % mesh.shape[axis] == 0)
+            else None
+        )
+
+    b_ax = axis_if_divisible(batch_axis, b)
+    h_ax = axis_if_divisible(heads_axis, h)
+    spec = P(b_ax, None, h_ax, None)
+    return jax.shard_map(
+        run_local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -28,6 +28,7 @@ Bubble accounting: with ``M`` microbatches and ``S`` stages the pipeline runs
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Optional
 
 import jax
@@ -145,8 +146,6 @@ def pipeline_apply(
 
     x_spec = P(*((d_ax,) + (None,) * (x.ndim - 1)))
     params_spec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
-
-    import functools
 
     body = functools.partial(
         _pipeline_shard_body,

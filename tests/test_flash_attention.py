@@ -1,0 +1,102 @@
+"""Pallas flash-attention kernel tests, run in interpreter mode on CPU
+(the same kernel code lowers to Mosaic on a real TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu.ops.attention import dot_product_attention
+from distributed_pytorch_tpu.ops.flash_attention import flash_attention
+
+
+def make_qkv(b=2, t=32, h=2, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(
+        jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+        for _ in range(3)
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_dense(causal):
+    q, k, v = make_qkv()
+    out = flash_attention(
+        q, k, v, causal=causal, block_q=8, block_k=8, interpret=True
+    )
+    ref = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_grads_match_dense(causal):
+    q, k, v = make_qkv(t=16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=causal, block_q=8, block_k=8, interpret=True
+            )
+            ** 2
+        )
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, (0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_uneven_blocks_mismatched_qk():
+    """block_q != block_k exercises the diagonal bookkeeping."""
+    q, k, v = make_qkv(t=48)
+    out = flash_attention(
+        q, k, v, causal=True, block_q=8, block_k=16, interpret=True
+    )
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_block_fitting_finds_divisor():
+    """t=40 with requested block 128: largest multiple-of-8 divisor (40) is
+    used rather than falling back to dense — verify via numerics (the kernel
+    path is exercised because interpret=True)."""
+    q, k, v = make_qkv(t=40)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_sharded_under_mesh_matches_dense():
+    """With a mesh, the kernel runs under shard_map (per-device batch shard)
+    and still matches dense attention."""
+    from distributed_pytorch_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"data": 2, "tensor": 2}, devices=jax.devices()[:4])
+    q, k, v = make_qkv(b=4, t=16, h=2, d=8)
+    out = jax.jit(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=8, block_k=8,
+            interpret=True, mesh=mesh,
+        )
+    )(q, k, v)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_fallback_on_non_tiling_shape():
+    """A prime sequence length can't tile: falls back to dense, still right."""
+    q, k, v = make_qkv(t=17)
+    out = flash_attention(q, k, v, causal=True, block_q=8, block_k=8)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_cpu_backend_defaults_to_dense():
+    """interpret=None off-TPU returns the dense path (fast CI), bit-identical."""
+    q, k, v = make_qkv(t=16)
+    out = flash_attention(q, k, v, causal=False)
+    ref = dot_product_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=0)

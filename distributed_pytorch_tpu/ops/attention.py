@@ -33,6 +33,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30
 
 
+def axis_if_divisible(mesh: Mesh, axis: Optional[str], size: int) -> Optional[str]:
+    """``axis`` when it names a real mesh axis whose size divides ``size``,
+    else None (replicate). The shared eligibility rule for sharding an array
+    dim in the attention entry points."""
+    if axis and axis in mesh.shape and size % mesh.shape[axis] == 0:
+        return axis
+    return None
+
+
 def dot_product_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -140,25 +149,12 @@ def ring_attention(
             f"sequence length {q.shape[1]} not divisible by mesh axis "
             f"{axis_name!r} ({seq_size})"
         )
-    batch_spec = (
-        batch_axis
-        if (
-            batch_axis
-            and batch_axis in mesh.shape
-            and q.shape[0] % mesh.shape[batch_axis] == 0
-        )
-        else None
+    spec = P(
+        axis_if_divisible(mesh, batch_axis, q.shape[0]),
+        axis_name,
+        axis_if_divisible(mesh, heads_axis, q.shape[2]),
+        None,
     )
-    heads_spec = (
-        heads_axis
-        if (
-            heads_axis
-            and heads_axis in mesh.shape
-            and q.shape[2] % mesh.shape[heads_axis] == 0
-        )
-        else None
-    )
-    spec = P(batch_spec, axis_name, heads_spec, None)
     body = functools.partial(
         _ring_attention_shard, axis_name=axis_name, causal=causal
     )

@@ -8,14 +8,17 @@ dimension — each ring hop's local block can use this kernel's math).
 
 Design (FlashAttention-2 style, built per the Pallas TPU playbook):
 
-* forward: grid over ``(batch*heads, Tq/block_q)``; each program streams K/V
-  ``block_k`` tiles from VMEM, maintaining the online-softmax running max
-  ``m``, denominator ``l``, and accumulator ``o`` in fp32 registers; writes
-  the normalized output plus the logsumexp row stats for the backward pass.
-* backward: the standard two-kernel split — one grid over Q tiles producing
-  ``dQ``, one over K/V tiles producing ``dK``/``dV`` — each recomputing
+* forward: grid ``(batch*heads, Tq/block_q, Tk/block_k)`` with the K dim
+  innermost — Pallas streams ``block_k`` K/V tiles HBM->VMEM with automatic
+  double buffering, so VMEM stays ``O(block)`` at any sequence length. The
+  online-softmax running max ``m``, denominator ``l``, and output accumulator
+  live in fp32 VMEM scratch that persists across the K iterations; the last K
+  step normalizes and writes the output tile plus logsumexp row stats.
+* backward: the standard two-kernel split — one grid producing ``dQ`` (K
+  innermost), one producing ``dK``/``dV`` (Q innermost) — each recomputing
   probabilities from the saved logsumexp (no stored score matrix), with
   ``delta = rowsum(dO * O)`` precomputed outside.
+* causal programs skip the matmul work of fully-masked tiles via ``pl.when``.
 * all matmuls run on the MXU with ``preferred_element_type=float32``;
   bfloat16 inputs are upcast per tile.
 
@@ -34,9 +37,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
-from distributed_pytorch_tpu.ops.attention import dot_product_attention
-
-NEG_INF = -1e30
+from distributed_pytorch_tpu.ops.attention import (
+    NEG_INF,
+    axis_if_divisible,
+    dot_product_attention,
+)
 
 
 def _causal_mask(s, q_start, k_start):
@@ -46,17 +51,30 @@ def _causal_mask(s, q_start, k_start):
     return jnp.where(q_pos >= k_pos, s, NEG_INF)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, scale, causal):
-    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
-    block_q, d = q.shape
-    seq_k = k_ref.shape[1]
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, scale, causal
+):
+    block_q, d = q_ref.shape[1:]
+    block_k = k_ref.shape[1]
     q_start = pl.program_id(1) * block_q
+    k_idx = pl.program_id(2)
+    k_start = k_idx * block_k
 
-    def body(j, carry):
-        m, l, o = carry
-        k_start = j * block_k
-        k_blk = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+    @pl.when(k_idx == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: tiles fully above the diagonal contribute nothing — skip the
+    # MXU work (the tile DMA still happens; the grid is static).
+    live = True if not causal else k_start <= q_start + block_q - 1
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
         s = (
             jax.lax.dot_general(
                 q, k_blk, (((1,), (1,)), ((), ())),
@@ -66,50 +84,51 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, scale, causal):
         )  # [block_q, block_k]
         if causal:
             s = _causal_mask(s, q_start, k_start)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        correction = jnp.exp(m - m_new)
-        l_new = l * correction + jnp.sum(p, axis=-1)
+        m_prev = m_scr[:, :1]  # [block_q, 1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        correction = jnp.exp(m_prev - m_new)
+        l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        o_new = o * correction[:, None] + pv
-        return m_new, l_new, o_new
+        acc_scr[:] = acc_scr[:] * correction + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    o0 = jnp.zeros((block_q, d), jnp.float32)
-    n_blocks = seq_k // block_k
-    if causal:
-        # Blocks entirely above the diagonal contribute nothing — skip them.
-        # (fori_loop accepts a traced bound, so this is per-program.)
-        n_blocks = jnp.minimum(
-            n_blocks, pl.cdiv(q_start + block_q, block_k)
-        )
-    m, l, o = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, o0))
-    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
-    # lse rides as [BH, T, 1]: stats live in the sublane dim (lane dim 1), so
-    # per-tile blocks and multiple-of-8 dynamic offsets stay Mosaic-legal for
-    # any block size — lane-dim offsets would need 128 alignment.
-    lse_ref[0, :, 0] = m + jnp.log(l)
+    @pl.when(k_idx == pl.num_programs(2) - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0, :, :] = m_scr[:, :1] + jnp.log(l)
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k, scale, causal
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, scale, causal,
 ):
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    block_q, d = q.shape
-    seq_k = k_ref.shape[1]
+    block_q, d = q_ref.shape[1:]
+    block_k = k_ref.shape[1]
     q_start = pl.program_id(1) * block_q
-    lse = lse_ref[0, :, 0]
-    delta = delta_ref[0, :, 0]
+    k_idx = pl.program_id(2)
+    k_start = k_idx * block_k
 
-    def body(j, dq):
-        k_start = j * block_k
-        k_blk = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+    @pl.when(k_idx == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = True if not causal else k_start <= q_start + block_q - 1
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]  # [block_q, 1]
+        delta = delta_ref[0]
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
         s = (
             jax.lax.dot_general(
                 q, k_blk, (((1,), (1,)), ((), ())),
@@ -119,42 +138,47 @@ def _bwd_dq_kernel(
         )
         if causal:
             s = _causal_mask(s, q_start, k_start)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta[:, None]) * scale
-        return dq + jax.lax.dot_general(
+        ds = p * (dp - delta) * scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    dq = jnp.zeros((block_q, d), jnp.float32)
-    n_blocks = seq_k // block_k
-    if causal:
-        n_blocks = jnp.minimum(n_blocks, pl.cdiv(q_start + block_q, block_k))
-    dq = jax.lax.fori_loop(0, n_blocks, body, dq)
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(k_idx == pl.num_programs(2) - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, block_q, scale, causal,
+    dk_scr, dv_scr, *, scale, causal,
 ):
-    k = k_ref[0].astype(jnp.float32)  # [block_k, D]
-    v = v_ref[0].astype(jnp.float32)
-    block_k, d = k.shape
-    seq_q = q_ref.shape[1]
+    block_k, d = k_ref.shape[1:]
+    block_q = q_ref.shape[1]
     k_start = pl.program_id(1) * block_k
+    q_idx = pl.program_id(2)
+    q_start = q_idx * block_q
 
-    def body(i, carry):
-        dk, dv = carry
-        q_start = i * block_q
-        q_blk = q_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(q_start, block_q), 0]
-        delta_blk = delta_ref[0, pl.ds(q_start, block_q), 0]
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live = True if not causal else q_start + block_q - 1 >= k_start
+
+    @pl.when(live)
+    def _step():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q_blk = q_ref[0].astype(jnp.float32)
+        do_blk = do_ref[0].astype(jnp.float32)
+        lse_blk = lse_ref[0]  # [block_q, 1]
+        delta_blk = delta_ref[0]
         s = (
             jax.lax.dot_general(
                 q_blk, k, (((1,), (1,)), ((), ())),
@@ -164,8 +188,8 @@ def _bwd_dkv_kernel(
         )  # [block_q, block_k]
         if causal:
             s = _causal_mask(s, q_start, k_start)
-        p = jnp.exp(s - lse_blk[:, None])
-        dv_new = dv + jax.lax.dot_general(
+        p = jnp.exp(s - lse_blk)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p, do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -173,38 +197,48 @@ def _bwd_dkv_kernel(
             do_blk, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_blk[:, None]) * scale
-        dk_new = dk + jax.lax.dot_general(
+        ds = p * (dp - delta_blk) * scale
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return dk_new, dv_new
 
-    dk0 = jnp.zeros((block_k, d), jnp.float32)
-    dv0 = jnp.zeros((block_k, d), jnp.float32)
-    start = k_start // block_q if causal else 0
-    dk, dv = jax.lax.fori_loop(start, seq_q // block_q, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(q_idx == pl.num_programs(2) - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _row_spec(block, d):
-    return pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM)
+def _q_spec(block, d):
+    return pl.BlockSpec(
+        (1, block, d), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM
+    )
 
 
-def _full_spec(t, d):
-    return pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM)
+def _kv_spec(block, d):
+    return pl.BlockSpec(
+        (1, block, d), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM
+    )
 
 
-def _vec_spec(block):
-    # Row stats ride as [BH, T, 1] (stats along sublanes, trivial lane dim):
-    # block (1, block, 1) is legal for any multiple-of-8 block because the
-    # lane dim equals the full array dim.
-    return pl.BlockSpec((1, block, 1), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM)
+def _q_vec_spec(block):
+    # Row stats ride as [BH, T, 1]: stats along sublanes, trivial lane dim —
+    # legal for any multiple-of-8 block (lane-dim offsets would need 128
+    # alignment).
+    return pl.BlockSpec(
+        (1, block, 1), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM
+    )
 
 
-def _full_vec_spec(t):
-    return pl.BlockSpec((1, t, 1), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM)
+def _swap_q(spec_fn, block, *rest):
+    """Same specs with the roles of grid dims 1/2 swapped (dK/dV kernel:
+    grid is (bh, k_tile, q_tile))."""
+    inner = spec_fn(block, *rest)
+    return pl.BlockSpec(
+        inner.block_shape,
+        lambda b, i, j: inner.index_map(b, j, i),
+        memory_space=pltpu.VMEM,
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -215,22 +249,23 @@ def _flash(q, k, v, causal, block_q, block_k, interpret):
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     bh, seq, d = q.shape
-    grid = (bh, seq // block_q)
-    kernel = functools.partial(
-        _fwd_kernel, block_k=block_k, scale=d**-0.5, causal=causal
-    )
     out, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
+        functools.partial(_fwd_kernel, scale=d**-0.5, causal=causal),
+        grid=(bh, seq // block_q, seq // block_k),
         in_specs=[
-            _row_spec(block_q, d),
-            _full_spec(seq, d),
-            _full_spec(seq, d),
+            _q_spec(block_q, d),
+            _kv_spec(block_k, d),
+            _kv_spec(block_k, d),
         ],
-        out_specs=[_row_spec(block_q, d), _vec_spec(block_q)],
+        out_specs=[_q_spec(block_q, d), _q_vec_spec(block_q)],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct((bh, seq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, 128), jnp.float32),  # denominator l
+            pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
         ],
         interpret=interpret,
     )(q, k, v)
@@ -246,40 +281,42 @@ def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
     scale = d**-0.5
 
     dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel, block_k=block_k, scale=scale, causal=causal
-        ),
-        grid=(bh, seq // block_q),
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal),
+        grid=(bh, seq // block_q, seq // block_k),
         in_specs=[
-            _row_spec(block_q, d),
-            _full_spec(seq, d),
-            _full_spec(seq, d),
-            _row_spec(block_q, d),
-            _vec_spec(block_q),
-            _vec_spec(block_q),
+            _q_spec(block_q, d),
+            _kv_spec(block_k, d),
+            _kv_spec(block_k, d),
+            _q_spec(block_q, d),
+            _q_vec_spec(block_q),
+            _q_vec_spec(block_q),
         ],
-        out_specs=_row_spec(block_q, d),
+        out_specs=_q_spec(block_q, d),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, g, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel, block_q=block_q, scale=scale, causal=causal
-        ),
-        grid=(bh, seq // block_k),
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal),
+        grid=(bh, seq // block_k, seq // block_q),
         in_specs=[
-            _full_spec(seq, d),
-            _row_spec(block_k, d),
-            _row_spec(block_k, d),
-            _full_spec(seq, d),
-            _full_vec_spec(seq),
-            _full_vec_spec(seq),
+            _swap_q(_q_spec, block_q, d),
+            # Grid dim 1 is the K tile here, so K/V use the dim-1 index map.
+            _q_spec(block_k, d),
+            _q_spec(block_k, d),
+            _swap_q(_q_spec, block_q, d),
+            _swap_q(_q_vec_spec, block_q),
+            _swap_q(_q_vec_spec, block_q),
         ],
-        out_specs=[_row_spec(block_k, d), _row_spec(block_k, d)],
+        out_specs=[_q_spec(block_k, d)] * 2,
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, g, lse, delta)
@@ -305,8 +342,9 @@ def flash_attention(
     v: jnp.ndarray,
     *,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,  # tuned on v5-class hardware: (512, 1024) ran the
+    block_k: int = 1024,  # 8k-seq causal train step 2.6x faster than dense
+
     interpret: bool | None = None,
     mesh=None,
     batch_axis: str | None = "data",
@@ -350,16 +388,12 @@ def flash_attention(
     if mesh is None:
         return run_local(q, k, v)
 
-    def axis_if_divisible(axis, size):
-        return (
-            axis
-            if (axis and axis in mesh.shape and size % mesh.shape[axis] == 0)
-            else None
-        )
-
-    b_ax = axis_if_divisible(batch_axis, b)
-    h_ax = axis_if_divisible(heads_axis, h)
-    spec = P(b_ax, None, h_ax, None)
+    spec = P(
+        axis_if_divisible(mesh, batch_axis, b),
+        None,
+        axis_if_divisible(mesh, heads_axis, h),
+        None,
+    )
     return jax.shard_map(
         run_local,
         mesh=mesh,

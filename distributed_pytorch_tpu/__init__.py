@@ -45,6 +45,7 @@ from distributed_pytorch_tpu.training.train_step import TrainState, make_train_s
 from distributed_pytorch_tpu.training.trainer import Trainer
 from distributed_pytorch_tpu.utils.data import (
     MaterializedDataset,
+    NativeShardedLoader,
     RandomDataset,
     ShardedLoader,
 )
@@ -53,6 +54,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "MaterializedDataset",
+    "NativeShardedLoader",
     "RandomDataset",
     "ShardedLoader",
     "StepProfiler",

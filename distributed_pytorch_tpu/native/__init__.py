@@ -10,10 +10,14 @@ Components:
 
 * ``kvstore.cpp``   -> ``tpu_kvstore`` binary — TCP rendezvous/KV store
   (c10d TCPStore twin; reference ``slurm/sbatch_run.sh:21-22``).
+* ``prefetch.cpp``  -> ``libtpu_prefetch.so`` — GIL-free batch-prefetch worker
+  pool (torch ``DataLoader`` worker/pin-memory twin; reference
+  ``multigpu.py:72-79``), driven via ctypes.
 """
 
 from __future__ import annotations
 
+import ctypes
 import os
 import subprocess
 import threading
@@ -51,3 +55,34 @@ def _compile(src_name: str, out_name: str, *, shared: bool) -> str:
 def kvstore_binary() -> str:
     """Path to the ``tpu_kvstore`` server binary (building it if needed)."""
     return _compile("kvstore.cpp", "tpu_kvstore", shared=False)
+
+
+_PREFETCH_LIB = None
+
+
+def prefetch_library() -> ctypes.CDLL:
+    """The batch-prefetch shared library, built on first use, with argtypes
+    bound."""
+    global _PREFETCH_LIB
+    if _PREFETCH_LIB is not None:
+        return _PREFETCH_LIB
+    path = _compile("prefetch.cpp", "libtpu_prefetch.so", shared=True)
+    lib = ctypes.CDLL(path)
+    lib.prefetch_create.restype = ctypes.c_void_p
+    lib.prefetch_create.argtypes = [
+        ctypes.c_void_p,  # x rows
+        ctypes.c_void_p,  # y rows
+        ctypes.c_long,  # row_x bytes
+        ctypes.c_long,  # row_y bytes
+        ctypes.POINTER(ctypes.c_long),  # indices
+        ctypes.c_long,  # n_indices
+        ctypes.c_long,  # batch
+        ctypes.c_int,  # depth
+        ctypes.c_int,  # n_threads
+    ]
+    lib.prefetch_next.restype = ctypes.c_int
+    lib.prefetch_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    lib.prefetch_destroy.restype = None
+    lib.prefetch_destroy.argtypes = [ctypes.c_void_p]
+    _PREFETCH_LIB = lib
+    return lib

@@ -1,0 +1,147 @@
+// Background batch-prefetch engine: the native data-loader worker pool.
+//
+// Capability twin of the reference's `DataLoader(..., pin_memory=True)` worker
+// machinery (reference multigpu.py:72-79) — the part of the input pipeline the
+// reference inherits from torch's C++ core (SURVEY.md §2a "Pinned-memory H2D
+// copy path"). Here it is a standalone shared library driven through ctypes:
+//
+//   * N worker threads gather sample rows (raw memcpy, dtype-agnostic) from a
+//     caller-owned dataset buffer into a bounded ring of batch slots — the
+//     Python GIL is never touched while batches are assembled;
+//   * the consumer drains batches strictly in order (slot b % depth carries
+//     batch b), so results are identical to serial iteration;
+//   * `depth` bounds memory: workers stall until the consumer frees a slot.
+//
+// Row indices are precomputed by the Python side (ShardedLoader semantics:
+// shuffle, shard, pad-by-wrap), keeping the C++ side a pure data mover.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum SlotState { kEmpty = 0, kFilling = 1, kReady = 2 };
+
+struct Loader {
+  const char* x;
+  const char* y;
+  long row_x;  // bytes per sample row in x
+  long row_y;
+  std::vector<long> indices;  // n_batches * batch sample indices
+  long batch;
+  long n_batches;
+  int depth;
+
+  std::vector<std::vector<char>> slot_x;
+  std::vector<std::vector<char>> slot_y;
+  std::vector<int> state;
+
+  std::mutex mu;
+  std::condition_variable cv_slot_free;
+  std::condition_variable cv_slot_ready;
+  std::atomic<long> next_claim{0};
+  long next_deliver = 0;
+  bool stopping = false;
+  std::vector<std::thread> workers;
+};
+
+void worker_loop(Loader* ld) {
+  for (;;) {
+    long b = ld->next_claim.fetch_add(1);
+    if (b >= ld->n_batches) return;
+    int slot = static_cast<int>(b % ld->depth);
+    {
+      std::unique_lock<std::mutex> lock(ld->mu);
+      // Slot b%depth is ours once the consumer has drained batch b-depth.
+      ld->cv_slot_free.wait(lock, [&] {
+        return ld->stopping ||
+               (ld->state[slot] == kEmpty && b - ld->next_deliver < ld->depth);
+      });
+      if (ld->stopping) return;
+      ld->state[slot] = kFilling;
+    }
+    char* out_x = ld->slot_x[slot].data();
+    char* out_y = ld->slot_y[slot].data();
+    const long* idx = ld->indices.data() + b * ld->batch;
+    for (long i = 0; i < ld->batch; ++i) {
+      std::memcpy(out_x + i * ld->row_x, ld->x + idx[i] * ld->row_x, ld->row_x);
+      std::memcpy(out_y + i * ld->row_y, ld->y + idx[i] * ld->row_y, ld->row_y);
+    }
+    {
+      std::lock_guard<std::mutex> lock(ld->mu);
+      ld->state[slot] = kReady;
+    }
+    ld->cv_slot_ready.notify_all();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Dataset buffers stay owned by the caller and must outlive the loader.
+void* prefetch_create(const char* x, const char* y, long row_x, long row_y,
+                      const long* indices, long n_indices, long batch,
+                      int depth, int n_threads) {
+  if (batch <= 0 || n_indices % batch != 0 || depth <= 0 || n_threads <= 0) {
+    return nullptr;
+  }
+  auto* ld = new Loader();
+  ld->x = x;
+  ld->y = y;
+  ld->row_x = row_x;
+  ld->row_y = row_y;
+  ld->indices.assign(indices, indices + n_indices);
+  ld->batch = batch;
+  ld->n_batches = n_indices / batch;
+  ld->depth = depth;
+  ld->slot_x.resize(depth, std::vector<char>(batch * row_x));
+  ld->slot_y.resize(depth, std::vector<char>(batch * row_y));
+  ld->state.assign(depth, kEmpty);
+  for (int t = 0; t < n_threads; ++t) {
+    ld->workers.emplace_back(worker_loop, ld);
+  }
+  return ld;
+}
+
+// Copies the next batch into caller buffers. 1 = delivered, 0 = exhausted.
+int prefetch_next(void* handle, char* out_x, char* out_y) {
+  auto* ld = static_cast<Loader*>(handle);
+  long b;
+  int slot;
+  {
+    std::unique_lock<std::mutex> lock(ld->mu);
+    if (ld->next_deliver >= ld->n_batches) return 0;
+    b = ld->next_deliver;
+    slot = static_cast<int>(b % ld->depth);
+    ld->cv_slot_ready.wait(lock, [&] { return ld->state[slot] == kReady; });
+  }
+  std::memcpy(out_x, ld->slot_x[slot].data(), ld->batch * ld->row_x);
+  std::memcpy(out_y, ld->slot_y[slot].data(), ld->batch * ld->row_y);
+  {
+    std::lock_guard<std::mutex> lock(ld->mu);
+    ld->state[slot] = kEmpty;
+    ld->next_deliver = b + 1;
+  }
+  ld->cv_slot_free.notify_all();
+  return 1;
+}
+
+void prefetch_destroy(void* handle) {
+  auto* ld = static_cast<Loader*>(handle);
+  {
+    std::lock_guard<std::mutex> lock(ld->mu);
+    ld->stopping = true;
+  }
+  ld->cv_slot_free.notify_all();
+  // Unblock any worker waiting to fill by draining claims.
+  ld->next_claim.store(ld->n_batches);
+  for (auto& t : ld->workers) t.join();
+  delete ld;
+}
+
+}  // extern "C"

@@ -1,5 +1,6 @@
 from distributed_pytorch_tpu.utils.data import (
     MaterializedDataset,
+    NativeShardedLoader,
     RandomDataset,
     ShardedLoader,
 )
@@ -7,6 +8,7 @@ from distributed_pytorch_tpu.utils.platform import use_fake_cpu_devices
 
 __all__ = [
     "MaterializedDataset",
+    "NativeShardedLoader",
     "RandomDataset",
     "ShardedLoader",
     "use_fake_cpu_devices",

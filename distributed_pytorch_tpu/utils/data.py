@@ -161,10 +161,13 @@ class ShardedLoader:
             return per_shard // self.batch_size
         return math.ceil(per_shard / self.batch_size)
 
-    def __iter__(self) -> Iterator[Batch]:
+    def batch_index_table(self) -> "list[np.ndarray]":
+        """This epoch's batches as a list of per-batch index rows (the final
+        batch is wrap-padded to full size when ``pad_final_batch`` is set,
+        otherwise it may be short)."""
         indices = self.shard_indices()
-        n_batches = len(self)
-        for b in range(n_batches):
+        rows = []
+        for b in range(len(self)):
             chunk = indices[b * self.batch_size : (b + 1) * self.batch_size]
             if self.pad_final_batch and len(chunk) < self.batch_size:
                 # np.resize repeats cyclically, so this wraps even when the
@@ -172,7 +175,81 @@ class ShardedLoader:
                 chunk = np.concatenate(
                     [chunk, np.resize(indices, self.batch_size - len(chunk))]
                 )
+            rows.append(chunk)
+        return rows
+
+    def __iter__(self) -> Iterator[Batch]:
+        for chunk in self.batch_index_table():
             samples = [self.dataset[int(i)] for i in chunk]
             xs = np.stack([s[0] for s in samples])
             ys = np.stack([s[1] for s in samples])
             yield xs, ys
+
+
+class NativeShardedLoader(ShardedLoader):
+    """ShardedLoader whose batch assembly runs in the C++ prefetch worker pool
+    (``native/prefetch.cpp``) — the torch ``DataLoader(num_workers=...,
+    pin_memory=True)`` twin (reference ``multigpu.py:72-79``): batches are
+    gathered by GIL-free background threads into a bounded ring while the
+    training loop consumes, so host batch assembly overlaps device compute.
+
+    Requires a dataset exposing C-contiguous ``inputs``/``targets`` arrays
+    (:class:`MaterializedDataset`). Batch order and contents are IDENTICAL to
+    the Python loader (same index table); only who does the copying changes.
+    """
+
+    def __init__(self, *args, num_workers: int = 2, prefetch_depth: int = 4, **kw):
+        super().__init__(*args, **kw)
+        if not (
+            hasattr(self.dataset, "inputs") and hasattr(self.dataset, "targets")
+        ):
+            raise TypeError(
+                "NativeShardedLoader needs a materialized dataset with "
+                ".inputs/.targets arrays"
+            )
+        self.num_workers = num_workers
+        self.prefetch_depth = prefetch_depth
+        self._x = np.ascontiguousarray(self.dataset.inputs)
+        self._y = np.ascontiguousarray(self.dataset.targets)
+
+    def __iter__(self) -> Iterator[Batch]:
+        import ctypes
+
+        from distributed_pytorch_tpu.native import prefetch_library
+
+        rows = self.batch_index_table()
+        full = [r for r in rows if len(r) == self.batch_size]
+        ragged = rows[len(full):]  # at most one short final batch
+
+        if full:
+            lib = prefetch_library()
+            table = np.ascontiguousarray(np.stack(full).ravel(), dtype=np.int64)
+            row_x = self._x.dtype.itemsize * int(np.prod(self._x.shape[1:]))
+            row_y = self._y.dtype.itemsize * int(np.prod(self._y.shape[1:]))
+            handle = lib.prefetch_create(
+                self._x.ctypes.data,
+                self._y.ctypes.data,
+                row_x,
+                row_y,
+                table.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+                table.size,
+                self.batch_size,
+                self.prefetch_depth,
+                self.num_workers,
+            )
+            if not handle:
+                raise RuntimeError("prefetch_create failed")
+            try:
+                shape_x = (self.batch_size,) + self._x.shape[1:]
+                shape_y = (self.batch_size,) + self._y.shape[1:]
+                while True:
+                    xs = np.empty(shape_x, self._x.dtype)
+                    ys = np.empty(shape_y, self._y.dtype)
+                    if not lib.prefetch_next(handle, xs.ctypes.data, ys.ctypes.data):
+                        break
+                    yield xs, ys
+            finally:
+                lib.prefetch_destroy(handle)
+
+        for chunk in ragged:  # rare: no drop_last/pad on an uneven tail
+            yield self._x[chunk], self._y[chunk]

@@ -58,18 +58,20 @@ def main():
         device_batch = jax.device_put((jnp.asarray(xs), jnp.asarray(ys)))
     step = make_train_step(model.apply, optimizer, softmax_cross_entropy_loss, mesh=mesh)
 
-    # Warmup: compile + 3 steps.
+    # Warmup: compile + 3 steps. Synchronize by fetching the loss VALUE, not
+    # just block_until_ready — remote-tunnel backends can treat the latter as
+    # a no-op, which would time dispatch instead of compute.
     state, loss = step(state, device_batch)
-    jax.block_until_ready(loss)
+    float(loss)
     for _ in range(3):
         state, loss = step(state, device_batch)
-    jax.block_until_ready(loss)
+    float(loss)
 
     n_steps = 20
     start = time.perf_counter()
     for _ in range(n_steps):
         state, loss = step(state, device_batch)
-    jax.block_until_ready(loss)
+    float(loss)
     elapsed = time.perf_counter() - start
 
     steps_per_sec_per_chip = n_steps / elapsed  # global step rate; batch scales with chips

@@ -41,7 +41,9 @@ def test_sharded_and_padded():
         native = NativeShardedLoader(
             data, 8, num_shards=4, shard_index=shard, pad_final_batch=True
         )
-        for (ax, ay), (bx, by) in zip(batches_of(native), batches_of(py)):
+        ref, got = batches_of(py), batches_of(native)
+        assert len(got) == len(ref) == 4  # guards against a vacuous zip below
+        for (ax, ay), (bx, by) in zip(got, ref):
             np.testing.assert_array_equal(ax, bx)
             np.testing.assert_array_equal(ay, by)
 

@@ -64,12 +64,9 @@ def _to_host(leaf) -> np.ndarray:
     return np.asarray(jax.device_get(leaf))
 
 
-def save_checkpoint(path: str, tree: Any, metadata: Optional[Dict] = None) -> None:
-    """Atomically write ``tree`` (+ JSON-able ``metadata``) to ``path`` (.npz).
-
-    Process-0-only under multi-process runs; all processes return only after the
-    write is durable (barrier).
-    """
+def _gather_arrays(tree: Any, metadata: Optional[Dict]) -> Dict[str, np.ndarray]:
+    """Device -> host snapshot of every leaf plus the metadata entry. Runs on
+    the caller thread (may involve cross-host collectives for sharded leaves)."""
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
     arrays = {_path_str(p): _to_host(v) for p, v in flat}
     if _META_KEY in arrays:
@@ -77,19 +74,89 @@ def save_checkpoint(path: str, tree: Any, metadata: Optional[Dict] = None) -> No
     arrays[_META_KEY] = np.frombuffer(
         json.dumps(metadata or {}).encode("utf-8"), dtype=np.uint8
     )
+    return arrays
+
+
+def _write_npz(path: str, arrays: Dict[str, np.ndarray]) -> None:
+    """Atomic durable write: tmp file in the target dir + ``os.replace``."""
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp.npz")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def save_checkpoint(path: str, tree: Any, metadata: Optional[Dict] = None) -> None:
+    """Atomically write ``tree`` (+ JSON-able ``metadata``) to ``path`` (.npz).
+
+    Process-0-only under multi-process runs; all processes return only after the
+    write is durable (barrier).
+    """
+    arrays = _gather_arrays(tree, metadata)
     if is_main_process():
-        directory = os.path.dirname(os.path.abspath(path))
-        os.makedirs(directory, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp.npz")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                np.savez(f, **arrays)
-            os.replace(tmp, path)
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
+        _write_npz(path, arrays)
     barrier("checkpoint_write")
+
+
+class AsyncCheckpointer:
+    """Checkpoint writes that overlap training (orbax-style async save).
+
+    ``save()`` gathers device state to host ON THE CALLER THREAD (so any
+    cross-host collectives stay on the main thread), then hands the durable
+    disk write to a background thread and returns. The cross-host barrier that
+    :func:`save_checkpoint` performs inline is deferred to the next ``wait()``
+    — which ``save()`` itself calls first, so writes never overlap and every
+    save is known durable before the next one starts. Call ``wait()`` before
+    reading the file or exiting.
+    """
+
+    def __init__(self):
+        self._thread = None
+        self._barrier_due = False
+        self._error: Optional[BaseException] = None
+
+    def save(self, path: str, tree: Any, metadata: Optional[Dict] = None) -> None:
+        self.wait()
+        arrays = _gather_arrays(tree, metadata)
+        self._barrier_due = True
+        if not is_main_process():
+            return
+
+        def write():
+            try:
+                _write_npz(path, arrays)
+            except BaseException as e:  # surfaced on the next wait()
+                self._error = e
+
+        import threading
+
+        self._thread = threading.Thread(target=write, daemon=True)
+        self._thread.start()
+
+    def save_snapshot(self, path: str, state: Any, epochs_run: int) -> None:
+        """Async variant of :func:`save_snapshot` (same metadata schema)."""
+        self.save(path, state, metadata=_snapshot_meta(epochs_run))
+
+    def wait(self) -> None:
+        """Block until the in-flight write is durable on every process."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        # Barrier BEFORE surfacing a write error: the other processes are
+        # already blocked in this barrier, and skipping it on failure would
+        # strand them (and desynchronize the next barrier).
+        if self._barrier_due:
+            self._barrier_due = False
+            barrier("checkpoint_write_async")
+        if self._error is not None:
+            error, self._error = self._error, None
+            raise error
 
 
 def load_checkpoint(path: str, template: Any) -> Tuple[Any, Dict]:
@@ -117,13 +184,19 @@ def load_checkpoint(path: str, template: Any) -> Tuple[Any, Dict]:
     return jax.tree_util.tree_unflatten(treedef, leaves), meta
 
 
+def _snapshot_meta(epochs_run: int) -> Dict:
+    """The snapshot metadata schema — single definition shared by the sync
+    and async save paths (load_snapshot reads the same key)."""
+    return {"epochs_run": int(epochs_run)}
+
+
 def save_snapshot(path: str, state: Any, epochs_run: int) -> None:
     """Elastic-training snapshot: full TrainState + progress marker.
 
     Twin of ``Trainer._save_snapshot`` (reference ``multigpu_torchrun.py:57-62``,
     which stores ``{MODEL_STATE, EPOCHS_RUN}``).
     """
-    save_checkpoint(path, state, metadata={"epochs_run": int(epochs_run)})
+    save_checkpoint(path, state, metadata=_snapshot_meta(epochs_run))
 
 
 def load_snapshot(path: str, template: Any) -> Tuple[Any, int]:

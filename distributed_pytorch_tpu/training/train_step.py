@@ -28,7 +28,7 @@ import flax.struct
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_pytorch_tpu.parallel.sharding import (
     batch_sharding,
@@ -89,6 +89,7 @@ def make_train_step(
     data_axis: str = "data",
     state_sharding: Optional[Any] = None,
     batch_spec: Optional[Any] = None,
+    grad_accum: int = 1,
 ) -> Callable[[TrainState, Tuple], Tuple[TrainState, jnp.ndarray]]:
     """Build the jitted ``(state, (inputs, targets)) -> (state', loss)`` step.
 
@@ -104,31 +105,96 @@ def make_train_step(
     dim-0-only batch sharding — e.g. ``P("data", "sequence")`` to co-shard
     tokens along the ring-attention sequence axis.
 
+    ``grad_accum > 1`` splits the batch into that many equal microbatches and
+    accumulates gradients over a ``lax.scan`` before the single optimizer
+    update — same math as the full batch (mean-of-means), at 1/grad_accum the
+    peak activation memory. Under a mesh, each microbatch keeps the batch
+    sharding (the reshape adds a leading replicated accum dim). Stateful
+    collections (BatchNorm stats) update sequentially per microbatch, like N
+    consecutive forward passes.
+
     ``donate_argnums=(0,)`` lets XLA reuse the old state's buffers for the new
     state (in-place update semantics, halving peak parameter memory).
     """
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
 
     def step(state: TrainState, batch) -> Tuple[TrainState, jnp.ndarray]:
         inputs, targets = batch
         mutable = list(state.model_state.keys())  # static at trace time
 
-        def batch_loss(params):
-            variables = {"params": params, **state.model_state}
+        def micro_loss(params, model_state, mb_inputs, mb_targets):
+            variables = {"params": params, **model_state}
             # "losses" is always mutable so sown penalty terms surface here;
             # it is popped before the aux state re-enters TrainState (it is
             # per-apply, not persistent — see create_train_state).
             predictions, new_model_state = apply_fn(
-                variables, inputs, mutable=mutable + ["losses"]
+                variables, mb_inputs, mutable=mutable + ["losses"]
             )
             new_model_state = dict(new_model_state)
-            loss = loss_fn(predictions, targets)
+            loss = loss_fn(predictions, mb_targets)
             for term in jax.tree_util.tree_leaves(new_model_state.pop("losses", {})):
                 loss = loss + jnp.sum(term)
             return loss, new_model_state
 
-        (loss, new_model_state), grads = jax.value_and_grad(
-            batch_loss, has_aux=True
-        )(state.params)
+        grad_fn = jax.value_and_grad(micro_loss, has_aux=True)
+
+        if grad_accum == 1:
+            (loss, new_model_state), grads = grad_fn(
+                state.params, state.model_state, inputs, targets
+            )
+        else:
+            if inputs.shape[0] % grad_accum != 0:
+                raise ValueError(
+                    f"batch {inputs.shape[0]} not divisible by grad_accum "
+                    f"{grad_accum}"
+                )
+            if mesh is not None:
+                axes = (batch_spec or P(data_axis))[0]
+                shards = 1
+                for ax in axes if isinstance(axes, tuple) else (axes,):
+                    shards *= mesh.shape.get(ax, 1) if ax else 1
+                if (inputs.shape[0] // grad_accum) % shards != 0:
+                    raise ValueError(
+                        f"microbatch {inputs.shape[0] // grad_accum} "
+                        f"(batch {inputs.shape[0]} / grad_accum {grad_accum}) "
+                        f"not divisible by the {shards} batch shards"
+                    )
+
+            def split(x):
+                x = x.reshape((grad_accum, x.shape[0] // grad_accum) + x.shape[1:])
+                if mesh is not None:
+                    # Keep each microbatch sharded like the full batch; the
+                    # accum dim is replicated (scanned over).
+                    spec = batch_spec if batch_spec is not None else P(data_axis)
+                    x = jax.lax.with_sharding_constraint(
+                        x, NamedSharding(mesh, P(None, *spec))
+                    )
+                return x
+
+            micro_in, micro_tgt = split(inputs), split(targets)
+
+            def body(carry, mb):
+                model_state, grad_sum, loss_sum = carry
+                (loss, new_ms), grads = grad_fn(state.params, model_state, *mb)
+                grad_sum = jax.tree_util.tree_map(jnp.add, grad_sum, grads)
+                return (new_ms, grad_sum, loss_sum + loss), None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            )
+            (new_model_state, grad_sum, loss_sum), _ = jax.lax.scan(
+                body,
+                (state.model_state, zeros, jnp.zeros((), jnp.float32)),
+                (micro_in, micro_tgt),
+            )
+            grads = jax.tree_util.tree_map(
+                lambda g, p: (g / grad_accum).astype(p.dtype),
+                grad_sum,
+                state.params,
+            )
+            loss = loss_sum / grad_accum
+
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(

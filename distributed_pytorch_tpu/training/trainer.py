@@ -73,6 +73,9 @@ class Trainer:
         profiler=None,
         metrics: Optional[MetricLogger] = None,
         log_every: int = 0,
+        grad_accum: int = 1,
+        async_save: bool = False,
+        paranoid: bool = False,
     ):
         self.model = model
         self.train_data = train_data
@@ -85,6 +88,16 @@ class Trainer:
         self.profiler = profiler
         self.metrics = metrics or MetricLogger()
         self.log_every = log_every
+        self.grad_accum = grad_accum
+        # async_save: overlap snapshot disk writes with the next epoch's
+        # compute; paranoid: replica-consistency check before every snapshot
+        # (the race detector, SURVEY.md §5).
+        self.checkpointer = None
+        if async_save:
+            from distributed_pytorch_tpu.checkpoint import AsyncCheckpointer
+
+            self.checkpointer = AsyncCheckpointer()
+        self.paranoid = paranoid
         self.epochs_run = 0
 
         if mesh is not None:
@@ -97,6 +110,16 @@ class Trainer:
             if not train_data.drop_last and not train_data.pad_final_batch:
                 # Static shapes under jit: wrap-pad any ragged final batch
                 # (DistributedSampler's pad-by-repeat semantic).
+                train_data.pad_final_batch = True
+        if grad_accum > 1:
+            if train_data.batch_size % grad_accum != 0:
+                raise ValueError(
+                    f"batch_size {train_data.batch_size} is not divisible by "
+                    f"grad_accum {grad_accum}"
+                )
+            if not train_data.drop_last and not train_data.pad_final_batch:
+                # A ragged final batch would break the microbatch split even
+                # in the serial (mesh-free) case.
                 train_data.pad_final_batch = True
 
         sample_x, _ = next(iter(train_data))
@@ -118,7 +141,7 @@ class Trainer:
                 self._load_snapshot(snapshot_path)
 
         self.train_step = make_train_step(
-            model.apply, optimizer, loss_fn, mesh=mesh
+            model.apply, optimizer, loss_fn, mesh=mesh, grad_accum=grad_accum
         )
 
     # ---------------------------------------------------------------- persistence
@@ -137,10 +160,23 @@ class Trainer:
             )
 
     def _save_snapshot(self, epoch: int) -> None:
-        save_snapshot(self.snapshot_path, self.state, epochs_run=epoch + 1)
+        if self.paranoid:
+            from distributed_pytorch_tpu.parallel.consistency import (
+                assert_replicas_consistent,
+            )
+
+            assert_replicas_consistent(self.state, name="TrainState")
+        if self.checkpointer is not None:
+            self.checkpointer.save_snapshot(
+                self.snapshot_path, self.state, epochs_run=epoch + 1
+            )
+            note = "snapshot write started (async)"
+        else:
+            save_snapshot(self.snapshot_path, self.state, epochs_run=epoch + 1)
+            note = "Training snapshot saved"
         if is_main_process():
             print(
-                f"Epoch {epoch} | Training snapshot saved at {self.snapshot_path}",
+                f"Epoch {epoch} | {note} at {self.snapshot_path}",
                 flush=True,
             )
 
@@ -214,6 +250,12 @@ class Trainer:
                     else:
                         self._save_checkpoint(epoch)
         finally:
-            if self.profiler is not None:
-                self.profiler.stop()
-            self.metrics.close()
+            try:
+                if self.checkpointer is not None:
+                    # Snapshot must be durable before returning; a surfaced
+                    # write error must not skip profiler/metrics cleanup.
+                    self.checkpointer.wait()
+            finally:
+                if self.profiler is not None:
+                    self.profiler.stop()
+                self.metrics.close()

@@ -1,0 +1,170 @@
+"""Tests for gradient accumulation, async checkpointing, and the replica
+consistency checker."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_pytorch_tpu.checkpoint import AsyncCheckpointer, load_snapshot
+from distributed_pytorch_tpu.models import MLP, ToyRegressor
+from distributed_pytorch_tpu.parallel.consistency import (
+    ReplicaDivergenceError,
+    assert_replicas_consistent,
+    check_device_replicas,
+)
+from distributed_pytorch_tpu.parallel.mesh import make_mesh
+from distributed_pytorch_tpu.parallel.sharding import (
+    put_global_batch,
+    replicated_sharding,
+)
+from distributed_pytorch_tpu.training.losses import mse_loss
+from distributed_pytorch_tpu.training.train_step import (
+    create_train_state,
+    make_train_step,
+)
+from distributed_pytorch_tpu.training.trainer import Trainer
+from distributed_pytorch_tpu.utils.data import MaterializedDataset, ShardedLoader
+
+
+def toy_batch(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.standard_normal((n, 20)).astype(np.float32),
+        rng.standard_normal((n, 1)).astype(np.float32),
+    )
+
+
+# ---------------------------------------------------------------- grad accum
+
+
+@pytest.mark.parametrize("accum", [2, 4])
+def test_grad_accum_matches_full_batch(accum):
+    model = ToyRegressor()
+    optimizer = optax.adam(1e-2)
+    xs, ys = toy_batch()
+    state_a = create_train_state(model, optimizer, xs, rng_seed=1)
+    state_b = create_train_state(model, optimizer, xs, rng_seed=1)
+    full = make_train_step(model.apply, optimizer, mse_loss)
+    accum_step = make_train_step(model.apply, optimizer, mse_loss, grad_accum=accum)
+    for _ in range(3):
+        state_a, loss_a = full(state_a, (jnp.asarray(xs), jnp.asarray(ys)))
+        state_b, loss_b = accum_step(state_b, (jnp.asarray(xs), jnp.asarray(ys)))
+        np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7),
+        state_a.params,
+        state_b.params,
+    )
+
+
+def test_grad_accum_sharded():
+    mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
+    model = ToyRegressor()
+    optimizer = optax.sgd(1e-2)
+    xs, ys = toy_batch(n=32)
+    state = create_train_state(model, optimizer, xs)
+    state = jax.device_put(state, replicated_sharding(mesh))
+    step = make_train_step(model.apply, optimizer, mse_loss, mesh=mesh, grad_accum=2)
+    serial_state = create_train_state(model, optimizer, xs)
+    serial = make_train_step(model.apply, optimizer, mse_loss)
+    state, loss = step(state, put_global_batch(mesh, (xs, ys)))
+    serial_state, serial_loss = serial(serial_state, (jnp.asarray(xs), jnp.asarray(ys)))
+    np.testing.assert_allclose(float(loss), float(serial_loss), rtol=1e-6)
+
+
+def test_grad_accum_indivisible_raises():
+    model = ToyRegressor()
+    xs, ys = toy_batch(n=30)
+    state = create_train_state(model, optax.sgd(1e-2), xs)
+    step = make_train_step(model.apply, optax.sgd(1e-2), mse_loss, grad_accum=4)
+    with pytest.raises(ValueError, match="divisible"):
+        step(state, (jnp.asarray(xs), jnp.asarray(ys)))
+
+
+# ---------------------------------------------------------------- async ckpt
+
+
+def test_async_checkpointer_roundtrip(tmp_path):
+    model = ToyRegressor()
+    xs, _ = toy_batch()
+    state = create_train_state(model, optax.adam(1e-3), xs)
+    path = str(tmp_path / "snap.npz")
+    ck = AsyncCheckpointer()
+    ck.save(path, state, metadata={"epochs_run": 7})
+    ck.wait()
+    restored, epochs = load_snapshot(path, state)
+    assert epochs == 7
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        state.params,
+        restored.params,
+    )
+
+
+def test_async_checkpointer_snapshot_is_of_save_time_state(tmp_path):
+    """Mutating state after save() must not leak into the written file —
+    the host gather happens at save() time."""
+    model = ToyRegressor()
+    xs, ys = toy_batch()
+    state = create_train_state(model, optax.sgd(1e-1), xs)
+    step = make_train_step(model.apply, optax.sgd(1e-1), mse_loss)
+    path = str(tmp_path / "snap.npz")
+    ck = AsyncCheckpointer()
+    saved_kernel = np.asarray(state.params["linear"]["kernel"]).copy()
+    ck.save(path, state, metadata={"epochs_run": 1})
+    for _ in range(5):  # keep training while the write is in flight
+        state, _ = step(state, (jnp.asarray(xs), jnp.asarray(ys)))
+    ck.wait()
+    restored, _ = load_snapshot(path, state)
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["linear"]["kernel"]), saved_kernel
+    )
+
+
+def test_trainer_async_save_resume(tmp_path):
+    data = MaterializedDataset(128)
+    snap = str(tmp_path / "s.npz")
+
+    def build():
+        loader = ShardedLoader(data, 32)
+        return Trainer(
+            ToyRegressor(), loader, optax.sgd(1e-3), save_every=1,
+            snapshot_path=snap, async_save=True, paranoid=True,
+        )
+
+    build().train(2)
+    assert os.path.exists(snap)
+    t2 = build()
+    assert t2.epochs_run == 2  # resumed from the async-written snapshot
+
+
+# ------------------------------------------------------------- consistency
+
+
+def test_consistent_state_passes():
+    mesh = make_mesh({"data": 8})
+    model = MLP()
+    xs, _ = toy_batch()
+    state = create_train_state(model, optax.adam(1e-3), xs)
+    state = jax.device_put(state, replicated_sharding(mesh))
+    assert_replicas_consistent(state)
+
+
+def test_divergent_device_replicas_detected():
+    mesh = make_mesh({"data": 8})
+    sharding = replicated_sharding(mesh)
+    shape = (4, 4)
+    # Hand-build a "replicated" array whose per-device buffers DISAGREE.
+    buffers = [
+        jax.device_put(
+            np.full(shape, float(i == 3), np.float32), d
+        )
+        for i, d in enumerate(mesh.devices.flat)
+    ]
+    evil = jax.make_array_from_single_device_arrays(shape, sharding, buffers)
+    with pytest.raises(ReplicaDivergenceError, match="replicated"):
+        check_device_replicas({"w": evil})

@@ -2,12 +2,19 @@ from distributed_pytorch_tpu.training.losses import (
     mse_loss,
     softmax_cross_entropy_loss,
 )
-from distributed_pytorch_tpu.training.train_step import TrainState, make_train_step
+from distributed_pytorch_tpu.training.train_step import (
+    TrainState,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+)
 from distributed_pytorch_tpu.training.trainer import Trainer
 
 __all__ = [
     "TrainState",
     "Trainer",
+    "create_train_state",
+    "make_eval_step",
     "make_train_step",
     "mse_loss",
     "softmax_cross_entropy_loss",

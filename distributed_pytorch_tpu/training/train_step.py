@@ -205,20 +205,89 @@ def make_train_step(
         )
         return new_state, loss
 
+    return _jit_sharded(
+        step,
+        mesh=mesh,
+        data_axis=data_axis,
+        state_sharding=state_sharding,
+        batch_spec=batch_spec,
+        donate=True,
+        out_includes_state=True,
+    )
+
+
+def _jit_sharded(
+    fn: Callable,
+    *,
+    mesh: Optional[Mesh],
+    data_axis: str,
+    state_sharding: Optional[Any],
+    batch_spec: Optional[Any],
+    donate: bool,
+    out_includes_state: bool,
+) -> Callable:
+    """Shared jit wiring for the train and eval steps: state + (inputs,
+    targets) in, state sharded-or-replicated, batch sharded along the data
+    axis (or an explicit spec)."""
+    donate_argnums = (0,) if donate else ()
     if mesh is None:
         if state_sharding is not None or batch_spec is not None:
             raise ValueError(
-                "state_sharding/batch_spec require mesh=; without one the step "
-                "would silently run unsharded"
+                "state_sharding/batch_spec require mesh=; without one the "
+                "computation would silently run unsharded"
             )
-        return jax.jit(step, donate_argnums=(0,))
+        return jax.jit(fn, donate_argnums=donate_argnums)
 
     replicated = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else replicated
     sharded_batch = batch_sharding(mesh, data_axis, spec=batch_spec)
+    out_sh = (state_sh, replicated) if out_includes_state else replicated
     return jax.jit(
-        step,
+        fn,
         in_shardings=(state_sh, (sharded_batch, sharded_batch)),
-        out_shardings=(state_sh, replicated),
-        donate_argnums=(0,),
+        out_shardings=out_sh,
+        donate_argnums=donate_argnums,
+    )
+
+
+def make_eval_step(
+    apply_fn: Callable,
+    loss_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    *,
+    mesh: Optional[Mesh] = None,
+    data_axis: str = "data",
+    state_sharding: Optional[Any] = None,
+    batch_spec: Optional[Any] = None,
+) -> Callable[[TrainState, Tuple], jnp.ndarray]:
+    """Jitted forward-only ``(state, (inputs, targets)) -> loss``.
+
+    ``apply_fn(variables, inputs, mutable=...) -> (predictions, aux)`` should
+    already be in eval mode (e.g. ``partial(model.apply, train=False)`` for
+    BatchNorm models, so running statistics are used). The ``"losses"``
+    collection is collected the same way the train step does, so sown penalty
+    terms (MoE load balance) appear in eval loss too and train-vs-eval
+    comparisons stay apples-to-apples. No state is donated (evaluation must
+    not consume the training state's buffers).
+    """
+
+    def eval_step(state: TrainState, batch) -> jnp.ndarray:
+        inputs, targets = batch
+        predictions, aux = apply_fn(
+            {"params": state.params, **state.model_state},
+            inputs,
+            mutable=["losses"],
+        )
+        loss = loss_fn(predictions, targets)
+        for term in jax.tree_util.tree_leaves(dict(aux).get("losses", {})):
+            loss = loss + jnp.sum(term)
+        return loss
+
+    return _jit_sharded(
+        eval_step,
+        mesh=mesh,
+        data_axis=data_axis,
+        state_sharding=state_sharding,
+        batch_spec=batch_spec,
+        donate=False,
+        out_includes_state=False,
     )

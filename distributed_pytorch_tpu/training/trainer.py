@@ -43,6 +43,7 @@ from distributed_pytorch_tpu.training.losses import mse_loss
 from distributed_pytorch_tpu.training.train_step import (
     TrainState,
     create_train_state,
+    make_eval_step,
     make_train_step,
 )
 from distributed_pytorch_tpu.utils.data import ShardedLoader
@@ -143,6 +144,7 @@ class Trainer:
         self.train_step = make_train_step(
             model.apply, optimizer, loss_fn, mesh=mesh, grad_accum=grad_accum
         )
+        self._eval_step = None  # built lazily on first evaluate()
 
     # ---------------------------------------------------------------- persistence
 
@@ -234,6 +236,47 @@ class Trainer:
         epoch_loss = float(np.mean([float(l) for l in losses])) if losses else 0.0
         self.metrics.log(int(self.state.step), epoch_loss=epoch_loss, epoch=epoch)
         return epoch_loss
+
+    def _eval_apply(self, variables, inputs, **kwargs):
+        """Forward in eval mode. Models whose ``__call__`` takes a ``train``
+        flag (BatchNorm family) get ``train=False`` so running statistics are
+        used; models without one are called plainly. Detected via the call
+        signature — a try/except on TypeError would mask real errors and
+        silently fall back to train mode."""
+        import inspect
+
+        signature = inspect.signature(type(self.model).__call__)
+        if "train" in signature.parameters:
+            kwargs["train"] = False
+        return self.model.apply(variables, inputs, **kwargs)
+
+    def evaluate(self, eval_data: ShardedLoader) -> float:
+        """Forward-only mean loss over ``eval_data`` (no gradients, no state
+        mutation). No reference analog — the reference never evaluates
+        (SURVEY.md §5: loss is computed but not even logged)."""
+        if self._eval_step is None:
+            self._eval_step = make_eval_step(
+                self._eval_apply, self.loss_fn, mesh=self.mesh
+            )
+        if self.mesh is not None:
+            data_size = self.mesh.shape.get("data", 1)
+            if eval_data.batch_size % data_size != 0:
+                raise ValueError(
+                    f"eval batch_size {eval_data.batch_size} is not divisible "
+                    f"by the mesh's data axis ({data_size})"
+                )
+            if not eval_data.drop_last and not eval_data.pad_final_batch:
+                # P("data") placement needs full batches; wrap-padding
+                # slightly over-weights the wrapped samples in the mean — the
+                # same DistributedSampler semantic the training path uses.
+                eval_data.pad_final_batch = True
+        losses = []
+        for xs, ys in eval_data:
+            # Keep device scalars; one host sync after the loop.
+            losses.append(self._eval_step(self.state, self._put_batch(xs, ys)))
+        eval_loss = float(np.mean([float(l) for l in losses])) if losses else 0.0
+        self.metrics.log(int(self.state.step), eval_loss=eval_loss)
+        return eval_loss
 
     def train(self, max_epochs: int) -> None:
         """Epoch loop with snapshot/checkpoint cadence (twin of ``train``,

@@ -1,0 +1,116 @@
+"""Evaluation-path tests: forward-only loss, BatchNorm eval mode, sharding."""
+
+import jax
+import numpy as np
+import optax
+
+from distributed_pytorch_tpu.models import ResNet18, ToyRegressor
+from distributed_pytorch_tpu.parallel.mesh import make_mesh
+from distributed_pytorch_tpu.training.losses import (
+    mse_loss,
+    softmax_cross_entropy_loss,
+)
+from distributed_pytorch_tpu.training.trainer import Trainer
+from distributed_pytorch_tpu.utils.data import MaterializedDataset, ShardedLoader
+
+
+def test_evaluate_matches_train_loss_for_stateless_model():
+    """For a stateless model with frozen params, eval loss on the training
+    data equals the loss the next train step reports (pre-update)."""
+    data = MaterializedDataset(64)
+    loader = ShardedLoader(data, 64)
+    trainer = Trainer(ToyRegressor(), loader, optax.sgd(0.0), save_every=0,
+                      loss_fn=mse_loss)
+    eval_loss = trainer.evaluate(ShardedLoader(data, 64))
+    (xs, ys) = next(iter(loader))
+    _, train_loss = trainer.train_step(trainer.state, trainer._put_batch(xs, ys))
+    np.testing.assert_allclose(eval_loss, float(train_loss), rtol=1e-6)
+
+
+def test_evaluate_does_not_mutate_state():
+    data = MaterializedDataset(32)
+    trainer = Trainer(ToyRegressor(), ShardedLoader(data, 32), optax.sgd(1e-3),
+                      save_every=0, loss_fn=mse_loss)
+    before = jax.tree_util.tree_map(np.asarray, trainer.state.params)
+    step_before = int(trainer.state.step)
+    trainer.evaluate(ShardedLoader(data, 16))
+    after = jax.tree_util.tree_map(np.asarray, trainer.state.params)
+    jax.tree_util.tree_map(np.testing.assert_array_equal, before, after)
+    assert int(trainer.state.step) == step_before
+
+
+def test_evaluate_batchnorm_uses_running_stats():
+    """ResNet eval must run with use_running_average=True: identical inputs in
+    different batch compositions give identical per-sample outputs (train-mode
+    BN would normalize by the batch's own stats and differ)."""
+    rng = np.random.default_rng(0)
+
+    class TinyImages:
+        def __init__(self):
+            self.inputs = rng.standard_normal((16, 8, 8, 3)).astype(np.float32)
+            self.targets = rng.integers(0, 4, (16,)).astype(np.int32)
+
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return self.inputs[i], self.targets[i]
+
+    data = TinyImages()
+    trainer = Trainer(
+        ResNet18(num_classes=4), ShardedLoader(data, 8), optax.sgd(1e-2),
+        save_every=0, loss_fn=softmax_cross_entropy_loss,
+    )
+    trainer.train(1)  # accumulate some running stats
+    variables = {"params": trainer.state.params, **trainer.state.model_state}
+    full = trainer._eval_apply(variables, data.inputs)
+    halves = np.concatenate([
+        np.asarray(trainer._eval_apply(variables, data.inputs[:8])),
+        np.asarray(trainer._eval_apply(variables, data.inputs[8:])),
+    ])
+    np.testing.assert_allclose(np.asarray(full), halves, atol=1e-5)
+
+
+def test_evaluate_includes_moe_aux_loss():
+    """Eval loss must include sown penalty terms, matching the train-step
+    loss definition (frozen params + same batch => identical numbers)."""
+    from distributed_pytorch_tpu.models import TransformerLM
+
+    rng = np.random.default_rng(2)
+
+    class Tokens:
+        def __init__(self):
+            toks = rng.integers(0, 32, (8, 17), dtype=np.int32)
+            self.inputs, self.targets = toks[:, :-1], toks[:, 1:]
+
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return self.inputs[i], self.targets[i]
+
+    data = Tokens()
+    model = TransformerLM(
+        vocab_size=32, d_model=16, n_layers=2, n_heads=2, d_ff=32,
+        n_experts=2, moe_every=2,
+    )
+    trainer = Trainer(
+        model, ShardedLoader(data, 8), optax.sgd(0.0), save_every=0,
+        loss_fn=softmax_cross_entropy_loss,
+    )
+    eval_loss = trainer.evaluate(ShardedLoader(data, 8))
+    xs, ys = next(iter(ShardedLoader(data, 8)))
+    _, train_loss = trainer.train_step(trainer.state, trainer._put_batch(xs, ys))
+    np.testing.assert_allclose(eval_loss, float(train_loss), rtol=1e-6)
+
+
+def test_evaluate_sharded():
+    data = MaterializedDataset(96)
+    mesh = make_mesh({"data": 8})
+    trainer = Trainer(ToyRegressor(), ShardedLoader(data, 32), optax.sgd(1e-3),
+                      save_every=0, mesh=mesh, loss_fn=mse_loss)
+    serial = Trainer(ToyRegressor(), ShardedLoader(data, 32), optax.sgd(1e-3),
+                     save_every=0, loss_fn=mse_loss)
+    sharded_loss = trainer.evaluate(ShardedLoader(data, 32))
+    serial_loss = serial.evaluate(ShardedLoader(data, 32))
+    np.testing.assert_allclose(sharded_loss, serial_loss, rtol=1e-6)

@@ -25,11 +25,13 @@ there is no user-space NCCL analog.
 """
 
 from distributed_pytorch_tpu.checkpoint import (
+    AsyncCheckpointer,
     load_checkpoint,
     load_snapshot,
     save_checkpoint,
     save_snapshot,
 )
+from distributed_pytorch_tpu.generation import generate
 from distributed_pytorch_tpu.parallel.bootstrap import (
     is_main_process,
     setup_distributed,
@@ -53,8 +55,10 @@ from distributed_pytorch_tpu.utils.data import (
 __version__ = "0.1.0"
 
 __all__ = [
+    "AsyncCheckpointer",
     "MaterializedDataset",
     "NativeShardedLoader",
+    "generate",
     "RandomDataset",
     "ShardedLoader",
     "StepProfiler",

@@ -1,0 +1,133 @@
+"""Autoregressive text generation with a KV cache — the inference side of the
+LM family.
+
+No reference analog (the reference is a training tutorial); a complete
+framework needs a sampling path, and on TPU it must be a SINGLE compiled
+program, not a Python token loop: the whole generate pass is one
+``lax.fori_loop`` inside ``jit``, so XLA pipelines the per-token steps and the
+host is never in the loop.
+
+Mechanics:
+
+* the model is cloned with ``decode=True`` (:class:`..models.transformer
+  .TransformerLM`); each attention layer carries ``cached_key``/``cached_value``
+  buffers sized ``[B, max_len, H, D]`` plus a running ``cache_index``;
+* each loop step feeds ONE token per sequence, updates the caches in place
+  (functionally — donated buffers under jit), and samples the next token
+  (greedy, temperature, optional top-k);
+* prompt handling needs no separate prefill phase: while ``t`` is inside the
+  prompt the sampled token is discarded in favor of the prompt token, so
+  prompts of ragged lengths work with one code path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def generate(
+    model,
+    params,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    *,
+    prompt_lengths: Optional[jnp.ndarray] = None,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    rng: Optional[jax.Array] = None,
+    pad_token: int = 0,
+) -> jnp.ndarray:
+    """Generate ``max_new_tokens`` continuations for ``prompt`` ``[B, T0]``.
+
+    ``temperature=0`` is greedy argmax; otherwise softmax sampling at the
+    given temperature, optionally truncated to the ``top_k`` most likely
+    tokens. ``prompt_lengths`` ([B]) supports ragged prompts padded to T0
+    with ``pad_token`` — generation for each row starts after its own length.
+    Returns ``[B, T0 + max_new_tokens]`` token ids.
+    """
+    decode_model = model.clone(decode=True)
+    batch, prompt_len = prompt.shape
+    total_len = prompt_len + max_new_tokens
+    if prompt_lengths is None:
+        prompt_lengths = jnp.full((batch,), prompt_len, jnp.int32)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    # Size the KV caches from abstract shapes only — eval_shape traces init
+    # without running it, so no throwaway params and no full-length forward.
+    abstract = jax.eval_shape(
+        decode_model.init,
+        jax.random.PRNGKey(0),
+        jnp.zeros((batch, total_len), jnp.int32),
+    )["cache"]
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), abstract
+    )
+
+    tokens0 = jnp.concatenate(
+        [
+            jnp.asarray(prompt, jnp.int32),
+            jnp.full((batch, max_new_tokens), pad_token, jnp.int32),
+        ],
+        axis=1,
+    )
+
+    run = _compiled_run(decode_model, total_len, float(temperature), int(top_k))
+    return run(params, tokens0, cache, jnp.asarray(prompt_lengths), rng)
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_run(decode_model, total_len: int, temperature: float, top_k: int):
+    """Jitted decode loop, cached per (model config, length, sampling config)
+    so repeated generate() calls with the same shapes reuse the executable
+    (flax modules are frozen dataclasses, hence hashable cache keys)."""
+
+    def sample(logits, step_rng):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits / temperature
+        if top_k > 0:
+            kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+        return jax.random.categorical(step_rng, scaled).astype(jnp.int32)
+
+    def run(params, tokens, cache, prompt_lengths, rng):
+        batch = tokens.shape[0]
+
+        def body(t, carry):
+            tokens, cache, rng = carry
+            current = jax.lax.dynamic_slice(tokens, (0, t), (batch, 1))
+            logits, updated = decode_model.apply(
+                {"params": params, "cache": cache}, current, mutable=["cache"]
+            )
+            cache = updated["cache"]
+            rng, step_rng = jax.random.split(rng)
+            proposed = sample(logits[:, -1, :], step_rng)  # [B]
+            # Inside each row's prompt, keep the prompt token; past it, take
+            # the sample. (t+1 is the position being decided.)
+            keep_prompt = (t + 1) < prompt_lengths
+            existing = jax.lax.dynamic_slice(tokens, (0, t + 1), (batch, 1))[:, 0]
+            next_token = jnp.where(keep_prompt, existing, proposed)
+            tokens = jax.lax.dynamic_update_slice(
+                tokens, next_token[:, None], (0, t + 1)
+            )
+            return tokens, cache, rng
+
+        tokens, _, _ = jax.lax.fori_loop(
+            0, total_len - 1, body, (tokens, cache, rng)
+        )
+        return tokens
+
+    return jax.jit(run, donate_argnums=(2,))
+
+
+def generate_text_ids(model, params, prompt_ids, max_new_tokens, **kw) -> np.ndarray:
+    """Convenience wrapper returning numpy ids."""
+    return np.asarray(
+        generate(model, params, jnp.asarray(prompt_ids), max_new_tokens, **kw)
+    )

@@ -19,20 +19,31 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from distributed_pytorch_tpu.models.moe import MoEMLP
-from distributed_pytorch_tpu.ops.attention import ring_attention
+from distributed_pytorch_tpu.ops.attention import NEG_INF, ring_attention
 from distributed_pytorch_tpu.ops.flash_attention import flash_attention
 
 
-def apply_rope(x: jnp.ndarray, *, theta: float = 10000.0) -> jnp.ndarray:
-    """Rotary position embedding over [B, T, H, D] (global positions 0..T-1)."""
+def apply_rope(
+    x: jnp.ndarray,
+    *,
+    theta: float = 10000.0,
+    positions: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Rotary position embedding over [B, T, H, D].
+
+    ``positions`` ([T] int/float) defaults to global positions 0..T-1; the
+    decode path passes the cache offset so a single-token step rotates by its
+    absolute position."""
     d_half = x.shape[-1] // 2
     freqs = theta ** (-jnp.arange(0, d_half, dtype=jnp.float32) / d_half)
-    positions = jnp.arange(x.shape[1], dtype=jnp.float32)
-    angles = positions[:, None] * freqs[None, :]  # [T, D/2]
+    if positions is None:
+        positions = jnp.arange(x.shape[1], dtype=jnp.float32)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, D/2]
     cos = jnp.cos(angles)[None, :, None, :]
     sin = jnp.sin(angles)[None, :, None, :]
     x1, x2 = x[..., :d_half], x[..., d_half:]
@@ -57,6 +68,7 @@ class Attention(nn.Module):
     causal: bool = True
     mesh: Optional[Mesh] = None
     sequence_axis: Optional[str] = None
+    decode: bool = False  # autoregressive KV-cache mode (see generation.py)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -64,9 +76,26 @@ class Attention(nn.Module):
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
             (self.n_heads, head_dim), dtype=self.dtype, name=name
         )
-        q = apply_rope(dense("query")(x))
-        k = apply_rope(dense("key")(x))
+        q_raw = dense("query")(x)
+        k_raw = dense("key")(x)
         v = dense("value")(x)
+
+        if self.decode and self.has_variable("cache", "cached_key"):
+            out = self._decode_step(q_raw, k_raw, v)
+            return nn.DenseGeneral(
+                self.d_model, axis=(-2, -1), dtype=self.dtype, name="out"
+            )(out)
+        if self.decode:
+            # Cache init pass: size the KV cache to this call's (max) length,
+            # then fall through to the normal causal forward.
+            self.variable("cache", "cached_key", jnp.zeros, k_raw.shape, k_raw.dtype)
+            self.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
+            self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+
+        q = apply_rope(q_raw)
+        k = apply_rope(k_raw)
 
         use_ring = (
             self.mesh is not None
@@ -85,6 +114,40 @@ class Attention(nn.Module):
         return nn.DenseGeneral(
             self.d_model, axis=(-2, -1), dtype=self.dtype, name="out"
         )(out)
+
+    def _decode_step(self, q_raw, k_raw, v):
+        """One autoregressive step: rotate q/k by their absolute positions,
+        write k/v into the cache at the running index, attend q against the
+        valid cache prefix. ``q_raw``: [B, T_step, H, D] (T_step usually 1)."""
+        cached_key = self.variable("cache", "cached_key", lambda: None)
+        cached_value = self.variable("cache", "cached_value", lambda: None)
+        cache_index = self.variable("cache", "cache_index", lambda: None)
+        index = cache_index.value
+        t_step = q_raw.shape[1]
+        max_len = cached_key.value.shape[1]
+
+        positions = index + jnp.arange(t_step)
+        q = apply_rope(q_raw, positions=positions)
+        k = apply_rope(k_raw, positions=positions)
+
+        cached_key.value = jax.lax.dynamic_update_slice(
+            cached_key.value, k.astype(cached_key.value.dtype), (0, index, 0, 0)
+        )
+        cached_value.value = jax.lax.dynamic_update_slice(
+            cached_value.value, v.astype(cached_value.value.dtype), (0, index, 0, 0)
+        )
+        cache_index.value = index + t_step
+
+        keys, values = cached_key.value, cached_value.value
+        scale = q.shape[-1] ** -0.5
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, keys) * scale
+        # Position k is visible to step-q q when k <= index + q.
+        visible = (
+            jnp.arange(max_len)[None, :] <= (index + jnp.arange(t_step))[:, None]
+        )
+        logits = jnp.where(visible[None, None], logits, NEG_INF)
+        weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", weights, values)
 
 
 class MLPBlock(nn.Module):
@@ -108,12 +171,13 @@ class TransformerBlock(nn.Module):
     mesh: Optional[Mesh] = None
     sequence_axis: Optional[str] = None
     n_experts: int = 0  # >0 swaps the dense MLP for an expert-parallel MoEMLP
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         x = x + Attention(
             self.n_heads, self.d_model, self.dtype, self.causal,
-            self.mesh, self.sequence_axis, name="attention",
+            self.mesh, self.sequence_axis, self.decode, name="attention",
         )(nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x))
         if self.n_experts > 0:
             mlp = MoEMLP(
@@ -140,6 +204,7 @@ class TransformerLM(nn.Module):
     sequence_axis: Optional[str] = None
     n_experts: int = 0  # >0: MoE MLPs in every `moe_every`-th block
     moe_every: int = 2
+    decode: bool = False  # KV-cache autoregressive mode (see generation.py)
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
@@ -154,7 +219,8 @@ class TransformerLM(nn.Module):
             moe = self.n_experts if (i + 1) % self.moe_every == 0 else 0
             x = block(
                 self.n_heads, self.d_model, self.d_ff, self.dtype,
-                True, self.mesh, self.sequence_axis, moe, name=f"block_{i}",
+                True, self.mesh, self.sequence_axis, moe, self.decode,
+                name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         # Logits in float32 for a numerically stable softmax-cross-entropy.

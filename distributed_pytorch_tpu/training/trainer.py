@@ -269,12 +269,23 @@ class Trainer:
                 # P("data") placement needs full batches; wrap-padding
                 # slightly over-weights the wrapped samples in the mean — the
                 # same DistributedSampler semantic the training path uses.
+                # Pad a COPY: the caller's loader must not change behavior.
+                import copy
+
+                eval_data = copy.copy(eval_data)
                 eval_data.pad_final_batch = True
-        losses = []
+        losses, weights = [], []
         for xs, ys in eval_data:
-            # Keep device scalars; one host sync after the loop.
+            # Keep device scalars; one host sync after the loop. Weight by
+            # batch size so a ragged final batch doesn't skew the mean.
             losses.append(self._eval_step(self.state, self._put_batch(xs, ys)))
-        eval_loss = float(np.mean([float(l) for l in losses])) if losses else 0.0
+            weights.append(xs.shape[0])
+        if losses:
+            eval_loss = float(
+                np.average([float(l) for l in losses], weights=weights)
+            )
+        else:
+            eval_loss = 0.0
         self.metrics.log(int(self.state.step), eval_loss=eval_loss)
         return eval_loss
 

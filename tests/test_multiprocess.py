@@ -58,11 +58,19 @@ def launch_workers(n_procs, args, *, fake_devices, port, extra_env=None):
             )
         )
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=300)
-        outs.append(out)
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{out}"
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, f"worker failed:\n{out}"
+    finally:
+        # A hung rendezvous must not leak workers (they hold the coordinator
+        # port and would poison subsequent runs).
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     return outs
 
 

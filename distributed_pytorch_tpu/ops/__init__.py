@@ -1,1 +1,17 @@
-# Pallas/custom-op kernels live here (see distributed_pytorch_tpu/ops/).
+"""Hot ops: attention cores (dense / ring / Pallas flash) and fused losses."""
+
+from distributed_pytorch_tpu.ops.attention import (
+    dot_product_attention,
+    ring_attention,
+)
+from distributed_pytorch_tpu.ops.flash_attention import flash_attention
+from distributed_pytorch_tpu.ops.fused_cross_entropy import (
+    fused_linear_cross_entropy,
+)
+
+__all__ = [
+    "dot_product_attention",
+    "flash_attention",
+    "fused_linear_cross_entropy",
+    "ring_attention",
+]

@@ -23,8 +23,27 @@ import subprocess
 import threading
 
 _NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
-_BUILD_DIR = os.path.join(_NATIVE_DIR, "_build")
 _BUILD_LOCK = threading.Lock()
+
+
+def _build_dir() -> str:
+    """Output dir for compiled artifacts.
+
+    Prefer ``_build/`` next to the sources (editable installs, repo
+    checkouts); when the package dir is read-only (non-editable wheel in
+    system site-packages) fall back to a per-user cache dir keyed by the
+    source location, so distinct installs never share stale binaries.
+    """
+    preferred = os.path.join(_NATIVE_DIR, "_build")
+    if os.access(_NATIVE_DIR, os.W_OK):
+        return preferred
+    import hashlib
+
+    key = hashlib.sha256(_NATIVE_DIR.encode()).hexdigest()[:16]
+    cache_root = os.environ.get(
+        "XDG_CACHE_HOME", os.path.join(os.path.expanduser("~"), ".cache")
+    )
+    return os.path.join(cache_root, "distributed_pytorch_tpu", key)
 
 
 def _needs_rebuild(src: str, out: str) -> bool:
@@ -34,11 +53,12 @@ def _needs_rebuild(src: str, out: str) -> bool:
 def _compile(src_name: str, out_name: str, *, shared: bool) -> str:
     """Compile ``src_name`` (in this dir) to ``_build/out_name`` if stale."""
     src = os.path.join(_NATIVE_DIR, src_name)
-    out = os.path.join(_BUILD_DIR, out_name)
+    build_dir = _build_dir()
+    out = os.path.join(build_dir, out_name)
     with _BUILD_LOCK:
         if not _needs_rebuild(src, out):
             return out
-        os.makedirs(_BUILD_DIR, exist_ok=True)
+        os.makedirs(build_dir, exist_ok=True)
         cmd = ["g++", "-O2", "-std=c++17", "-pthread"]
         if shared:
             cmd += ["-fPIC", "-shared"]

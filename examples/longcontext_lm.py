@@ -13,11 +13,7 @@ Run:  python examples/longcontext_lm.py --steps 20 --seq_len 2048 \
 """
 
 import argparse
-import os
-import sys
 import time
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main(args):

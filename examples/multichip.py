@@ -14,10 +14,7 @@ Run:  python examples/multichip.py 10 2 [--batch_size 32]
 """
 
 import argparse
-import os
-import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import optax

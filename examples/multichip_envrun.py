@@ -18,10 +18,7 @@ Run (N processes):    COORDINATOR_ADDRESS=host0:1234 NUM_PROCESSES=N PROCESS_ID=
 """
 
 import argparse
-import os
-import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import optax

@@ -15,10 +15,7 @@ Run:  python examples/multichip_profile.py [--epochs 3] [--batch_size 32] [--bf1
 """
 
 import argparse
-import os
-import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

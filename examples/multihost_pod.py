@@ -22,10 +22,7 @@ Run on a pod slice (from launch/tpu_pod_run.sh):
 """
 
 import argparse
-import os
-import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import optax

@@ -12,10 +12,6 @@ Run:  python examples/parallelism_4d.py --steps 10 --fake_devices 8
 """
 
 import argparse
-import os
-import sys
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def run_config(name, model, mesh, rules, tokens, steps, batch_spec=None):

@@ -24,4 +24,4 @@ SAVE_EVERY="${2:-5}"
 gcloud compute tpus tpu-vm ssh "$TPU_NAME" \
   --zone="$ZONE" \
   --worker=all \
-  --command="cd $REPO_DIR && python examples/multihost_pod.py $TOTAL_EPOCHS $SAVE_EVERY"
+  --command="cd $REPO_DIR && pip install -q -e . && python examples/multihost_pod.py $TOTAL_EPOCHS $SAVE_EVERY"

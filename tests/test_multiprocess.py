@@ -38,6 +38,7 @@ def launch_workers(n_procs, args, *, fake_devices, port, extra_env=None):
             NUM_PROCESSES=str(n_procs),
             PROCESS_ID=str(pid),
             JAX_PLATFORMS="cpu",
+            PYTHONPATH=REPO,
         )
         env.pop("XLA_FLAGS", None)  # the example sets device count itself
         env.update(extra_env or {})
@@ -113,7 +114,7 @@ def test_two_process_parity_and_single_writer(tmp_path):
             "--fake_devices", "8",
         ],
         cwd=REPO,
-        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO},
         capture_output=True,
         text=True,
         timeout=300,

@@ -46,6 +46,8 @@ struct Loader {
   std::atomic<long> next_claim{0};
   long next_deliver = 0;
   bool stopping = false;
+  int consumers_inflight = 0;  // prefetch_next calls currently executing
+  std::condition_variable cv_consumer_done;
   std::vector<std::thread> workers;
 };
 
@@ -115,10 +117,22 @@ int prefetch_next(void* handle, char* out_x, char* out_y) {
   int slot;
   {
     std::unique_lock<std::mutex> lock(ld->mu);
-    if (ld->next_deliver >= ld->n_batches) return 0;
+    if (ld->stopping || ld->next_deliver >= ld->n_batches) return 0;
+    ++ld->consumers_inflight;
     b = ld->next_deliver;
     slot = static_cast<int>(b % ld->depth);
-    ld->cv_slot_ready.wait(lock, [&] { return ld->state[slot] == kReady; });
+    // Also wake on stopping: a cross-thread destroy must not strand a
+    // blocked consumer forever (mirrors the worker-side wait predicate).
+    ld->cv_slot_ready.wait(
+        lock, [&] { return ld->state[slot] == kReady || ld->stopping; });
+    if (ld->stopping) {
+      // Notify while still holding the mutex: the moment the lock is
+      // released with consumers_inflight == 0, destroy may delete ld, so
+      // no ld member may be touched outside the lock from here on.
+      --ld->consumers_inflight;
+      ld->cv_consumer_done.notify_all();
+      return 0;
+    }
   }
   std::memcpy(out_x, ld->slot_x[slot].data(), ld->batch * ld->row_x);
   std::memcpy(out_y, ld->slot_y[slot].data(), ld->batch * ld->row_y);
@@ -126,20 +140,29 @@ int prefetch_next(void* handle, char* out_x, char* out_y) {
     std::lock_guard<std::mutex> lock(ld->mu);
     ld->state[slot] = kEmpty;
     ld->next_deliver = b + 1;
+    --ld->consumers_inflight;
+    // Same rule: notify under the lock — after release, ld may be gone.
+    ld->cv_slot_free.notify_all();
+    ld->cv_consumer_done.notify_all();
   }
-  ld->cv_slot_free.notify_all();
   return 1;
 }
 
 void prefetch_destroy(void* handle) {
   auto* ld = static_cast<Loader*>(handle);
   {
-    std::lock_guard<std::mutex> lock(ld->mu);
+    std::unique_lock<std::mutex> lock(ld->mu);
     ld->stopping = true;
+    ld->cv_slot_free.notify_all();
+    ld->cv_slot_ready.notify_all();
+    // A consumer between its predicate check and memcpy (mutex released)
+    // still touches slot buffers; wait until no prefetch_next is in flight
+    // before tearing the Loader down, so cross-thread destroy is safe.
+    ld->cv_consumer_done.wait(lock, [&] { return ld->consumers_inflight == 0; });
   }
-  ld->cv_slot_free.notify_all();
   // Unblock any worker waiting to fill by draining claims.
   ld->next_claim.store(ld->n_batches);
+  ld->cv_slot_free.notify_all();
   for (auto& t : ld->workers) t.join();
   delete ld;
 }

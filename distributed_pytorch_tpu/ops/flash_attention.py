@@ -375,6 +375,13 @@ def flash_attention(
     block_k = _fit_block(block_k, t)
     if block_q is None or block_k is None:
         return dot_product_attention(q, k, v, causal=causal)
+    # block_k is the lane dimension of the [block_q, block_k] score tile; on
+    # real hardware Mosaic wants lanes in multiples of 128 (interpret mode
+    # doesn't care). Sequence lengths whose only divisors are smaller than
+    # that (e.g. T=40) take the dense path instead of risking a lowering
+    # failure or a badly tiled kernel.
+    if not interpret and block_k % 128 != 0:
+        return dot_product_attention(q, k, v, causal=causal)
 
     def run_local(ql, kl, vl):
         bl, tl, hl, dl = ql.shape

@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import Mesh
@@ -253,7 +254,16 @@ class Trainer:
     def evaluate(self, eval_data: ShardedLoader) -> float:
         """Forward-only mean loss over ``eval_data`` (no gradients, no state
         mutation). No reference analog — the reference never evaluates
-        (SURVEY.md §5: loss is computed but not even logged)."""
+        (SURVEY.md §5: loss is computed but not even logged).
+
+        Padding bias: on a mesh, an uneven final batch is wrap-padded to full
+        size, and the padded batch's mean counts the wrapped duplicates — the
+        same semantic ``DistributedSampler`` applies (and the training path
+        uses), so the eval loss is very slightly biased toward the wrapped
+        samples. ``loss_fn`` is an opaque scalar reduction, so the exact
+        distinct-sample mean would need per-sample losses; pass a dataset
+        divisible by the batch size (or ``drop_last=True``) when the
+        distinction matters."""
         if self._eval_step is None:
             self._eval_step = make_eval_step(
                 self._eval_apply, self.loss_fn, mesh=self.mesh
@@ -277,13 +287,17 @@ class Trainer:
         losses, weights = [], []
         for xs, ys in eval_data:
             # Keep device scalars; one host sync after the loop. Weight by
-            # batch size so a ragged final batch doesn't skew the mean.
+            # the actual batch size: exact for ragged batches; for a
+            # wrap-padded batch the duplicates are inside the device mean, so
+            # the padded size IS the consistent weight (see docstring).
             losses.append(self._eval_step(self.state, self._put_batch(xs, ys)))
             weights.append(xs.shape[0])
         if losses:
-            eval_loss = float(
-                np.average([float(l) for l in losses], weights=weights)
-            )
+            # Stack on device and fetch ONCE: on remote-tunnel backends the
+            # value fetch is the only real sync, and per-scalar fetches would
+            # cost a round trip per eval batch.
+            host_losses = np.asarray(jnp.stack(losses))
+            eval_loss = float(np.average(host_losses, weights=weights))
         else:
             eval_loss = 0.0
         self.metrics.log(int(self.state.step), eval_loss=eval_loss)

@@ -211,6 +211,28 @@ class NativeShardedLoader(ShardedLoader):
         self.prefetch_depth = prefetch_depth
         self._x = np.ascontiguousarray(self.dataset.inputs)
         self._y = np.ascontiguousarray(self.dataset.targets)
+        # The pool gathers rows straight from .inputs/.targets, bypassing
+        # __getitem__. A dataset whose __getitem__ applies a transform would
+        # pass the attribute check above yet yield different batches than
+        # ShardedLoader — probe one sample to keep the "identical contents"
+        # contract honest.
+        if len(self.dataset):
+            x0, y0 = self.dataset[0]
+
+            def same(a, b):
+                try:
+                    # equal_nan: a stored NaN (masked feature) must not read
+                    # as "__getitem__ transformed the data".
+                    return np.array_equal(np.asarray(a), b, equal_nan=True)
+                except TypeError:  # non-float dtype rejects equal_nan
+                    return np.array_equal(np.asarray(a), b)
+
+            if not (same(x0, self._x[0]) and same(y0, self._y[0])):
+                raise TypeError(
+                    "NativeShardedLoader requires dataset[i] == "
+                    "(dataset.inputs[i], dataset.targets[i]); this dataset's "
+                    "__getitem__ transforms the stored arrays"
+                )
 
     def __iter__(self) -> Iterator[Batch]:
         import ctypes

@@ -122,3 +122,15 @@ def test_pad_final_batch_tiny_dataset_wraps():
     loader = ShardedLoader(ds, 8, pad_final_batch=True)
     (xs, _), = list(loader)
     assert xs.shape[0] == 8
+
+
+def test_native_loader_rejects_transforming_getitem():
+    from distributed_pytorch_tpu.utils.data import NativeShardedLoader
+
+    class Transforming(MaterializedDataset):
+        def __getitem__(self, i):
+            x, y = super().__getitem__(i)
+            return x * 2.0, y  # stored arrays no longer match __getitem__
+
+    with pytest.raises(TypeError, match="__getitem__"):
+        NativeShardedLoader(Transforming(16), 4)

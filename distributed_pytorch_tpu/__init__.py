@@ -46,6 +46,7 @@ from distributed_pytorch_tpu.training.losses import (
 from distributed_pytorch_tpu.training.train_step import TrainState, make_train_step
 from distributed_pytorch_tpu.training.trainer import Trainer
 from distributed_pytorch_tpu.utils.data import (
+    ArrayDataset,
     MaterializedDataset,
     NativeShardedLoader,
     RandomDataset,
@@ -56,6 +57,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "AsyncCheckpointer",
+    "ArrayDataset",
     "MaterializedDataset",
     "NativeShardedLoader",
     "generate",

@@ -26,6 +26,9 @@ from jax.sharding import Mesh
 from distributed_pytorch_tpu.models.moe import MoEMLP
 from distributed_pytorch_tpu.ops.attention import NEG_INF, ring_attention
 from distributed_pytorch_tpu.ops.flash_attention import flash_attention
+from distributed_pytorch_tpu.ops.fused_cross_entropy import (
+    fused_linear_cross_entropy,
+)
 
 
 def apply_rope(
@@ -190,8 +193,59 @@ class TransformerBlock(nn.Module):
         return x
 
 
+class LMHead(nn.Module):
+    """The LM projection with an optional fused-loss path.
+
+    Parameters are declared directly (``kernel``/``bias``) with the same
+    names, shapes, and initializers ``nn.Dense(name="lm_head")`` would create,
+    so the param tree — and pinned-seed initialization — is byte-identical
+    whether or not the fused path is enabled, and checkpoints move freely
+    between the two.
+
+    * ``targets is None`` (or ``fused_chunk == 0``): returns float32 logits
+      ``[..., vocab]`` — the standard path, used by generation and eval.
+    * fused path: returns the scalar mean cross-entropy via
+      :func:`fused_linear_cross_entropy` — the ``[N, vocab]`` logits tensor
+      (an LM's largest activation) is never materialized in forward or
+      backward.
+    """
+
+    vocab_size: int
+    fused_chunk: int = 0
+
+    @nn.compact
+    def __call__(
+        self, x: jnp.ndarray, targets: Optional[jnp.ndarray] = None
+    ) -> jnp.ndarray:
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (x.shape[-1], self.vocab_size),
+            jnp.float32,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), (self.vocab_size,), jnp.float32
+        )
+        if self.fused_chunk and targets is not None:
+            return fused_linear_cross_entropy(
+                x.reshape(-1, x.shape[-1]),
+                kernel,
+                bias,
+                targets.reshape(-1),
+                self.fused_chunk,
+            )
+        # Logits in float32 for a numerically stable softmax-cross-entropy.
+        return x.astype(jnp.float32) @ kernel + bias
+
+
 class TransformerLM(nn.Module):
-    """GPT-style causal LM over token ids ``[batch, seq] -> [batch, seq, vocab]``."""
+    """GPT-style causal LM over token ids ``[batch, seq] -> [batch, seq, vocab]``.
+
+    With ``fused_head_chunk > 0`` AND ``targets`` passed to ``__call__``, the
+    model instead returns the scalar mean next-token cross-entropy computed by
+    the fused LM head (the logits tensor is never materialized); the train
+    step passes targets through when built with ``apply_takes_targets=True``.
+    """
 
     vocab_size: int = 32000
     d_model: int = 512
@@ -205,9 +259,12 @@ class TransformerLM(nn.Module):
     n_experts: int = 0  # >0: MoE MLPs in every `moe_every`-th block
     moe_every: int = 2
     decode: bool = False  # KV-cache autoregressive mode (see generation.py)
+    fused_head_chunk: int = 0  # >0: vocab chunk size for the fused CE head
 
     @nn.compact
-    def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
+    def __call__(
+        self, tokens: jnp.ndarray, targets: Optional[jnp.ndarray] = None
+    ) -> jnp.ndarray:
         x = nn.Embed(
             self.vocab_size, self.d_model, dtype=self.dtype, name="embed"
         )(tokens)
@@ -223,5 +280,14 @@ class TransformerLM(nn.Module):
                 name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
-        # Logits in float32 for a numerically stable softmax-cross-entropy.
-        return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(x)
+        if self.fused_head_chunk and self.vocab_size % self.fused_head_chunk:
+            # Fail loudly here: a silent dense fallback would surface later as
+            # a baffling "gradient only defined for scalar-output functions"
+            # from the train step (which expects the fused scalar loss).
+            raise ValueError(
+                f"vocab_size {self.vocab_size} not divisible by "
+                f"fused_head_chunk {self.fused_head_chunk}"
+            )
+        return LMHead(
+            self.vocab_size, self.fused_head_chunk, name="lm_head"
+        )(x, targets)

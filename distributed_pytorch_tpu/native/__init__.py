@@ -102,6 +102,8 @@ def prefetch_library() -> ctypes.CDLL:
     ]
     lib.prefetch_next.restype = ctypes.c_int
     lib.prefetch_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    lib.prefetch_stop.restype = None
+    lib.prefetch_stop.argtypes = [ctypes.c_void_p]
     lib.prefetch_destroy.restype = None
     lib.prefetch_destroy.argtypes = [ctypes.c_void_p]
     _PREFETCH_LIB = lib

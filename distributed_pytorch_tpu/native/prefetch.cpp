@@ -148,6 +148,21 @@ int prefetch_next(void* handle, char* out_x, char* out_y) {
   return 1;
 }
 
+// Ends the stream without freeing: any prefetch_next blocked in its wait (or
+// starting afterwards) returns 0. Safe to call from any thread while a
+// consumer is mid-call — the teardown contract is: stop() from anywhere,
+// let the consumer loop exit, THEN destroy() (a prefetch_next that *starts*
+// after destroy would touch freed memory, same as any handle API).
+void prefetch_stop(void* handle) {
+  auto* ld = static_cast<Loader*>(handle);
+  {
+    std::lock_guard<std::mutex> lock(ld->mu);
+    ld->stopping = true;
+    ld->cv_slot_free.notify_all();
+    ld->cv_slot_ready.notify_all();
+  }
+}
+
 void prefetch_destroy(void* handle) {
   auto* ld = static_cast<Loader*>(handle);
   {
@@ -157,7 +172,9 @@ void prefetch_destroy(void* handle) {
     ld->cv_slot_ready.notify_all();
     // A consumer between its predicate check and memcpy (mutex released)
     // still touches slot buffers; wait until no prefetch_next is in flight
-    // before tearing the Loader down, so cross-thread destroy is safe.
+    // before tearing the Loader down. Calls that START after this point are
+    // the caller's responsibility (use prefetch_stop to end a foreign
+    // consumer loop first).
     ld->cv_consumer_done.wait(lock, [&] { return ld->consumers_inflight == 0; });
   }
   // Unblock any worker waiting to fill by draining claims.

@@ -60,6 +60,46 @@ def dot_product_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
+def _dense_hop(q32, k_blk, v_blk, *, causal_mask_offset=None):
+    """One ring hop's local attention with its logsumexp, dense XLA math.
+    ``q32``: [B, Tq, H, D] fp32; ``k_blk``/``v_blk``: [B, Tk, H, D].
+    ``causal_mask_offset``: (q_pos, kv_pos) arrays for the diagonal hop, None
+    for a fully-visible hop. Returns ``(o [B,Tq,H,D] f32, lse [B,H,Tq] f32)``."""
+    scale = q32.shape[-1] ** -0.5
+    logits = (
+        jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
+    )
+    if causal_mask_offset is not None:
+        q_pos, kv_pos = causal_mask_offset
+        logits = jnp.where(q_pos[:, None] >= kv_pos[None, :], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1)  # [B,H,Tq]
+    p = jnp.exp(logits - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+    o = o / l.transpose(0, 2, 1)[..., None]
+    return o, m + jnp.log(l)
+
+
+def _flash_hop(q, k_blk, v_blk, *, causal, block_q, block_k, interpret):
+    """One ring hop through the Pallas flash kernel (``[B,T,H,D]`` in/out,
+    ``lse`` reshaped to the merge layout ``[B,H,Tq]``)."""
+    from distributed_pytorch_tpu.ops.flash_attention import (
+        flash_attention_with_lse,
+    )
+
+    b, t, h, d = q.shape
+
+    def to3(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    o3, lse3 = flash_attention_with_lse(
+        to3(q), to3(k_blk), to3(v_blk), causal, block_q, block_k, interpret
+    )
+    o = o3.reshape(b, h, t, d).transpose(0, 2, 1, 3).astype(jnp.float32)
+    lse = lse3[..., 0].reshape(b, h, t)
+    return o, lse
+
+
 def _ring_attention_shard(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -67,56 +107,83 @@ def _ring_attention_shard(
     *,
     axis_name: str,
     causal: bool,
+    flash_blocks=None,
+    interpret: bool = False,
 ) -> jnp.ndarray:
-    """Per-device body (runs under shard_map): online-softmax over rotating
-    K/V blocks. ``q,k,v``: [B, T_local, H, D] shards of the global sequence."""
+    """Per-device body (runs under shard_map): per-hop local attention with
+    online lse merging over rotating K/V blocks.
+
+    ``q,k,v``: [B, T_local, H, D] shards of the global sequence. Each hop's
+    local block runs through the Pallas flash kernel when ``flash_blocks``
+    is set (``(block_q, block_k)``), else dense XLA math; causal hops that
+    are fully masked (this device's queries precede every key in the block)
+    are skipped entirely via ``lax.cond`` — no score FLOPs, no exp, only the
+    ring rotation they must forward anyway.
+
+    Hop structure: block at step ``s`` is the K/V shard originally owned by
+    device ``(my_index - s) % axis_size``. Step 0 is this device's own block
+    — the causal *diagonal* — so the accumulator starts finite and the merge
+    ``exp(lse - lse_new)`` never sees (-inf) - (-inf).
+    """
     axis_size = jax.lax.psum(1, axis_name)
     my_index = jax.lax.axis_index(axis_name)
     t_local = q.shape[1]
-    scale = q.shape[-1] ** -0.5
 
-    q32 = q.astype(jnp.float32)
-    q_pos = my_index * t_local + jnp.arange(t_local)
+    def hop(k_blk, v_blk, hop_causal, kv_index):
+        if flash_blocks is not None:
+            # hop_causal selects the kernel's own causal path for the
+            # diagonal block (local positions align there: global offsets
+            # are equal), unmasked otherwise.
+            return _flash_hop(
+                q, k_blk, v_blk, causal=hop_causal,
+                block_q=flash_blocks[0], block_k=flash_blocks[1],
+                interpret=interpret,
+            )
+        offsets = None
+        if hop_causal:
+            q_pos = my_index * t_local + jnp.arange(t_local)
+            kv_pos = kv_index * t_local + jnp.arange(t_local)
+            offsets = (q_pos, kv_pos)
+        return _dense_hop(
+            q.astype(jnp.float32), k_blk, v_blk, causal_mask_offset=offsets
+        )
+
+    # Step 0: own block (the diagonal when causal).
+    o_acc, lse_acc = hop(k, v, causal, my_index)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     def body(step, carry):
-        o, m, l, kv = carry
-        k_blk, v_blk = kv
-        # Block `step` holds the K/V shard originally owned by device
-        # (my_index - step) mod axis_size.
+        o_acc, lse_acc, k_blk, v_blk = carry
+        # Rotate first: after `step` rotations this device holds the block of
+        # device (my_index - step) % axis_size.
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         kv_index = (my_index - step) % axis_size
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
-        if causal:
-            kv_pos = kv_index * t_local + jnp.arange(t_local)
-            mask = q_pos[:, None] >= kv_pos[None, :]
-            logits = jnp.where(mask, logits, NEG_INF)
-        blk_max = jnp.max(logits, axis=-1)  # [B,H,Tq]
-        new_m = jnp.maximum(m, blk_max)
-        correction = jnp.exp(m - new_m)
-        p = jnp.exp(logits - new_m[..., None])  # [B,H,Tq,Tk]
-        new_l = l * correction + jnp.sum(p, axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
-        new_o = o * correction.transpose(0, 2, 1)[..., None] + pv
-        # Rotate K/V one hop around the ring (nearest-neighbor ICI); the final
-        # block needs no rotation, so skip that pair of collectives.
-        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-        k_next, v_next = jax.lax.cond(
-            step < axis_size - 1,
-            lambda kv: (
-                jax.lax.ppermute(kv[0], axis_name, perm),
-                jax.lax.ppermute(kv[1], axis_name, perm),
-            ),
-            lambda kv: kv,
-            (k_blk, v_blk),
-        )
-        return new_o, new_m, new_l, (k_next, v_next)
 
-    b, _, h, d = q.shape
-    o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
-    m0 = jnp.full((b, h, t_local), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, t_local), jnp.float32)
-    o, m, l, _ = jax.lax.fori_loop(0, axis_size, body, (o0, m0, l0, (k, v)))
-    out = o / l.transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+        def live(args):
+            o_acc, lse_acc = args
+            o_hop, lse_hop = hop(k_blk, v_blk, False, kv_index)
+            lse_new = jnp.logaddexp(lse_acc, lse_hop)
+            w_acc = jnp.exp(lse_acc - lse_new).transpose(0, 2, 1)[..., None]
+            w_hop = jnp.exp(lse_hop - lse_new).transpose(0, 2, 1)[..., None]
+            return o_acc * w_acc + o_hop * w_hop, lse_new
+
+        if causal:
+            # Hop blocks are fully visible iff the block's owner precedes
+            # this device (kv_index < my_index ⇔ step <= my_index for
+            # step >= 1); otherwise fully masked — skip all compute.
+            o_acc, lse_acc = jax.lax.cond(
+                step <= my_index, live, lambda args: args, (o_acc, lse_acc)
+            )
+        else:
+            o_acc, lse_acc = live((o_acc, lse_acc))
+        return o_acc, lse_acc, k_blk, v_blk
+
+    o_acc, lse_acc, _, _ = jax.lax.fori_loop(
+        1, axis_size, body, (o_acc, lse_acc, k, v)
+    )
+    return o_acc.astype(q.dtype)
 
 
 def ring_attention(
@@ -129,12 +196,23 @@ def ring_attention(
     causal: bool = False,
     batch_axis: Optional[str] = "data",
     heads_axis: Optional[str] = "tensor",
+    use_flash: Optional[bool] = None,
+    interpret: bool = False,
+    block_q: int = 512,
+    block_k: int = 1024,
 ) -> jnp.ndarray:
     """Sequence-parallel attention over globally-shaped arrays.
 
     Inputs are global ``[B, T, H, D]`` arrays whose sequence dim is (to be)
     sharded along ``axis_name``; the shard_map splits them, runs the ring, and
     reassembles. Degenerates to one dense block when the axis has size 1.
+
+    Each ring hop's local block runs through the Pallas flash kernel
+    (``use_flash``: None = auto — on when the backend is TPU, or when
+    ``interpret`` is set, and the local block tiles legally), composing the
+    cross-chip ring with the single-chip tiled kernel: per-hop memory drops
+    from ``O(T_local^2)`` scores to ``O(block)``, and fully-masked causal
+    hops skip their compute entirely (only the ring rotation remains).
 
     Under tensor parallelism the heads dim arrives sharded along
     ``heads_axis``; the shard_map keeps it sharded (heads are independent in
@@ -149,6 +227,22 @@ def ring_attention(
             f"sequence length {q.shape[1]} not divisible by mesh axis "
             f"{axis_name!r} ({seq_size})"
         )
+
+    from distributed_pytorch_tpu.ops.flash_attention import _fit_block
+
+    t_local = q.shape[1] // seq_size
+    fit_q = _fit_block(block_q, t_local)
+    fit_k = _fit_block(block_k, t_local)
+    blocks_fit = fit_q is not None and fit_k is not None
+    if blocks_fit and not interpret and (fit_k % 128 != 0):
+        blocks_fit = False  # lane alignment (see flash_attention)
+    if use_flash is None:
+        use_flash = (jax.default_backend() == "tpu" or interpret) and blocks_fit
+    elif use_flash and not blocks_fit:
+        raise ValueError(
+            f"use_flash=True but no legal flash tiling for local block "
+            f"T/{seq_size}={t_local}"
+        )
     spec = P(
         axis_if_divisible(mesh, batch_axis, q.shape[0]),
         axis_name,
@@ -156,7 +250,11 @@ def ring_attention(
         None,
     )
     body = functools.partial(
-        _ring_attention_shard, axis_name=axis_name, causal=causal
+        _ring_attention_shard,
+        axis_name=axis_name,
+        causal=causal,
+        flash_blocks=(fit_q, fit_k) if use_flash else None,
+        interpret=interpret,
     )
     return jax.shard_map(
         body,

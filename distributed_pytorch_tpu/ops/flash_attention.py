@@ -274,10 +274,16 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
 
 def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
     q, k, v, out, lse = residuals
-    bh, seq, d = q.shape
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )[:, :, None]
+    return _flash_bwd_impl(
+        causal, block_q, block_k, interpret, q, k, v, lse, g, delta
+    )
+
+
+def _flash_bwd_impl(causal, block_q, block_k, interpret, q, k, v, lse, g, delta):
+    bh, seq, d = q.shape
     scale = d**-0.5
 
     dq = pl.pallas_call(
@@ -324,6 +330,45 @@ def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_with_lse(q, k, v, causal, block_q, block_k, interpret):
+    """Tiled attention returning ``(out, lse)`` over ``[BH, T, D]`` inputs.
+
+    The building block for composing this kernel with
+    :func:`.attention.ring_attention`: each ring hop computes its local
+    ``(out, lse)`` here and the hops merge online outside. Differentiable in
+    BOTH outputs — the lse cotangent folds into the backward kernels as
+    ``delta - dlse`` (since d(lse)/d(scores) is exactly the softmax ``p``,
+    the same factor the dO path multiplies), so no extra kernel is needed.
+
+    ``lse`` is ``[BH, T, 1]`` float32.
+    """
+    out, residuals = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, residuals[-1]
+
+
+def _flash_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, residuals = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return (out, residuals[-1]), residuals
+
+
+def _flash_lse_bwd(causal, block_q, block_k, interpret, residuals, cotangents):
+    g_out, g_lse = cotangents
+    q, k, v, out, lse = residuals
+    delta = (
+        jnp.sum(g_out.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)[
+            :, :, None
+        ]
+        - g_lse.astype(jnp.float32)
+    )
+    return _flash_bwd_impl(
+        causal, block_q, block_k, interpret, q, k, v, lse, g_out, delta
+    )
+
+
+flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def _fit_block(block: int, t: int) -> int | None:

@@ -90,6 +90,7 @@ def make_train_step(
     state_sharding: Optional[Any] = None,
     batch_spec: Optional[Any] = None,
     grad_accum: int = 1,
+    apply_takes_targets: bool = False,
 ) -> Callable[[TrainState, Tuple], Tuple[TrainState, jnp.ndarray]]:
     """Build the jitted ``(state, (inputs, targets)) -> (state', loss)`` step.
 
@@ -115,6 +116,14 @@ def make_train_step(
 
     ``donate_argnums=(0,)`` lets XLA reuse the old state's buffers for the new
     state (in-place update semantics, halving peak parameter memory).
+
+    ``apply_takes_targets=True`` is for models that fuse the loss into the
+    forward pass (e.g. ``TransformerLM(fused_head_chunk=...)``, whose fused LM
+    head never materializes the logits): ``apply_fn`` is called as
+    ``apply_fn(variables, inputs, targets, mutable=...)`` and its output feeds
+    ``loss_fn(predictions, targets)`` as usual — pass
+    ``loss_fn=lambda out, _: out`` when the model already returns the scalar
+    loss.
     """
     if grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
@@ -128,8 +137,11 @@ def make_train_step(
             # "losses" is always mutable so sown penalty terms surface here;
             # it is popped before the aux state re-enters TrainState (it is
             # per-apply, not persistent — see create_train_state).
+            apply_args = (
+                (mb_inputs, mb_targets) if apply_takes_targets else (mb_inputs,)
+            )
             predictions, new_model_state = apply_fn(
-                variables, mb_inputs, mutable=mutable + ["losses"]
+                variables, *apply_args, mutable=mutable + ["losses"]
             )
             new_model_state = dict(new_model_state)
             loss = loss_fn(predictions, mb_targets)
@@ -258,6 +270,7 @@ def make_eval_step(
     data_axis: str = "data",
     state_sharding: Optional[Any] = None,
     batch_spec: Optional[Any] = None,
+    apply_takes_targets: bool = False,
 ) -> Callable[[TrainState, Tuple], jnp.ndarray]:
     """Jitted forward-only ``(state, (inputs, targets)) -> loss``.
 
@@ -272,9 +285,10 @@ def make_eval_step(
 
     def eval_step(state: TrainState, batch) -> jnp.ndarray:
         inputs, targets = batch
+        apply_args = (inputs, targets) if apply_takes_targets else (inputs,)
         predictions, aux = apply_fn(
             {"params": state.params, **state.model_state},
-            inputs,
+            *apply_args,
             mutable=["losses"],
         )
         loss = loss_fn(predictions, targets)

@@ -1,4 +1,5 @@
 from distributed_pytorch_tpu.utils.data import (
+    ArrayDataset,
     MaterializedDataset,
     NativeShardedLoader,
     RandomDataset,
@@ -7,6 +8,7 @@ from distributed_pytorch_tpu.utils.data import (
 from distributed_pytorch_tpu.utils.platform import use_fake_cpu_devices
 
 __all__ = [
+    "ArrayDataset",
     "MaterializedDataset",
     "NativeShardedLoader",
     "RandomDataset",

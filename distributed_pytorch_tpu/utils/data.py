@@ -47,6 +47,30 @@ class MaterializedDataset:
         return self.inputs[index], self.targets[index]
 
 
+class ArrayDataset:
+    """Materialized dataset over caller-provided arrays.
+
+    The general form of :class:`MaterializedDataset` (any shapes/dtypes):
+    exposes C-contiguous ``inputs``/``targets``, so it feeds both the Python
+    :class:`ShardedLoader` and the C++-backed :class:`NativeShardedLoader`.
+    Used for real data (e.g. CIFAR-10) and materialized benchmark workloads.
+    """
+
+    def __init__(self, inputs: np.ndarray, targets: np.ndarray):
+        if len(inputs) != len(targets):
+            raise ValueError(
+                f"inputs ({len(inputs)}) and targets ({len(targets)}) disagree"
+            )
+        self.inputs = np.ascontiguousarray(inputs)
+        self.targets = np.ascontiguousarray(targets)
+
+    def __len__(self) -> int:
+        return self.inputs.shape[0]
+
+    def __getitem__(self, index: int) -> Batch:
+        return self.inputs[index], self.targets[index]
+
+
 class RandomDataset:
     """Lazy random dataset: every ``__getitem__`` generates its sample on demand.
 

@@ -44,8 +44,10 @@ def main(args):
         remat=args.remat,
         mesh=mesh,
         sequence_axis="sequence",
+        fused_head_chunk=args.fused_head_chunk,
     )
     optimizer = optax.adamw(3e-4)
+    fused = args.fused_head_chunk > 0
 
     rng = np.random.default_rng(0)
     tokens = rng.integers(
@@ -55,8 +57,14 @@ def main(args):
 
     state = create_train_state(model, optimizer, inputs)
     state = jax.device_put(state, replicated_sharding(mesh))
+    # With the fused head the model consumes targets and returns the scalar
+    # loss itself; the [B*T, vocab] logits tensor is never materialized.
     step = make_train_step(
-        model.apply, optimizer, softmax_cross_entropy_loss, mesh=mesh
+        model.apply,
+        optimizer,
+        (lambda out, _: out) if fused else softmax_cross_entropy_loss,
+        mesh=mesh,
+        apply_takes_targets=fused,
     )
 
     # The batch is sharded over "data"; inside each attention layer the
@@ -91,6 +99,9 @@ if __name__ == "__main__":
     parser.add_argument("--data_parallel", default=2, type=int)
     parser.add_argument("--sequence_parallel", default=4, type=int)
     parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--fused_head_chunk", default=0, type=int,
+                        help=">0: fused LM-head cross-entropy with this vocab "
+                        "chunk size (never materializes the logits)")
     parser.add_argument("--fake_devices", default=0, type=int,
                         help="debug: present N virtual CPU devices instead of real chips")
     args = parser.parse_args()

@@ -91,10 +91,11 @@ def test_requires_materialized_dataset():
         NativeShardedLoader(RandomDataset(16, (4,)), 4)
 
 
-def test_cross_thread_destroy_neither_hangs_nor_crashes():
-    """prefetch_destroy from a different thread than the consumer must wake a
-    blocked prefetch_next (returning 0) and wait out any in-flight copy —
-    no deadlock, no use-after-free."""
+def test_cross_thread_stop_then_destroy():
+    """The cross-thread teardown contract: prefetch_stop from ANY thread wakes
+    a blocked consumer (prefetch_next returns 0 and its loop exits), then
+    prefetch_destroy — after the consumer is done — frees safely. No
+    deadlock, no use-after-free."""
     import ctypes
     import threading
 
@@ -126,10 +127,11 @@ def test_cross_thread_destroy_neither_hangs_nor_crashes():
 
         t = threading.Thread(target=consume)
         t.start()
-        # Destroy at a random-ish point mid-stream (sometimes immediately).
+        # Stop at a random-ish point mid-stream (sometimes immediately).
         if trial % 2:
             while len(consumed) < trial:
                 pass
-        lib.prefetch_destroy(handle)
+        lib.prefetch_stop(handle)  # safe while the consumer is mid-call
         t.join(timeout=30)
-        assert not t.is_alive(), "consumer thread hung after cross-thread destroy"
+        assert not t.is_alive(), "consumer thread hung after cross-thread stop"
+        lib.prefetch_destroy(handle)  # consumer done: free is race-free

@@ -198,8 +198,8 @@ def ring_attention(
     heads_axis: Optional[str] = "tensor",
     use_flash: Optional[bool] = None,
     interpret: bool = False,
-    block_q: int = 512,
-    block_k: int = 1024,
+    block_q: Optional[int] = None,  # None: measured table (flash_autotune)
+    block_k: Optional[int] = None,
 ) -> jnp.ndarray:
     """Sequence-parallel attention over globally-shaped arrays.
 
@@ -228,11 +228,20 @@ def ring_attention(
             f"{axis_name!r} ({seq_size})"
         )
 
-    from distributed_pytorch_tpu.ops.flash_attention import _fit_block
+    from distributed_pytorch_tpu.ops.flash_attention import (
+        _fit_block,
+        resolve_blocks,
+    )
 
     t_local = q.shape[1] // seq_size
-    fit_q = _fit_block(block_q, t_local)
-    fit_k = _fit_block(block_k, t_local)
+    if use_flash is False:
+        fit_q = fit_k = None  # dense hops: never resolve/sweep block sizes
+    else:
+        block_q, block_k = resolve_blocks(
+            block_q, block_k, t_local, q.shape[-1], q.dtype, causal, interpret
+        )
+        fit_q = _fit_block(block_q, t_local)
+        fit_k = _fit_block(block_k, t_local)
     blocks_fit = fit_q is not None and fit_k is not None
     if blocks_fit and not interpret and (fit_k % 128 != 0):
         blocks_fit = False  # lane alignment (see flash_attention)

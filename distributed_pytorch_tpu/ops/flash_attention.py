@@ -381,15 +381,52 @@ def _fit_block(block: int, t: int) -> int | None:
     return b if b >= 8 else None
 
 
+def resolve_blocks(
+    block_q: int | None,
+    block_k: int | None,
+    t: int,
+    d: int,
+    dtype,
+    causal: bool,
+    interpret: bool,
+) -> tuple:
+    """Fill unspecified block sizes from the measured table
+    (:mod:`.flash_autotune`); with ``FLASH_AUTOTUNE=1`` on real hardware, run
+    the measured sweep for this shape instead (compiles each candidate once,
+    cached on disk thereafter)."""
+    if block_q is not None and block_k is not None:
+        return block_q, block_k
+    from distributed_pytorch_tpu.ops.flash_autotune import (
+        autotune,
+        autotune_enabled,
+        lookup,
+    )
+
+    dtype_name = jnp.dtype(dtype).name
+    if (
+        autotune_enabled()
+        and not interpret
+        and jax.default_backend() == "tpu"
+        # Multi-process SPMD: the sweep's winner is timing-dependent, and
+        # hosts choosing different blocks would trace divergent programs
+        # around the same collectives (hang/crash). Every host must take
+        # the deterministic table path instead.
+        and jax.process_count() == 1
+    ):
+        bq, bk = autotune(t, d, dtype=dtype, causal=causal)
+    else:
+        bq, bk = lookup(t, d, dtype_name, causal)
+    return block_q or bq, block_k or bk
+
+
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
     *,
     causal: bool = False,
-    block_q: int = 512,  # tuned on v5-class hardware: (512, 1024) ran the
-    block_k: int = 1024,  # 8k-seq causal train step 2.6x faster than dense
-
+    block_q: int | None = None,  # None: measured table / FLASH_AUTOTUNE sweep
+    block_k: int | None = None,
     interpret: bool | None = None,
     mesh=None,
     batch_axis: str | None = "data",
@@ -416,6 +453,9 @@ def flash_attention(
             # far faster than the Pallas interpreter — use it.
             return dot_product_attention(q, k, v, causal=causal)
         interpret = False
+    block_q, block_k = resolve_blocks(
+        block_q, block_k, t, d, q.dtype, causal, interpret
+    )
     block_q = _fit_block(block_q, t)
     block_k = _fit_block(block_k, t)
     if block_q is None or block_k is None:

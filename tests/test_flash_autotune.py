@@ -1,0 +1,58 @@
+"""Flash block-size selection: candidate legality and lookup tiers (the
+measured sweep itself needs real hardware; its results ship in
+DEFAULT_TABLE — see BASELINE.md)."""
+
+import json
+
+import pytest
+
+from distributed_pytorch_tpu.ops import flash_autotune as fa
+
+
+@pytest.fixture(autouse=True)
+def _isolated_caches(tmp_path, monkeypatch):
+    """Every test sees an empty disk cache (a dev box where a real sweep ran
+    must not leak measured winners in) and a clean in-process cache."""
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+    monkeypatch.setattr(fa, "_runtime_cache", {})
+
+
+def test_candidates_are_legal():
+    for t in (2048, 8192, 16384):
+        for d in (64, 128):
+            cands = list(fa.candidates(t, d))
+            assert cands, (t, d)
+            for bq, bk in cands:
+                assert t % bq == 0 and t % bk == 0
+                assert bk % 128 == 0  # lane alignment
+                # VMEM bound honored
+                assert bq * bk * 4 + 2 * bk * d * 4 <= 12 * 2**20
+
+
+def test_lookup_uses_shipped_table_nearest_bucket():
+    # Exact bucket.
+    assert fa.lookup(16384, 64, device_kind="TPU v5 lite") == (1024, 1024)
+    # Nearest bucket: T=12288 sits nearer 16384 than 8192... check stability
+    # for an off-table T and d.
+    blocks = fa.lookup(4096, 96, device_kind="TPU v5 lite")
+    assert blocks in set(fa.DEFAULT_TABLE["tpu v5 lite"].values())
+
+
+def test_lookup_falls_back_on_unknown_device():
+    assert fa.lookup(8192, 64, device_kind="TPU v99") == fa._FALLBACK
+
+
+def test_disk_cache_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+    fa._save_disk_cache({("tpu v5 lite", 1024, 64, "bfloat16", True): (256, 512)})
+    got = fa._load_disk_cache()
+    assert got[("tpu v5 lite", 1024, 64, "bfloat16", True)] == (256, 512)
+    # Cache file is valid JSON on disk.
+    with open(fa._cache_path()) as f:
+        json.load(f)
+
+
+def test_runtime_cache_wins_over_table(monkeypatch):
+    key = fa._key("TPU v5 lite", 16384, 64, "bfloat16", True)
+    monkeypatch.setitem(fa._runtime_cache, key, (256, 256))
+    assert fa.lookup(16384, 64, device_kind="TPU v5 lite") == (256, 256)

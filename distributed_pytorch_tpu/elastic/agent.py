@@ -14,7 +14,9 @@ that machinery, TPU-native:
 * **Rendezvous** — agents meet at a native C++ TCP store (``elastic/store.py``,
   the c10d TCPStore twin) on the rendezvous host; node 0's agent runs the
   store. Joins are counted per generation; everyone proceeds when all
-  ``nnodes`` agents have joined.
+  ``nnodes`` agents have joined. JAX's own coordination service is a second
+  port on the same host, bound by *worker* 0 (``--jax-coordinator-port``,
+  default: rendezvous port + 1).
 * **Failure detection** — local: the agent polls its workers; any nonzero exit
   is a failure. Remote: each agent heartbeats ``hb/<node>`` into the store and
   a monitor thread watches the failure-generation key and peer heartbeats.
@@ -69,6 +71,10 @@ class ElasticConfig:
     node_rank: int = 0
     rdzv_host: str = "127.0.0.1"
     rdzv_port: int = 29400
+    # Port for JAX's own coordination service (run by global process 0 of the
+    # *workers*, not by the agent). Defaults to rdzv_port + 1; set explicitly
+    # (--jax-coordinator-port) when that neighbor port may be taken.
+    jax_coordinator_port: Optional[int] = None
     max_restarts: int = 3
     heartbeat_interval: float = 2.0
     heartbeat_timeout: float = 30.0
@@ -80,8 +86,10 @@ class ElasticConfig:
 
     @property
     def coordinator_address(self) -> str:
-        # JAX's coordination service listens next door to the rendezvous store.
-        return f"{self.rdzv_host}:{self.rdzv_port + 1}"
+        port = self.jax_coordinator_port
+        if port is None:
+            port = self.rdzv_port + 1
+        return f"{self.rdzv_host}:{port}"
 
 
 class WorkerGroup:
@@ -171,20 +179,35 @@ class ElasticAgent:
 
         Staleness is judged purely on this node's monotonic clock — the beat
         value is an opaque counter, never a timestamp — so cross-host clock
-        skew cannot declare a healthy peer dead."""
+        skew cannot declare a healthy peer dead. A peer with no beat at all is
+        measured from when monitoring began (``_seed_peer_clocks``): every
+        agent rendezvoused before workers spawned, so a node frozen before its
+        first heartbeat write must still be declared dead, not waited on
+        forever."""
         now = time.monotonic()
         for rank in range(self.cfg.nnodes):
             if rank == self.cfg.node_rank:
                 continue
             beat = self.store.get(f"{HB_PREFIX}{rank}")
-            if beat is None:
-                continue  # not yet joined — rendezvous handles that phase
             last_beat, seen_at = self._peer_beats.get(rank, (None, None))
-            if beat != last_beat:
+            if seen_at is None or beat != last_beat:
                 self._peer_beats[rank] = (beat, now)
             elif now - seen_at > self.cfg.heartbeat_timeout:
                 return rank
         return None
+
+    def _seed_peer_clocks(self) -> None:
+        """(Re)start every peer's staleness clock at monitor start.
+
+        Each generation grants each peer a fresh ``heartbeat_timeout`` window:
+        a peer declared dead last generation has just re-rendezvoused, and its
+        pre-freeze beat value must not count as already-stale (that would
+        re-declare a recovered node dead instantly and burn extra restarts)."""
+        now = time.monotonic()
+        for rank in range(self.cfg.nnodes):
+            if rank != self.cfg.node_rank:
+                last_beat = self._peer_beats.get(rank, (None, None))[0]
+                self._peer_beats[rank] = (last_beat, now)
 
     # ------------------------------------------------------------- lifecycle
     def _rendezvous(self, timeout: float = 600.0) -> int:
@@ -257,7 +280,8 @@ class ElasticAgent:
                     )
                     return 1
                 print(
-                    f"[tpurun] failure detected (gen {generation}); "
+                    f"[tpurun] failure detected (gen {generation}): "
+                    f"{failure or 'restart requested elsewhere'}; "
                     f"restart {restarts}/{cfg.max_restarts}",
                     flush=True,
                 )
@@ -273,6 +297,7 @@ class ElasticAgent:
         """
         cfg = self.cfg
         last_peer_check = 0.0
+        self._seed_peer_clocks()
         while True:
             code = group.poll()
             if code is not None:
@@ -347,6 +372,26 @@ def make_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--max-restarts", type=int, default=3)
     p.add_argument(
+        "--jax-coordinator-port",
+        type=int,
+        default=None,
+        help="port for jax.distributed's coordination service, which worker 0 "
+        "binds on the rendezvous host (default: rendezvous port + 1)",
+    )
+    p.add_argument(
+        "--heartbeat-interval",
+        type=float,
+        default=2.0,
+        help="seconds between agent heartbeats into the store",
+    )
+    p.add_argument(
+        "--heartbeat-timeout",
+        type=float,
+        default=30.0,
+        help="declare a peer node dead after this many seconds without a "
+        "fresh heartbeat (restart-the-world follows)",
+    )
+    p.add_argument(
         "--standalone",
         action="store_true",
         help="single-node shorthand: nnodes=1, store on an ephemeral local port",
@@ -356,17 +401,29 @@ def make_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+def _free_ports(n: int) -> List[int]:
+    """``n`` distinct free ports, all held open while picking so two calls
+    cannot hand back the same just-released port."""
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = make_parser().parse_args(argv)
     if args.standalone:
         args.nnodes, args.node_rank = 1, 0
-        args.rdzv_endpoint = f"127.0.0.1:{_free_port()}"
+        # The ephemeral store port's neighbor may be in use; pick two distinct
+        # free ports rather than gambling on rdzv_port + 1.
+        rdzv_port, coord_port = _free_ports(2)
+        args.rdzv_endpoint = f"127.0.0.1:{rdzv_port}"
+        if args.jax_coordinator_port is None:
+            args.jax_coordinator_port = coord_port
     host, port = _parse_endpoint(args.rdzv_endpoint)
     cfg = ElasticConfig(
         nproc_per_node=args.nproc_per_node,
@@ -374,7 +431,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         node_rank=args.node_rank,
         rdzv_host=host,
         rdzv_port=port,
+        jax_coordinator_port=args.jax_coordinator_port,
         max_restarts=args.max_restarts,
+        heartbeat_interval=args.heartbeat_interval,
+        heartbeat_timeout=args.heartbeat_timeout,
     )
     agent = ElasticAgent(cfg, [sys.executable, args.script] + args.script_args)
 

@@ -4,12 +4,15 @@ Covers the torchrun-equivalent layer the reference outsources
 (SURVEY.md §3.3): env-var contract, rendezvous via the C++ TCP store,
 failure detection, and restart-the-world recovery with TPURUN_RESTART_COUNT.
 
-Workers here are tiny pure-Python scripts (no jax import) so the tests run in
-seconds; the full train-resume integration lives in
-``tests/test_integration_multiprocess.py``.
+Most workers here are tiny pure-Python scripts (no jax import) so the tests
+run in seconds; ``TestElasticTraining`` at the bottom runs the real thing —
+live JAX workers of ``examples/multihost_pod.py`` under tpurun, one of them
+SIGKILLed mid-epoch. Clean-relaunch snapshot resume (no agent in the loop) is
+covered in ``tests/test_multiprocess.py``.
 """
 
 import os
+import signal
 import socket
 import subprocess
 import sys
@@ -17,6 +20,7 @@ import textwrap
 import threading
 import time
 
+import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -97,10 +101,13 @@ class TestKVStore:
 # ----------------------------------------------------------------- agent
 
 
-def run_tpurun(tmp_path, worker_src: str, *args: str, timeout: float = 120):
+def run_tpurun(
+    tmp_path, worker_src: str, *args: str, timeout: float = 120, extra_env=None
+):
     worker = tmp_path / "worker.py"
     worker.write_text(textwrap.dedent(worker_src))
     env = dict(os.environ, PYTHONPATH=REPO)
+    env.update(extra_env or {})
     return subprocess.run(
         [sys.executable, "-m", "distributed_pytorch_tpu.elastic", *args, str(worker)],
         env=env,
@@ -229,3 +236,196 @@ class TestElasticAgent:
         # LOCAL_RANK is per-node: global 0,1 -> node0 local 0,1; global 2,3 -> node1.
         assert (tmp_path / "n.2").read_text() == "0"
         assert (tmp_path / "n.3").read_text() == "1"
+
+
+# ------------------------------------------------- live-JAX fault injection
+
+
+class TestElasticTraining:
+    """The reference's marquee behavior, end-to-end: a live JAX training
+    worker dies mid-epoch, tpurun restarts the world, and training resumes
+    from the snapshot with no loss divergence (reference
+    ``multigpu_torchrun.py:30-40,57-65`` + torchrun's restart policy)."""
+
+    KILL_WORKER = """
+    '''Rung-4 training worker with deterministic mid-epoch fault injection.
+
+    Process 1 of the first launch SIGKILLs itself partway through epoch 1's
+    batch loop. SIGKILL cannot be caught or blocked, so the effect is
+    identical to an external ``kill -9`` landing mid-step: the process
+    vanishes instantly while its peer sits inside a cross-process collective.
+    '''
+    import os
+    import runpy
+    import signal
+    import sys
+
+    process_id = os.environ["PROCESS_ID"]
+    restart = os.environ["TPURUN_RESTART_COUNT"]
+    open(f"gen.{process_id}.{restart}", "w").write("ok")
+
+    if process_id == "1" and restart == "0":
+        import distributed_pytorch_tpu.training.trainer as trainer_mod
+
+        steps = [0]
+        original = trainer_mod.Trainer._run_batch
+
+        def sabotaged(self, batch):
+            steps[0] += 1
+            if steps[0] > 21:  # 16 steps/epoch -> dies 6 steps into epoch 1
+                os.kill(os.getpid(), signal.SIGKILL)
+            return original(self, batch)
+
+        trainer_mod.Trainer._run_batch = sabotaged
+
+    sys.argv = [
+        "multihost_pod.py", "3", "1",
+        "--snapshot_path", "killtest.npz",
+        "--fake_devices", "2",
+    ]
+    runpy.run_path(os.environ["POD_EXAMPLE"], run_name="__main__")
+    """
+
+    @pytest.mark.slow
+    def test_sigkill_mid_epoch_restart_resume_parity(self, tmp_path):
+        """SIGKILL a live JAX worker mid-epoch; assert restart-the-world,
+        snapshot resume, and final losses identical to an uninterrupted run."""
+        result = run_tpurun(
+            tmp_path,
+            self.KILL_WORKER,
+            "--standalone",
+            "--nproc-per-node",
+            "2",
+            "--max-restarts",
+            "2",
+            timeout=600,
+            extra_env={
+                "POD_EXAMPLE": os.path.join(REPO, "examples", "multihost_pod.py"),
+                # Each worker presents 2 virtual chips -> a 4-chip world.
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                "JAX_PLATFORMS": "cpu",
+            },
+        )
+        assert result.returncode == 0, result.stdout + result.stderr
+
+        # The world restarted exactly once: every worker ran at generation 0
+        # AND at generation 1 (TPURUN_RESTART_COUNT bumped for all of them).
+        markers = {p.name for p in tmp_path.glob("gen.*")}
+        assert {"gen.0.0", "gen.1.0", "gen.0.1", "gen.1.1"} <= markers
+        assert "restart 1/2" in result.stdout
+        # The relaunched workers resumed from the epoch-0 snapshot, not step 0.
+        assert "Resuming training from snapshot at Epoch 1" in result.stdout
+
+        # Loss parity with an uninterrupted run of the same global workload
+        # (one process, 4 virtual chips, same global batch of 128).
+        single = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "examples", "multihost_pod.py"),
+                "3", "1",
+                "--snapshot_path", str(tmp_path / "uninterrupted.npz"),
+                "--fake_devices", "4",
+            ],
+            cwd=tmp_path,
+            env={
+                **os.environ,
+                "PYTHONPATH": REPO,
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            },
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+        assert single.returncode == 0, single.stdout + single.stderr
+
+        import json
+
+        def epoch_losses(text):
+            losses = {}
+            for line in text.splitlines():
+                if line.startswith("{"):
+                    try:
+                        record = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if "epoch_loss" in record:
+                        losses[int(record["epoch"])] = record["epoch_loss"]
+            return losses
+
+        killed = epoch_losses(result.stdout)
+        clean = epoch_losses(single.stdout)
+        assert set(killed) == {0, 1, 2}, f"epochs seen: {sorted(killed)}"
+        for epoch, loss in clean.items():
+            np.testing.assert_allclose(killed[epoch], loss, rtol=1e-6)
+
+    @pytest.mark.slow
+    def test_heartbeat_staleness_restarts_world(self, tmp_path):
+        """A node that goes silent (SIGSTOP: process alive, heartbeats frozen)
+        past --heartbeat-timeout is declared dead by its peer, who bumps the
+        generation; when the node wakes it rejoins the restarted world."""
+        port = free_port()
+        worker = tmp_path / "worker.py"
+        worker.write_text(
+            textwrap.dedent(
+                """
+                import os, time
+                pid = os.environ["PROCESS_ID"]
+                restart = int(os.environ["TPURUN_RESTART_COUNT"])
+                open(f"started.{pid}.{restart}", "w").write("ok")
+                if restart == 0:
+                    time.sleep(300)  # hung world: only node failure ends it
+                open(f"done.{pid}.{restart}", "w").write("ok")
+                """
+            )
+        )
+        env = dict(os.environ, PYTHONPATH=REPO)
+
+        def launch(node_rank):
+            return subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "distributed_pytorch_tpu.elastic",
+                    "--nnodes", "2",
+                    "--node-rank", str(node_rank),
+                    "--nproc-per-node", "1",
+                    "--rdzv-endpoint", f"127.0.0.1:{port}",
+                    "--heartbeat-interval", "0.3",
+                    "--heartbeat-timeout", "3",
+                    str(worker),
+                ],
+                env=env,
+                cwd=tmp_path,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+
+        agents = [launch(0), launch(1)]
+        try:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not (
+                (tmp_path / "started.0.0").exists()
+                and (tmp_path / "started.1.0").exists()
+            ):
+                time.sleep(0.1)
+            assert (tmp_path / "started.1.0").exists(), "world never started"
+
+            os.kill(agents[1].pid, signal.SIGSTOP)  # node 1 goes silent
+            time.sleep(6)  # well past heartbeat_timeout
+            os.kill(agents[1].pid, signal.SIGCONT)
+
+            out0, err0 = agents[0].communicate(timeout=120)
+            out1, err1 = agents[1].communicate(timeout=120)
+        finally:
+            for a in agents:
+                if a.poll() is None:
+                    a.kill()
+                    a.wait()
+        assert agents[0].returncode == 0, out0 + err0
+        assert agents[1].returncode == 0, out1 + err1
+        assert "heartbeat lost" in out0
+        # Both nodes' workers ran again at a bumped restart count and finished.
+        assert any((tmp_path / f"done.0.{r}").exists() for r in range(1, 4))
+        assert any((tmp_path / f"done.1.{r}").exists() for r in range(1, 4))

@@ -222,9 +222,13 @@ def bench_lm(seq_len: int, fused: bool, n_steps: int = 10):
 
     import jax.numpy as jnp
 
+    # No remat at these sizes: with the flash kernel, activations are linear
+    # in T and fit HBM through T=16k+; full-block remat re-runs the attention
+    # forward in backward (measured 18% step-time tax at T=8192 on v5e, see
+    # BASELINE.md). remat / remat_policy="mlp" remain for beyond-HBM runs.
     model = TransformerLM(
         vocab_size=vocab, d_model=d_model, n_layers=n_layers, n_heads=n_heads,
-        d_ff=d_ff, dtype=jnp.bfloat16, remat=seq_len >= 8192,
+        d_ff=d_ff, dtype=jnp.bfloat16, remat=False,
         fused_head_chunk=8192 if fused else 0,
     )
     optimizer = optax.adam(1e-4)
@@ -237,18 +241,26 @@ def bench_lm(seq_len: int, fused: bool, n_steps: int = 10):
         step_fn = make_train_step(
             model.apply, optimizer, softmax_cross_entropy_loss
         )
-    compiled, flops = compile_with_flops(
+    compiled, _ = compile_with_flops(
         step_fn, state, jax.device_put(next(iter(loader)))
     )
     step = lambda s, b: compiled(s, jax.device_put(b))  # noqa: E731
 
-    if flops is None:
-        n_params = sum(
-            int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(state.params)
-        )
-        tokens = batch * seq_len
-        # 6 * P per token (fwd+bwd matmuls) + causal attention scores.
-        flops = 6.0 * n_params * tokens + 6.0 * n_layers * d_model * seq_len * tokens
+    # MFU denominator: ANALYTIC model FLOPs, not XLA cost analysis — XLA
+    # cannot count inside the Pallas attention custom-call and undercounts
+    # the scan-chunked fused head, which made fused/long-T rows read as
+    # artificially low MFU (the round-2 "15.2% at T=8192" was this artifact).
+    # Same basis for dense and fused rows, so their MFUs compare honestly.
+    # PaLM-style: fwd = 2 * P_matmul * tokens + causal attention matmuls;
+    # train = 3 * fwd (backward counted as 2x forward, no remat/recompute).
+    n_params = sum(
+        int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(state.params)
+    )
+    embed_params = vocab * d_model  # lookup, not a matmul
+    tokens = batch * seq_len
+    head_dim = d_model // n_heads
+    attn_fwd = n_layers * 4 * batch * n_heads * (seq_len**2 / 2) * head_dim
+    flops = 3.0 * (2.0 * (n_params - embed_params) * tokens + attn_fwd)
     _, elapsed = timed_steps(step, state, list(loader), n_steps, warmup=3)
     tag = "fused" if fused else "dense"
     return {

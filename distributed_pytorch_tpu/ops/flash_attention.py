@@ -19,8 +19,11 @@ Design (FlashAttention-2 style, built per the Pallas TPU playbook):
   probabilities from the saved logsumexp (no stored score matrix), with
   ``delta = rowsum(dO * O)`` precomputed outside.
 * causal programs skip the matmul work of fully-masked tiles via ``pl.when``.
-* all matmuls run on the MXU with ``preferred_element_type=float32``;
-  bfloat16 inputs are upcast per tile.
+* all matmuls keep their inputs in the source dtype (bfloat16 feeds the MXU
+  at full rate — an f32 upcast would quarter matmul throughput) and
+  accumulate in float32 via ``preferred_element_type``; softmax statistics
+  (m, l, lse) are float32 throughout, and the probability/ds tiles are cast
+  back to the input dtype for the second matmul of each kernel.
 
 ``interpret=True`` runs the same kernels on CPU for tests; on non-TPU
 backends without interpret, :func:`flash_attention` falls back to the dense
@@ -72,16 +75,19 @@ def _fwd_kernel(
 
     @pl.when(live)
     def _step():
-        q = q_ref[0].astype(jnp.float32)
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
+        # Matmul inputs stay in the source dtype (bf16 runs the MXU at full
+        # rate; an f32 upcast here quarters throughput) with f32 accumulation
+        # via preferred_element_type. Softmax stats are f32 throughout.
+        q = q_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
         s = (
             jax.lax.dot_general(
                 q, k_blk, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             * scale
-        )  # [block_q, block_k]
+        )  # [block_q, block_k] f32
         if causal:
             s = _causal_mask(s, q_start, k_start)
         m_prev = m_scr[:, :1]  # [block_q, 1]
@@ -91,7 +97,7 @@ def _fwd_kernel(
         correction = jnp.exp(m_prev - m_new)
         l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         acc_scr[:] = acc_scr[:] * correction + pv
@@ -123,12 +129,12 @@ def _bwd_dq_kernel(
 
     @pl.when(live)
     def _step():
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]  # [block_q, 1]
         delta = delta_ref[0]
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
         s = (
             jax.lax.dot_general(
                 q, k_blk, (((1,), (1,)), ((), ())),
@@ -143,7 +149,7 @@ def _bwd_dq_kernel(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(k_blk.dtype)
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -173,10 +179,10 @@ def _bwd_dkv_kernel(
 
     @pl.when(live)
     def _step():
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        q_blk = q_ref[0].astype(jnp.float32)
-        do_blk = do_ref[0].astype(jnp.float32)
+        k = k_ref[0]
+        v = v_ref[0]
+        q_blk = q_ref[0]
+        do_blk = do_ref[0]
         lse_blk = lse_ref[0]  # [block_q, 1]
         delta_blk = delta_ref[0]
         s = (
@@ -185,19 +191,19 @@ def _bwd_dkv_kernel(
                 preferred_element_type=jnp.float32,
             )
             * scale
-        )  # [block_q, block_k]
+        )  # [block_q, block_k] f32
         if causal:
             s = _causal_mask(s, q_start, k_start)
         p = jnp.exp(s - lse_blk)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())),
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do_blk, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_blk) * scale
+        ds = (p * (dp - delta_blk) * scale).astype(q_blk.dtype)
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,

@@ -163,6 +163,69 @@ def bench_resnet(
     }
 
 
+def bench_vit(per_chip_batch: int = 32, n_steps: int = 10, dataset_size: int = 128):
+    """ViT-L/32 bf16 train (the reference's alternative real model,
+    ``multigpu_profile.py:24``; ``--model vit`` in examples/multichip_profile.py).
+    At 50 tokens the attention runs the dense XLA path, so cost analysis sees
+    every FLOP."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributed_pytorch_tpu.models import ViT_L32
+    from distributed_pytorch_tpu.parallel.mesh import make_mesh
+    from distributed_pytorch_tpu.parallel.sharding import (
+        put_global_batch,
+        replicated_sharding,
+    )
+    from distributed_pytorch_tpu.training.losses import softmax_cross_entropy_loss
+    from distributed_pytorch_tpu.training.train_step import (
+        create_train_state,
+        make_train_step,
+    )
+    from distributed_pytorch_tpu.utils.data import ArrayDataset, NativeShardedLoader
+
+    n_chips = jax.device_count()
+    batch = per_chip_batch * n_chips
+    rng = np.random.default_rng(0)
+    data = ArrayDataset(
+        rng.standard_normal((dataset_size, 224, 224, 3)).astype(np.float32),
+        rng.integers(0, 1000, size=(dataset_size,)).astype(np.int32),
+    )
+    loader = NativeShardedLoader(
+        data, batch, pad_final_batch=True, num_workers=4, prefetch_depth=2
+    )
+    model = ViT_L32(num_classes=1000, dtype=jnp.bfloat16)
+    optimizer = optax.sgd(1e-3, momentum=0.9)
+    state = create_train_state(model, optimizer, data.inputs[:1])
+    mesh = make_mesh() if n_chips > 1 else None
+    if mesh is not None:
+        state = jax.device_put(state, replicated_sharding(mesh))
+        put = lambda b: put_global_batch(mesh, b)  # noqa: E731
+    else:
+        put = jax.device_put
+    step_fn = make_train_step(
+        model.apply, optimizer, softmax_cross_entropy_loss, mesh=mesh
+    )
+    compiled, flops = compile_with_flops(step_fn, state, put(next(iter(loader))))
+    if flops is None:
+        n_params = sum(
+            int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(state.params)
+        )
+        tokens_per_image = (224 // 32) ** 2 + 1  # 49 patches + cls = 50
+        flops = 6.0 * n_params * batch * tokens_per_image
+    batches = [put(b) for b in loader]
+    _, elapsed = timed_steps(compiled, state, batches, n_steps, warmup=3)
+    return {
+        "workload": f"vit_l32_bf16_b{per_chip_batch}",
+        "steps_per_sec": n_steps / elapsed,
+        "images_per_sec": n_steps * batch / elapsed,
+        "flops_per_step": flops,
+        "n_chips": n_chips,
+    }
+
+
 def bench_toy_mlp(n_steps: int = 200):
     """The reference toy rung: Linear(20,1), batch 32, SGD (single_gpu.py)."""
     import jax
@@ -304,6 +367,7 @@ def main():
             matrix.append(attach_mfu(bench_resnet(b), peak))
         # The honest-but-tunnel-bound number: H2D transfer per step.
         matrix.append(attach_mfu(bench_resnet(32, h2d_on_clock=True), peak))
+        matrix.append(attach_mfu(bench_vit(32), peak))
         matrix.append(attach_mfu(bench_toy_mlp(), peak))
         for seq in (2048, 8192):
             for fused in (False, True):

@@ -28,6 +28,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def generate(
@@ -41,6 +42,9 @@ def generate(
     top_k: int = 0,
     rng: Optional[jax.Array] = None,
     pad_token: int = 0,
+    mesh: Optional[Mesh] = None,
+    data_axis: str = "data",
+    param_shardings=None,
 ) -> jnp.ndarray:
     """Generate ``max_new_tokens`` continuations for ``prompt`` ``[B, T0]``.
 
@@ -49,6 +53,18 @@ def generate(
     tokens. ``prompt_lengths`` ([B]) supports ragged prompts padded to T0
     with ``pad_token`` — generation for each row starts after its own length.
     Returns ``[B, T0 + max_new_tokens]`` token ids.
+
+    With ``mesh``, decoding runs sharded: tokens and every KV-cache buffer are
+    placed ``P(data_axis)`` (batch-sharded — at ``[B, 32k, H, D]`` the cache,
+    not the params, is the memory that matters), params are replicated unless
+    ``param_shardings`` provides a placement: e.g. megatron TP via
+    ``make_param_specs(params, TRANSFORMER_TP_RULES, mesh=mesh)`` from
+    ``parallel.partitioning``, with each spec wrapped in
+    ``NamedSharding(mesh, spec)`` — GSPMD then shards the per-token matmuls
+    and the caches' head dim follows (see tests/test_generation.py).
+    The decode hot loop itself feeds ONE token per step, so the flash kernel
+    (built for long query blocks) does not apply; cache reads stay the
+    einsum-over-cache path, which XLA fuses well at ``T_step=1``.
     """
     decode_model = model.clone(decode=True)
     batch, prompt_len = prompt.shape
@@ -65,9 +81,6 @@ def generate(
         jax.random.PRNGKey(0),
         jnp.zeros((batch, total_len), jnp.int32),
     )["cache"]
-    cache = jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), abstract
-    )
 
     tokens0 = jnp.concatenate(
         [
@@ -76,9 +89,30 @@ def generate(
         ],
         axis=1,
     )
+    prompt_lengths = jnp.asarray(prompt_lengths, jnp.int32)
+
+    if mesh is not None:
+        batch_sh = NamedSharding(mesh, P(data_axis))
+        replicated = NamedSharding(mesh, P())
+
+        def place(s):
+            # Cache arrays lead with the batch dim; scalars (cache_index)
+            # replicate.
+            sh = batch_sh if s.ndim > 0 and s.shape[0] == batch else replicated
+            return jnp.zeros(s.shape, s.dtype, device=sh)
+
+        cache = jax.tree_util.tree_map(place, abstract)
+        tokens0 = jax.device_put(tokens0, batch_sh)
+        prompt_lengths = jax.device_put(prompt_lengths, batch_sh)
+        params = jax.device_put(params, param_shardings or replicated)
+        rng = jax.device_put(rng, replicated)
+    else:
+        cache = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), abstract
+        )
 
     run = _compiled_run(decode_model, total_len, float(temperature), int(top_k))
-    return run(params, tokens0, cache, jnp.asarray(prompt_lengths), rng)
+    return run(params, tokens0, cache, prompt_lengths, rng)
 
 
 @functools.lru_cache(maxsize=32)
@@ -123,7 +157,11 @@ def _compiled_run(decode_model, total_len: int, temperature: float, top_k: int):
         )
         return tokens
 
-    return jax.jit(run, donate_argnums=(2,))
+    # No donate_argnums: the cache lives its whole life INSIDE the fori_loop
+    # carry, where XLA already updates it in place; it is not a jit output, so
+    # donating its input buffer has nothing to alias against and only produced
+    # a "Some donated buffers were not usable" warning every call.
+    return jax.jit(run)
 
 
 def generate_text_ids(model, params, prompt_ids, max_new_tokens, **kw) -> np.ndarray:

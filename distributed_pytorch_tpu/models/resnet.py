@@ -87,6 +87,10 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.float32
+    # Small-image stem (the standard CIFAR adaptation, as in the original
+    # ResNet paper's CIFAR experiments): 3x3 stride-1 conv, no maxpool —
+    # a 7x7/2 stem + pool would collapse 32x32 inputs to 8x8 before stage 1.
+    cifar_stem: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -99,11 +103,17 @@ class ResNet(nn.Module):
             dtype=self.dtype,
         )
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+        kernel, strides, pad = (
+            ((3, 3), (1, 1), [(1, 1), (1, 1)])
+            if self.cifar_stem
+            else ((7, 7), (2, 2), [(3, 3), (3, 3)])
+        )
+        x = conv(self.num_filters, kernel, strides, padding=pad,
                  use_bias=False, name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        if not self.cifar_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for stage, num_blocks in enumerate(self.stage_sizes):
             for block_idx in range(num_blocks):
                 strides = (2, 2) if stage > 0 and block_idx == 0 else (1, 1)

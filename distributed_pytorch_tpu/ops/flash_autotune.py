@@ -1,12 +1,15 @@
 """Measured block-size selection for the Pallas flash-attention kernel.
 
 Replaces the round-1 hardcoded ``(512, 1024)`` guess (VERDICT item 8) with a
-three-tier lookup, cheapest first:
+tiered lookup, cheapest first:
 
-1. an in-process / on-disk cache of measured results (``~/.cache/...``),
-2. a shipped table measured on real hardware (``DEFAULT_TABLE`` below, keyed
+1. the in-process cache,
+2. an explicit precomputed table file (``FLASH_BLOCKS_TABLE=/path.json`` —
+   see the pod workflow below),
+3. the on-disk cache of this machine's own measured sweeps (``~/.cache/...``),
+4. a shipped table measured on real hardware (``DEFAULT_TABLE`` below, keyed
    by device kind), nearest-``T`` entry wins,
-3. the conservative fallback ``(512, 1024)``.
+5. the conservative fallback ``(512, 1024)``.
 
 A full *measured sweep* (``autotune()``) compiles and times each legal
 ``(block_q, block_k)`` candidate with value-fetch synchronization and caches
@@ -16,7 +19,20 @@ run ``python -m distributed_pytorch_tpu.ops.flash_autotune``, or set
 ``FLASH_AUTOTUNE=1`` to let :func:`flash_attention` sweep on first call per
 shape.
 
-The shipped numbers were measured on TPU v5e (see BASELINE.md round 2).
+**Multi-host pods**: the live sweep is disabled under multi-process SPMD on
+purpose (hosts could time different winners and trace divergent programs
+around the same collectives — hang). Instead, generate the table OFFLINE on
+one host of the same device kind and ship it to every host::
+
+    python -m distributed_pytorch_tpu.ops.flash_autotune \
+        --seq_lens 8192,16384 --head_dims 64,128 --export v5e_blocks.json
+    # then on every pod host:
+    export FLASH_BLOCKS_TABLE=/shared/v5e_blocks.json
+
+The explicit table outranks each host's private disk cache, so all hosts are
+guaranteed identical block choices (deterministic traces) even when their
+local caches disagree. The shipped DEFAULT_TABLE numbers were measured on
+TPU v5e (see BASELINE.md round 2).
 """
 
 from __future__ import annotations
@@ -76,6 +92,15 @@ def _key(device_kind: str, t: int, d: int, dtype_name: str, causal: bool):
     return (device_kind.lower(), t, d, dtype_name, bool(causal))
 
 
+@functools.lru_cache(maxsize=8)
+def _load_table_file(path: str) -> dict:
+    """Explicit precomputed table (FLASH_BLOCKS_TABLE): same JSON schema as
+    the disk cache. Errors are loud — a pod pointing at a bad table should
+    fail at startup, not silently fall back to divergent local caches."""
+    with open(path) as f:
+        return {tuple(json.loads(k)): tuple(v) for k, v in json.load(f).items()}
+
+
 def candidates(t: int, d: int) -> Iterable[Tuple[int, int]]:
     """Legal (block_q, block_k) pairs for sequence length ``t``: both divide
     ``t``, block_k lane-aligned (multiple of 128), VMEM-bounded."""
@@ -101,6 +126,12 @@ def lookup(
     key = _key(device_kind, t, d, dtype_name, causal)
     if key in _runtime_cache:
         return _runtime_cache[key]
+    table_path = os.environ.get("FLASH_BLOCKS_TABLE")
+    if table_path:
+        shipped = _load_table_file(table_path)
+        if key in shipped:
+            _runtime_cache[key] = shipped[key]
+            return shipped[key]
     disk = _load_disk_cache()
     if key in disk:
         _runtime_cache[key] = disk[key]
@@ -206,13 +237,24 @@ def main() -> None:
     parser.add_argument("--seq_lens", default="2048,8192,16384")
     parser.add_argument("--head_dims", default="64,128")
     parser.add_argument("--bh", default=16, type=int)
+    parser.add_argument(
+        "--export", default="",
+        help="write the swept entries to this JSON (ship to pod hosts via "
+        "FLASH_BLOCKS_TABLE so every host picks identical blocks)",
+    )
     args = parser.parse_args()
     kind = _device_kind()
     print(f"device: {kind}")
+    swept = {}
     for t in (int(x) for x in args.seq_lens.split(",")):
         for d in (int(x) for x in args.head_dims.split(",")):
             blocks = autotune(t, d, bh=args.bh, verbose=True)
             print(f"T={t:6d} d={d:4d} -> {blocks}")
+            swept[_key(kind, t, d, "bfloat16", True)] = blocks
+    if args.export:
+        with open(args.export, "w") as f:
+            json.dump({json.dumps(list(k)): list(v) for k, v in swept.items()}, f)
+        print(f"exported {len(swept)} entries to {args.export}")
 
 
 if __name__ == "__main__":

@@ -24,3 +24,42 @@ def softmax_cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp
     else:
         per_example = optax.softmax_cross_entropy(logits, targets)
     return jnp.mean(per_example)
+
+
+# -- per-sample twins (exact evaluation) -------------------------------------
+#
+# The batch-mean losses above are opaque reductions: under wrap-padded batches
+# (the DistributedSampler pad-by-repeat semantic) their mean over-counts the
+# duplicated rows. Evaluation uses these per-sample forms instead and weights
+# pad rows to zero, so eval metrics are EXACT on any dataset size / mesh shape
+# (see Trainer.evaluate). PER_SAMPLE_TWINS maps a batch loss to its per-sample
+# form so the Trainer can derive the exact path automatically.
+
+
+def per_sample_mse(predictions: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Squared error per sample: mean over feature dims only -> [batch]."""
+    se = jnp.square(predictions - targets)
+    return se.reshape(se.shape[0], -1).mean(axis=-1)
+
+
+def per_sample_cross_entropy(
+    logits: jnp.ndarray, targets: jnp.ndarray
+) -> jnp.ndarray:
+    """Cross entropy per sample -> [batch] (mean over any token dims)."""
+    if jnp.issubdtype(targets.dtype, jnp.integer):
+        per = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    else:
+        per = optax.softmax_cross_entropy(logits, targets)
+    return per.reshape(per.shape[0], -1).mean(axis=-1)
+
+
+def per_sample_accuracy(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """1.0 where argmax(logits) == integer target, else 0.0 -> [batch...]."""
+    correct = jnp.argmax(logits, axis=-1) == targets
+    return correct.reshape(correct.shape[0], -1).mean(axis=-1).astype(jnp.float32)
+
+
+PER_SAMPLE_TWINS = {
+    mse_loss: per_sample_mse,
+    softmax_cross_entropy_loss: per_sample_cross_entropy,
+}

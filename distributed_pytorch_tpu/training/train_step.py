@@ -305,3 +305,55 @@ def make_eval_step(
         donate=False,
         out_includes_state=False,
     )
+
+
+def make_metrics_eval_step(
+    apply_fn: Callable,
+    metric_fns,
+    *,
+    mesh: Optional[Mesh] = None,
+    data_axis: str = "data",
+    state_sharding: Optional[Any] = None,
+) -> Callable:
+    """Jitted exact-eval step: ``(state, (inputs, targets), weights) -> dict``.
+
+    Each ``metric_fns[name]`` maps ``(predictions, targets) -> [batch]``
+    per-sample values (see ``losses.PER_SAMPLE_TWINS``); the step returns
+    ``{name: sum(values * weights)}`` plus ``"__weight__": sum(weights)``.
+    Pad rows (wrap-padded duplicates from the loader, shard- or batch-level)
+    carry weight 0, so accumulating these sums over an epoch and dividing by
+    the total weight gives the EXACT distinct-sample mean on any dataset
+    size / mesh shape — closing the wrap-pad bias of the opaque-reduction
+    eval path. Sown penalty terms ("losses" collection, e.g. MoE load
+    balance) are batch-level, not per-sample: they are added to the ``loss``
+    metric scaled by the batch's weight so the epoch mean matches the train
+    step's accounting.
+    """
+
+    def eval_step(state: TrainState, batch, weights) -> dict:
+        inputs, targets = batch
+        predictions, aux = apply_fn(
+            {"params": state.params, **state.model_state},
+            inputs,
+            mutable=["losses"],
+        )
+        weight_sum = jnp.sum(weights)
+        out = {"__weight__": weight_sum}
+        for name, fn in metric_fns.items():
+            per = fn(predictions, targets)
+            out[name] = jnp.sum(per * weights.astype(per.dtype))
+        if "loss" in out:
+            for term in jax.tree_util.tree_leaves(dict(aux).get("losses", {})):
+                out["loss"] = out["loss"] + jnp.sum(term) * weight_sum
+        return out
+
+    if mesh is None:
+        return jax.jit(eval_step)
+    replicated = replicated_sharding(mesh)
+    state_sh = state_sharding if state_sharding is not None else replicated
+    sharded = batch_sharding(mesh, data_axis)
+    return jax.jit(
+        eval_step,
+        in_shardings=(state_sh, (sharded, sharded), sharded),
+        out_shardings=replicated,
+    )

@@ -146,6 +146,7 @@ class Trainer:
             model.apply, optimizer, loss_fn, mesh=mesh, grad_accum=grad_accum
         )
         self._eval_step = None  # built lazily on first evaluate()
+        self._eval_step_fns = None  # metric-fn set the cached step was built for
 
     # ---------------------------------------------------------------- persistence
 
@@ -251,23 +252,9 @@ class Trainer:
             kwargs["train"] = False
         return self.model.apply(variables, inputs, **kwargs)
 
-    def evaluate(self, eval_data: ShardedLoader) -> float:
-        """Forward-only mean loss over ``eval_data`` (no gradients, no state
-        mutation). No reference analog — the reference never evaluates
-        (SURVEY.md §5: loss is computed but not even logged).
-
-        Padding bias: on a mesh, an uneven final batch is wrap-padded to full
-        size, and the padded batch's mean counts the wrapped duplicates — the
-        same semantic ``DistributedSampler`` applies (and the training path
-        uses), so the eval loss is very slightly biased toward the wrapped
-        samples. ``loss_fn`` is an opaque scalar reduction, so the exact
-        distinct-sample mean would need per-sample losses; pass a dataset
-        divisible by the batch size (or ``drop_last=True``) when the
-        distinction matters."""
-        if self._eval_step is None:
-            self._eval_step = make_eval_step(
-                self._eval_apply, self.loss_fn, mesh=self.mesh
-            )
+    def _prepare_eval_loader(self, eval_data: ShardedLoader) -> ShardedLoader:
+        """Mesh divisibility checks + pad-final-batch on a COPY (the caller's
+        loader must not change behavior)."""
         if self.mesh is not None:
             data_size = self.mesh.shape.get("data", 1)
             if eval_data.batch_size % data_size != 0:
@@ -276,26 +263,99 @@ class Trainer:
                     f"by the mesh's data axis ({data_size})"
                 )
             if not eval_data.drop_last and not eval_data.pad_final_batch:
-                # P("data") placement needs full batches; wrap-padding
-                # slightly over-weights the wrapped samples in the mean — the
-                # same DistributedSampler semantic the training path uses.
-                # Pad a COPY: the caller's loader must not change behavior.
                 import copy
 
                 eval_data = copy.copy(eval_data)
                 eval_data.pad_final_batch = True
+        return eval_data
+
+    def evaluate(self, eval_data: ShardedLoader, metric_fns=None):
+        """Forward-only evaluation over ``eval_data`` (no gradients, no state
+        mutation). No reference analog — the reference never evaluates
+        (SURVEY.md §5: loss is computed but not even logged).
+
+        When ``loss_fn`` has a per-sample twin (``losses.PER_SAMPLE_TWINS`` —
+        both stock losses do) the mean is EXACT on any dataset size / mesh
+        shape: per-sample losses are computed and the loader's wrap-pad
+        duplicate rows (shard- and batch-level) are weighted to zero, so no
+        padding bias enters. ``metric_fns`` adds further per-sample metrics
+        (``{name: (predictions, targets) -> [batch]}``, e.g.
+        ``losses.per_sample_accuracy``); passing it returns a dict of means
+        instead of the bare loss float.
+
+        Custom opaque ``loss_fn``s (no per-sample twin, no ``metric_fns``)
+        fall back to the weighted-batch-mean path, which over-counts wrapped
+        duplicates on a non-divisible eval set under a mesh — the
+        DistributedSampler semantic the training path deliberately keeps."""
+        from distributed_pytorch_tpu.training.losses import PER_SAMPLE_TWINS
+
+        fns = dict(metric_fns or {})
+        if "loss" not in fns:
+            per_sample_loss = PER_SAMPLE_TWINS.get(self.loss_fn)
+            if per_sample_loss is not None:
+                fns["loss"] = per_sample_loss
+
+        if not fns:
+            return self._evaluate_batch_mean(eval_data)
+
+        # Key the cached step by the metric FUNCTIONS, not just their names:
+        # a different fn under the same name must rebuild, or it would
+        # silently return the old metric under the new label.
+        fns_key = frozenset(fns.items())
+        if self._eval_step is None or self._eval_step_fns != fns_key:
+            from distributed_pytorch_tpu.training.train_step import (
+                make_metrics_eval_step,
+            )
+
+            self._eval_step = make_metrics_eval_step(
+                self._eval_apply, fns, mesh=self.mesh
+            )
+            self._eval_step_fns = fns_key
+
+        eval_data = self._prepare_eval_loader(eval_data)
+        totals = None
+        weight_rows = eval_data.batch_weight_table()
+        for (xs, ys), w in zip(eval_data, weight_rows):
+            if self.mesh is None:
+                batch, weights = jax.device_put(((xs, ys), w))
+            else:
+                batch, weights = put_global_batch(self.mesh, ((xs, ys), w))
+            out = self._eval_step(self.state, batch, weights)
+            totals = (
+                out
+                if totals is None
+                else jax.tree_util.tree_map(jnp.add, totals, out)
+            )
+        if totals is None:
+            return 0.0 if metric_fns is None else {}
+        # One host fetch for all sums (a fetch per scalar would cost a round
+        # trip per metric on remote-tunnel backends).
+        names = sorted(totals)
+        host = np.asarray(jnp.stack([totals[k] for k in names]))
+        sums = dict(zip(names, host))
+        weight = max(float(sums.pop("__weight__")), 1e-9)
+        results = {name: float(value) / weight for name, value in sums.items()}
+        self.metrics.log(
+            int(self.state.step),
+            **{f"eval_{k}" if k != "loss" else "eval_loss": v
+               for k, v in results.items()},
+        )
+        return results if metric_fns is not None else results["loss"]
+
+    def _evaluate_batch_mean(self, eval_data: ShardedLoader) -> float:
+        """Legacy weighted-batch-mean eval for opaque loss functions (wrapped
+        duplicates count toward the mean — see ``evaluate``)."""
+        if self._eval_step is None or self._eval_step_fns is not None:
+            self._eval_step = make_eval_step(
+                self._eval_apply, self.loss_fn, mesh=self.mesh
+            )
+            self._eval_step_fns = None
+        eval_data = self._prepare_eval_loader(eval_data)
         losses, weights = [], []
         for xs, ys in eval_data:
-            # Keep device scalars; one host sync after the loop. Weight by
-            # the actual batch size: exact for ragged batches; for a
-            # wrap-padded batch the duplicates are inside the device mean, so
-            # the padded size IS the consistent weight (see docstring).
             losses.append(self._eval_step(self.state, self._put_batch(xs, ys)))
             weights.append(xs.shape[0])
         if losses:
-            # Stack on device and fetch ONCE: on remote-tunnel backends the
-            # value fetch is the only real sync, and per-scalar fetches would
-            # cost a round trip per eval batch.
             host_losses = np.asarray(jnp.stack(losses))
             eval_loss = float(np.average(host_losses, weights=weights))
         else:

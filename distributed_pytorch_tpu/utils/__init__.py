@@ -5,6 +5,12 @@ from distributed_pytorch_tpu.utils.data import (
     RandomDataset,
     ShardedLoader,
 )
+from distributed_pytorch_tpu.utils.datasets import (
+    cifar10_or_synthetic,
+    load_cifar10,
+    normalize_images,
+    synthetic_cifar10,
+)
 from distributed_pytorch_tpu.utils.platform import use_fake_cpu_devices
 
 __all__ = [
@@ -13,5 +19,9 @@ __all__ = [
     "NativeShardedLoader",
     "RandomDataset",
     "ShardedLoader",
+    "cifar10_or_synthetic",
+    "load_cifar10",
+    "normalize_images",
+    "synthetic_cifar10",
     "use_fake_cpu_devices",
 ]

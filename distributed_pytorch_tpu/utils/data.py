@@ -202,6 +202,32 @@ class ShardedLoader:
             rows.append(chunk)
         return rows
 
+    def batch_weight_table(self) -> "list[np.ndarray]":
+        """Per-batch sample weights (1.0 real / 0.0 wrap-pad duplicate),
+        aligned row-for-row with :meth:`batch_index_table`.
+
+        Two padding layers can duplicate samples: shard-level (the global
+        index list is wrapped so every shard has equal length — this shard's
+        row ``j`` came from padded-order position ``shard_index +
+        j * num_shards``, a duplicate iff that position >= dataset size) and
+        batch-level (``pad_final_batch`` wraps the final batch to full size).
+        Weighting both kinds to zero makes weighted eval sums EXACT
+        distinct-sample statistics on any dataset/mesh shape (the training
+        path deliberately keeps DistributedSampler's pad-by-repeat mean)."""
+        n = len(self.dataset)
+        per_shard = math.ceil(n / self.num_shards)
+        positions = self.shard_index + np.arange(per_shard) * self.num_shards
+        real = (positions < n).astype(np.float32)
+        rows = []
+        for b in range(len(self)):
+            chunk = real[b * self.batch_size : (b + 1) * self.batch_size]
+            if self.pad_final_batch and len(chunk) < self.batch_size:
+                chunk = np.concatenate(
+                    [chunk, np.zeros(self.batch_size - len(chunk), np.float32)]
+                )
+            rows.append(chunk)
+        return rows
+
     def __iter__(self) -> Iterator[Batch]:
         for chunk in self.batch_index_table():
             samples = [self.dataset[int(i)] for i in chunk]
@@ -220,6 +246,17 @@ class NativeShardedLoader(ShardedLoader):
     Requires a dataset exposing C-contiguous ``inputs``/``targets`` arrays
     (:class:`MaterializedDataset`). Batch order and contents are IDENTICAL to
     the Python loader (same index table); only who does the copying changes.
+
+    When it wins, measured (tools/loader_overlap_bench.py, BASELINE.md round
+    3): at SMALL rows the Python loader's per-item overhead dominates and the
+    pool assembles ~1.4x faster; at large rows (e.g. 224x224x3 images, where
+    one ``np.stack`` is a single fused memcpy) the pool's safe-ownership
+    design costs a second copy (worker gather -> ring slot, slot -> caller
+    array) and pure assembly is SLOWER than the Python loader — its value
+    there is only overlap with *device* compute, which a core-shared
+    CPU-backend rig cannot show (measured ~1.0x end to end). Zero-copy slot
+    views were considered and rejected: jax's CPU ``device_put`` may alias
+    numpy buffers, so recycling slot memory under a live view corrupts data.
     """
 
     def __init__(self, *args, num_workers: int = 2, prefetch_depth: int = 4, **kw):

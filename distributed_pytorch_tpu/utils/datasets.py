@@ -1,0 +1,150 @@
+"""Real datasets: CIFAR-10 loading, normalization, and a learnable stand-in.
+
+The reference's "real model" rung swaps torchvision models onto its synthetic
+loader (`/root/reference/multigpu_profile.py:13-27`); BASELINE.json's
+configs[4] names **ResNet-18 / CIFAR-10** as the real-data workload. This
+module supplies the data half:
+
+* :func:`load_cifar10` — loads the standard ``cifar-10-batches-py`` pickle
+  layout (or a previously converted ``cifar10.npz`` cache, which it writes on
+  first load) from ``data_dir``. It never downloads: on a connected machine,
+  fetch https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz and extract
+  into ``data_dir`` once; air-gapped rigs ship the ``.npz``.
+* :func:`synthetic_cifar10` — a deterministic, *learnable* 10-class stand-in
+  with CIFAR-10's exact shapes/dtypes for machines with no dataset and no
+  network (this CI rig): each class has a fixed random 32x32x3 template,
+  samples are template + Gaussian noise. A real model trains to high accuracy
+  on it, so the full real-data path (normalize -> augment-free train ->
+  exact eval accuracy) is exercised end to end; it is clearly labeled and
+  never silently substituted (``cifar10_or_synthetic`` prints which one ran).
+* :func:`normalize_images` — uint8 HWC -> float32 NHWC with per-channel
+  standardization (the torchvision ``transforms.Normalize`` twin).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+from typing import Tuple
+
+import numpy as np
+
+from distributed_pytorch_tpu.utils.data import ArrayDataset
+
+# Canonical CIFAR-10 per-channel statistics (training split, [0,1] scale).
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+
+Arrays = Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]
+
+
+def normalize_images(
+    images: np.ndarray,
+    mean: np.ndarray = CIFAR10_MEAN,
+    std: np.ndarray = CIFAR10_STD,
+) -> np.ndarray:
+    """uint8 ``[N, H, W, C]`` -> standardized float32 (NHWC, TPU-native)."""
+    x = images.astype(np.float32) / 255.0
+    return (x - mean) / std
+
+
+def _load_pickle_batches(batches_dir: str) -> Arrays:
+    def read(name):
+        with open(os.path.join(batches_dir, name), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)  # NHWC
+        y = np.asarray(d[b"labels"], np.int32)
+        return x, y
+
+    train = [read(f"data_batch_{i}") for i in range(1, 6)]
+    x_train = np.concatenate([x for x, _ in train])
+    y_train = np.concatenate([y for _, y in train])
+    x_test, y_test = read("test_batch")
+    return x_train, y_train, x_test, y_test
+
+
+def load_cifar10(data_dir: str = "data") -> Arrays:
+    """``(x_train u8 [50000,32,32,3], y_train i32, x_test u8 [10000,...], y_test)``.
+
+    Resolution order: ``cifar10.npz`` cache -> ``cifar-10-batches-py/`` pickles
+    -> ``cifar-10-python.tar.gz`` (extracted in place) -> FileNotFoundError
+    with fetch instructions. The ``.npz`` cache is written after a pickle load
+    so subsequent startups are one mmap'd read.
+    """
+    npz = os.path.join(data_dir, "cifar10.npz")
+    if os.path.exists(npz):
+        with np.load(npz) as d:
+            return d["x_train"], d["y_train"], d["x_test"], d["y_test"]
+
+    tar = os.path.join(data_dir, "cifar-10-python.tar.gz")
+    batches = os.path.join(data_dir, "cifar-10-batches-py")
+    if not os.path.isdir(batches) and os.path.exists(tar):
+        with tarfile.open(tar) as tf:
+            tf.extractall(data_dir)
+    if os.path.isdir(batches):
+        arrays = _load_pickle_batches(batches)
+        np.savez_compressed(
+            npz,
+            x_train=arrays[0], y_train=arrays[1],
+            x_test=arrays[2], y_test=arrays[3],
+        )
+        return arrays
+
+    raise FileNotFoundError(
+        f"CIFAR-10 not found under {data_dir!r}. Fetch "
+        "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz into that "
+        "directory (no auto-download: many TPU rigs are air-gapped), or use "
+        "synthetic_cifar10() / cifar10_or_synthetic()."
+    )
+
+
+def synthetic_cifar10(
+    n_train: int = 50000, n_test: int = 10000, seed: int = 0, noise: float = 0.35
+) -> Arrays:
+    """Deterministic learnable 10-class dataset with CIFAR-10 shapes/dtypes.
+
+    Class ``c``'s images are ``template_c + noise`` (templates drawn once from
+    ``U[0,255]``, noise ~ N(0, noise*128)), clipped back to uint8. At the
+    default noise the Bayes error is near zero but single pixels are
+    uninformative, so a model must actually learn the templates — accuracy is
+    a meaningful end-to-end signal, while no real-data claim is implied.
+    """
+    rng = np.random.default_rng(seed)
+    templates = rng.uniform(0, 255, size=(10, 32, 32, 3)).astype(np.float32)
+
+    def split(n, seed_offset):
+        r = np.random.default_rng([seed, seed_offset])
+        y = r.integers(0, 10, size=n).astype(np.int32)
+        x = templates[y] + r.normal(0.0, noise * 128.0, size=(n, 32, 32, 3))
+        return np.clip(x, 0, 255).astype(np.uint8), y
+
+    x_train, y_train = split(n_train, 1)
+    x_test, y_test = split(n_test, 2)
+    return x_train, y_train, x_test, y_test
+
+
+def cifar10_or_synthetic(data_dir: str = "data", **synth_kw):
+    """``(arrays, is_real)`` — real CIFAR-10 when present, else the synthetic
+    stand-in, with a printed notice so runs are never silently synthetic."""
+    try:
+        arrays = load_cifar10(data_dir)
+        print(f"[datasets] real CIFAR-10 loaded from {data_dir}", flush=True)
+        return arrays, True
+    except FileNotFoundError:
+        print(
+            "[datasets] CIFAR-10 not on disk and this rig has no egress -> "
+            "using the synthetic learnable stand-in (shapes/dtypes identical; "
+            "accuracy numbers are NOT real-CIFAR numbers)",
+            flush=True,
+        )
+        return synthetic_cifar10(**synth_kw), False
+
+
+def as_datasets(arrays: Arrays) -> Tuple[ArrayDataset, ArrayDataset]:
+    """Normalized train/test :class:`ArrayDataset` pair from raw arrays."""
+    x_train, y_train, x_test, y_test = arrays
+    return (
+        ArrayDataset(normalize_images(x_train), y_train),
+        ArrayDataset(normalize_images(x_test), y_test),
+    )

@@ -2,7 +2,9 @@
 step-scheduled TensorBoard traces. Twin of ``multigpu_profile.py``.
 
 * torchvision ``resnet50()`` (``multigpu_profile.py:23``) -> our flax ResNet-50
-  (NHWC, optional bfloat16 compute for the MXU);
+  (NHWC, optional bfloat16 compute for the MXU); the reference's commented-out
+  ``vit_l_32`` alternative (``multigpu_profile.py:24``) is a first-class flag
+  here: ``--model vit`` swaps in ``ViT_L32`` (305M params), no code edits;
 * ``torch.profiler`` with schedule(wait=1, warmup=1, active=5) and
   ``tensorboard_trace_handler`` (``:80-91``) -> ``StepProfiler`` over
   ``jax.profiler.start_trace/stop_trace`` with the same step schedule;
@@ -22,21 +24,27 @@ import jax.numpy as jnp
 import optax
 
 from distributed_pytorch_tpu import RandomDataset, ShardedLoader, StepProfiler, Trainer, make_mesh
-from distributed_pytorch_tpu.models import ResNet50
+from distributed_pytorch_tpu.models import ResNet50, ViT_L32
 from distributed_pytorch_tpu.training.losses import softmax_cross_entropy_loss
 
 
-def load_train_objs(bf16: bool):
-    """Factory twin of ``multigpu_profile.py:13-27``."""
+def load_train_objs(model_name: str, bf16: bool):
+    """Factory twin of ``multigpu_profile.py:13-27`` (the torchvision
+    resnet50/vit_l_32 swap-in, ``:23-24``, as a flag instead of a comment)."""
     dataset = RandomDataset(2048, (224, 224, 3), num_classes=1000)
-    model = ResNet50(dtype=jnp.bfloat16 if bf16 else jnp.float32)
+    dtype = jnp.bfloat16 if bf16 else jnp.float32
+    if model_name == "vit":
+        model = ViT_L32(num_classes=1000, dtype=dtype)
+    else:
+        model = ResNet50(dtype=dtype)
     optimizer = optax.sgd(1e-3, momentum=0.9)
     return dataset, model, optimizer
 
 
-def main(epochs: int, batch_size: int, bf16: bool, profile: bool, logdir: str):
+def main(epochs: int, batch_size: int, model_name: str, bf16: bool,
+         profile: bool, logdir: str):
     mesh = make_mesh() if jax.device_count() > 1 else None
-    dataset, model, optimizer = load_train_objs(bf16)
+    dataset, model, optimizer = load_train_objs(model_name, bf16)
     loader = ShardedLoader(dataset, batch_size * jax.device_count(), drop_last=True)
     profiler = StepProfiler(logdir, wait=1, warmup=1, active=5) if profile else None
     trainer = Trainer(
@@ -44,7 +52,7 @@ def main(epochs: int, batch_size: int, bf16: bool, profile: bool, logdir: str):
         loader,
         optimizer,
         save_every=epochs,  # checkpoint at the end (reference saves once, :107-108)
-        checkpoint_path="resnet50_checkpoint.npz",
+        checkpoint_path=f"{model_name}_checkpoint.npz",
         mesh=mesh,
         loss_fn=softmax_cross_entropy_loss,
         profiler=profiler,
@@ -57,13 +65,17 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="profiled ResNet-50 job (rung 5)")
     parser.add_argument("--epochs", default=3, type=int)
     parser.add_argument("--batch_size", default=32, type=int, help="per-chip batch size")
+    parser.add_argument("--model", default="resnet50", choices=["resnet50", "vit"],
+                        help="real model to train (reference multigpu_profile.py:23-24)")
     parser.add_argument("--bf16", action="store_true", help="bfloat16 compute (MXU-native)")
     parser.add_argument("--no_profile", action="store_true")
-    parser.add_argument("--logdir", default="log/resnet50", type=str)
+    parser.add_argument("--logdir", default="", type=str,
+                        help="trace directory (default: log/<model>)")
     parser.add_argument("--fake_devices", default=0, type=int,
                         help="debug: present N virtual CPU devices instead of real chips")
     args = parser.parse_args()
     if args.fake_devices:
         from distributed_pytorch_tpu.utils.platform import use_fake_cpu_devices
         use_fake_cpu_devices(args.fake_devices)
-    main(args.epochs, args.batch_size, args.bf16, not args.no_profile, args.logdir)
+    main(args.epochs, args.batch_size, args.model, args.bf16,
+         not args.no_profile, args.logdir or f"log/{args.model}")

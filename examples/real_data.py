@@ -1,0 +1,102 @@
+"""Rung 5b — real data: ResNet-18 on CIFAR-10 with exact eval accuracy.
+
+The reference's "real model" rung swaps a torchvision model onto its loader
+(``multigpu_profile.py:13-27``, with the ViT alternative commented at
+``:23-24``) but never trains on real data or evaluates. This rung completes
+the story the way BASELINE.json configs[4] ("ResNet-18 / CIFAR-10") asks:
+real (or clearly-labeled synthetic stand-in) CIFAR-10, normalized NHWC, SGD +
+momentum + cosine decay, and per-epoch **exact** eval accuracy via the
+Trainer's per-sample-weighted evaluation (wrap-pad duplicates weighted out —
+see ``Trainer.evaluate``).
+
+Run (real TPU, real data if ``--data_dir`` holds CIFAR-10, labeled synthetic
+stand-in otherwise — this rig has no egress):
+
+    python examples/real_data.py --epochs 4
+    python examples/real_data.py --epochs 2 --fake_devices 8   # CPU CI rig
+"""
+
+import argparse
+
+
+def main(args):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_pytorch_tpu import ShardedLoader, Trainer, make_mesh
+    from distributed_pytorch_tpu.models.resnet import ResNet18
+    from distributed_pytorch_tpu.training.losses import (
+        per_sample_accuracy,
+        softmax_cross_entropy_loss,
+    )
+    from distributed_pytorch_tpu.utils.datasets import (
+        as_datasets,
+        cifar10_or_synthetic,
+    )
+
+    arrays, is_real = cifar10_or_synthetic(args.data_dir)
+    if args.subset:
+        n_test = max(args.subset // 5, 1)
+        arrays = tuple(a[: n] for a, n in zip(
+            arrays, (args.subset, args.subset, n_test, n_test)
+        ))
+    train_ds, test_ds = as_datasets(arrays)
+
+    n_chips = jax.device_count()
+    mesh = make_mesh() if n_chips > 1 else None
+    global_batch = args.batch_size * n_chips
+    train_loader = ShardedLoader(train_ds, global_batch, shuffle=True)
+    eval_loader = ShardedLoader(test_ds, global_batch)
+
+    steps_per_epoch = len(train_loader)
+    schedule = optax.cosine_decay_schedule(
+        args.lr, args.epochs * steps_per_epoch
+    )
+    optimizer = optax.chain(
+        optax.add_decayed_weights(5e-4),
+        optax.sgd(schedule, momentum=0.9, nesterov=True),
+    )
+    model = ResNet18(num_classes=10, cifar_stem=True, dtype=jnp.bfloat16)
+    trainer = Trainer(
+        model,
+        train_loader,
+        optimizer,
+        save_every=0,
+        mesh=mesh,
+        loss_fn=softmax_cross_entropy_loss,
+        log_every=args.log_every,
+    )
+
+    metric_fns = {"accuracy": per_sample_accuracy}
+    metrics = {}
+    for epoch in range(args.epochs):
+        trainer._run_epoch(epoch)
+        trainer.epochs_run = epoch + 1
+        metrics = trainer.evaluate(eval_loader, metric_fns=metric_fns)
+        print(
+            f"epoch {epoch}: eval_loss={metrics.get('loss', float('nan')):.4f} "
+            f"eval_accuracy={metrics.get('accuracy', float('nan')):.4f} "
+            f"({'real CIFAR-10' if is_real else 'synthetic stand-in'})",
+            flush=True,
+        )
+    return metrics
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="ResNet-18 on CIFAR-10 (rung 5b)")
+    parser.add_argument("--epochs", default=4, type=int)
+    parser.add_argument("--batch_size", default=128, type=int,
+                        help="per-chip batch size")
+    parser.add_argument("--lr", default=0.1, type=float)
+    parser.add_argument("--data_dir", default="data", type=str)
+    parser.add_argument("--subset", default=0, type=int,
+                        help="debug: use only the first N train samples")
+    parser.add_argument("--log_every", default=0, type=int)
+    parser.add_argument("--fake_devices", default=0, type=int,
+                        help="debug: present N virtual CPU devices")
+    args = parser.parse_args()
+    if args.fake_devices:
+        from distributed_pytorch_tpu.utils.platform import use_fake_cpu_devices
+        use_fake_cpu_devices(args.fake_devices)
+    main(args)

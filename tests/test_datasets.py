@@ -1,0 +1,109 @@
+"""Real-data module tests: CIFAR-10 loading, normalization, synthetic stand-in."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu.utils.datasets import (
+    CIFAR10_MEAN,
+    CIFAR10_STD,
+    cifar10_or_synthetic,
+    load_cifar10,
+    normalize_images,
+    synthetic_cifar10,
+)
+
+
+def write_fake_cifar_pickles(data_dir):
+    """The standard cifar-10-batches-py layout with tiny deterministic data."""
+    batches = os.path.join(data_dir, "cifar-10-batches-py")
+    os.makedirs(batches)
+    rng = np.random.default_rng(0)
+
+    def write(name, n, seed):
+        r = np.random.default_rng(seed)
+        data = r.integers(0, 256, size=(n, 3072), dtype=np.int64).astype(np.uint8)
+        labels = r.integers(0, 10, size=n).tolist()
+        with open(os.path.join(batches, name), "wb") as f:
+            pickle.dump({b"data": data, b"labels": labels}, f)
+        return data, labels
+
+    train = [write(f"data_batch_{i}", 20, i) for i in range(1, 6)]
+    test = write("test_batch", 10, 99)
+    assert rng is not None
+    return train, test
+
+
+class TestLoadCifar10:
+    def test_loads_pickle_layout_and_caches_npz(self, tmp_path):
+        train, test = write_fake_cifar_pickles(tmp_path)
+        x_train, y_train, x_test, y_test = load_cifar10(str(tmp_path))
+        assert x_train.shape == (100, 32, 32, 3) and x_train.dtype == np.uint8
+        assert y_train.shape == (100,) and y_train.dtype == np.int32
+        assert x_test.shape == (10, 32, 32, 3)
+        # CHW->HWC transpose correctness: red channel of sample 0 comes from
+        # the first 1024 bytes of the row.
+        row = train[0][0][0]
+        np.testing.assert_array_equal(
+            x_train[0, :, :, 0], row[:1024].reshape(32, 32)
+        )
+        np.testing.assert_array_equal(y_test, np.asarray(test[1], np.int32))
+        # Second load comes from the npz cache and is identical.
+        assert os.path.exists(tmp_path / "cifar10.npz")
+        again = load_cifar10(str(tmp_path))
+        np.testing.assert_array_equal(again[0], x_train)
+
+    def test_missing_data_raises_with_instructions(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="cs.toronto.edu"):
+            load_cifar10(str(tmp_path / "nope"))
+
+    def test_fallback_is_labeled_synthetic(self, tmp_path, capsys):
+        arrays, is_real = cifar10_or_synthetic(
+            str(tmp_path / "nope"), n_train=50, n_test=10
+        )
+        assert not is_real
+        assert "synthetic" in capsys.readouterr().out.lower()
+        assert arrays[0].shape == (50, 32, 32, 3)
+
+
+class TestSyntheticCifar10:
+    def test_deterministic_and_shaped_like_cifar(self):
+        a = synthetic_cifar10(n_train=64, n_test=16)
+        b = synthetic_cifar10(n_train=64, n_test=16)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+        x_train, y_train, x_test, y_test = a
+        assert x_train.dtype == np.uint8 and y_train.dtype == np.int32
+        assert x_train.shape == (64, 32, 32, 3)
+        assert set(np.unique(y_train)) <= set(range(10))
+
+    def test_classes_are_separable(self):
+        """A nearest-template classifier must solve it — the stand-in's whole
+        point is that accuracy is a meaningful end-to-end signal."""
+        x_train, y_train, x_test, y_test = synthetic_cifar10(
+            n_train=500, n_test=100
+        )
+        means = np.stack(
+            [x_train[y_train == c].mean(axis=0) for c in range(10)]
+        )
+        d = ((x_test.astype(np.float32)[:, None] - means[None]) ** 2).sum(
+            axis=(2, 3, 4)
+        )
+        accuracy = (d.argmin(axis=1) == y_test).mean()
+        assert accuracy > 0.95
+
+
+class TestNormalize:
+    def test_standardizes_per_channel(self):
+        rng = np.random.default_rng(0)
+        images = rng.integers(0, 256, size=(4, 32, 32, 3), dtype=np.int64).astype(
+            np.uint8
+        )
+        out = normalize_images(images)
+        assert out.dtype == np.float32
+        expected = (images[0, 0, 0].astype(np.float32) / 255.0 - CIFAR10_MEAN) / (
+            CIFAR10_STD
+        )
+        np.testing.assert_allclose(out[0, 0, 0], expected, rtol=1e-6)

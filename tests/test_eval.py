@@ -3,6 +3,7 @@
 import jax
 import numpy as np
 import optax
+import pytest
 
 from distributed_pytorch_tpu.models import ResNet18, ToyRegressor
 from distributed_pytorch_tpu.parallel.mesh import make_mesh
@@ -114,3 +115,133 @@ def test_evaluate_sharded():
     sharded_loss = trainer.evaluate(ShardedLoader(data, 32))
     serial_loss = serial.evaluate(ShardedLoader(data, 32))
     np.testing.assert_allclose(sharded_loss, serial_loss, rtol=1e-6)
+
+
+# ----------------------------------------------------- exact (weighted) eval
+
+
+class TestExactEval:
+    """Trainer.evaluate with per-sample metrics: wrap-pad duplicates carry
+    weight zero, so eval means are exact on ANY dataset size / mesh shape —
+    closing the round-2 'wrap-pad bias is documented, not solved' item."""
+
+    def _trainer(self, mesh=None):
+        import jax.numpy as jnp
+        import optax
+
+        from distributed_pytorch_tpu import ShardedLoader, Trainer
+        from distributed_pytorch_tpu.models import ToyRegressor
+        from distributed_pytorch_tpu.training.losses import mse_loss
+        from distributed_pytorch_tpu.utils.data import MaterializedDataset
+
+        dataset = MaterializedDataset(64)
+        loader = ShardedLoader(dataset, 16)
+        return Trainer(
+            ToyRegressor(), loader, optax.sgd(1e-3), 0,
+            mesh=mesh, loss_fn=mse_loss,
+        )
+
+    def _exact_mse(self, trainer, dataset):
+        """Handmade distinct-sample mean loss, no loader in the loop."""
+        import jax
+        import numpy as np
+
+        params = jax.device_get(trainer.state.params)
+        preds = trainer.model.apply({"params": params}, dataset.inputs)
+        return float(np.mean(np.square(np.asarray(preds) - dataset.targets)))
+
+    @pytest.mark.parametrize("n_eval", [40, 64, 37])
+    def test_matches_handmade_mean_on_ragged_sets(self, n_eval):
+        """Eval loss == the true distinct-sample mean even when the eval set
+        is not divisible by the batch (serial: no wrap-pad needed either)."""
+        import numpy as np
+
+        from distributed_pytorch_tpu import ShardedLoader
+        from distributed_pytorch_tpu.utils.data import MaterializedDataset
+
+        trainer = self._trainer()
+        eval_ds = MaterializedDataset(n_eval, seed=7)
+        got = trainer.evaluate(ShardedLoader(eval_ds, 16))
+        np.testing.assert_allclose(got, self._exact_mse(trainer, eval_ds), rtol=1e-5)
+
+    @pytest.mark.parametrize("n_eval", [37, 52, 64])
+    def test_exact_on_mesh_with_wrap_padding(self, n_eval):
+        """On a mesh every ragged final batch IS wrap-padded (P('data') needs
+        full batches); the padded duplicates must not bias the mean."""
+        import numpy as np
+
+        from distributed_pytorch_tpu import ShardedLoader, make_mesh
+        from distributed_pytorch_tpu.utils.data import MaterializedDataset
+
+        mesh = make_mesh()
+        trainer = self._trainer(mesh=mesh)
+        eval_ds = MaterializedDataset(n_eval, seed=11)
+        got = trainer.evaluate(ShardedLoader(eval_ds, 16))
+        np.testing.assert_allclose(got, self._exact_mse(trainer, eval_ds), rtol=1e-5)
+
+    def test_exact_across_loader_shards(self):
+        """Sharded loaders wrap-pad at the SHARD level too (DistributedSampler
+        semantics); summing both shards' weighted sums must still be exact."""
+        import numpy as np
+
+        from distributed_pytorch_tpu import ShardedLoader
+        from distributed_pytorch_tpu.utils.data import MaterializedDataset
+
+        trainer = self._trainer()
+        eval_ds = MaterializedDataset(41, seed=3)  # odd: shards get 21 padded rows
+        per_shard = []
+        for idx in range(2):
+            loader = ShardedLoader(eval_ds, 8, num_shards=2, shard_index=idx)
+            weights = np.concatenate(loader.batch_weight_table())
+            indices = np.concatenate(loader.batch_index_table())
+            per_shard.append((indices, weights))
+        # Disjoint + exhaustive: rows with weight 1 across both shards are
+        # exactly the 41 distinct samples, each once.
+        real = np.concatenate([i[w > 0] for i, w in per_shard])
+        assert sorted(real.tolist()) == list(range(41))
+
+    def test_accuracy_metric(self):
+        """metric_fns adds exact per-sample accuracy; returns a dict."""
+        import numpy as np
+
+        import jax.numpy as jnp
+        import optax
+
+        from distributed_pytorch_tpu import ShardedLoader, Trainer
+        from distributed_pytorch_tpu.models.resnet import ResNet18
+        from distributed_pytorch_tpu.training.losses import (
+            per_sample_accuracy,
+            softmax_cross_entropy_loss,
+        )
+        from distributed_pytorch_tpu.utils.data import ArrayDataset
+
+        rng = np.random.default_rng(0)
+        train = ArrayDataset(
+            rng.standard_normal((8, 32, 32, 3)).astype(np.float32),
+            rng.integers(0, 10, size=(8,)).astype(np.int32),
+        )
+        eval_ds = ArrayDataset(
+            rng.standard_normal((11, 32, 32, 3)).astype(np.float32),  # ragged
+            rng.integers(0, 10, size=(11,)).astype(np.int32),
+        )
+        trainer = Trainer(
+            ResNet18(num_classes=10, cifar_stem=True, dtype=jnp.float32),
+            ShardedLoader(train, 8),
+            optax.sgd(1e-2),
+            0,
+            loss_fn=softmax_cross_entropy_loss,
+        )
+        metrics = trainer.evaluate(
+            ShardedLoader(eval_ds, 8), metric_fns={"accuracy": per_sample_accuracy}
+        )
+        assert set(metrics) == {"loss", "accuracy"}
+        # Cross-check accuracy against a handmade argmax over all 11 samples.
+        import jax
+
+        logits = trainer.model.apply(
+            {"params": trainer.state.params, **trainer.state.model_state},
+            eval_ds.inputs, train=False,
+        )
+        expected = float(np.mean(np.argmax(np.asarray(logits), -1) == eval_ds.targets))
+        np.testing.assert_allclose(metrics["accuracy"], expected, atol=1e-6)
+        assert jax  # silence unused-import lint

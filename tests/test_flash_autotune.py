@@ -56,3 +56,51 @@ def test_runtime_cache_wins_over_table(monkeypatch):
     key = fa._key("TPU v5 lite", 16384, 64, "bfloat16", True)
     monkeypatch.setitem(fa._runtime_cache, key, (256, 256))
     assert fa.lookup(16384, 64, device_kind="TPU v5 lite") == (256, 256)
+
+
+class TestShippedTableFile:
+    """FLASH_BLOCKS_TABLE: the pod workflow — an exported table outranks the
+    host's private disk cache, so all hosts pick identical blocks."""
+
+    def test_explicit_table_wins(self, tmp_path, monkeypatch):
+        import json
+
+        from distributed_pytorch_tpu.ops import flash_autotune as fa
+
+        key = fa._key("tpu v99", 4096, 64, "bfloat16", True)
+        table = tmp_path / "blocks.json"
+        table.write_text(json.dumps({json.dumps(list(key)): [256, 512]}))
+        monkeypatch.setenv("FLASH_BLOCKS_TABLE", str(table))
+        monkeypatch.setattr(fa, "_runtime_cache", {})
+        fa._load_table_file.cache_clear()
+        assert fa.lookup(4096, 64, "bfloat16", True, device_kind="tpu v99") == (
+            256,
+            512,
+        )
+
+    def test_missing_table_fails_loudly(self, tmp_path, monkeypatch):
+        import pytest
+
+        from distributed_pytorch_tpu.ops import flash_autotune as fa
+
+        monkeypatch.setenv("FLASH_BLOCKS_TABLE", str(tmp_path / "absent.json"))
+        monkeypatch.setattr(fa, "_runtime_cache", {})
+        fa._load_table_file.cache_clear()
+        with pytest.raises(FileNotFoundError):
+            fa.lookup(4096, 64, "bfloat16", True, device_kind="tpu v99")
+
+    def test_shape_not_in_table_falls_through(self, tmp_path, monkeypatch):
+        import json
+
+        from distributed_pytorch_tpu.ops import flash_autotune as fa
+
+        table = tmp_path / "blocks.json"
+        table.write_text(json.dumps({}))
+        monkeypatch.setenv("FLASH_BLOCKS_TABLE", str(table))
+        monkeypatch.setattr(fa, "_runtime_cache", {})
+        fa._load_table_file.cache_clear()
+        # Unknown device, empty table -> conservative fallback.
+        assert fa.lookup(4096, 64, "bfloat16", True, device_kind="tpu v99") == (
+            512,
+            1024,
+        )

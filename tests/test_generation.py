@@ -97,3 +97,80 @@ def test_sampling_with_temperature_and_topk():
     )
     assert out.shape == (2, 9)
     assert (out >= 0).all() and (out < 48).all()
+
+
+# ------------------------------------------------------- sharded generation
+
+
+class TestShardedGeneration:
+    def test_no_donation_warning(self):
+        """The KV cache is updated in place inside the decode loop; the old
+        useless donation produced 'Some donated buffers were not usable'
+        every call — assert it is gone for good."""
+        import warnings
+
+        model = tiny_lm()
+        params, tokens = make_params(model)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # any jax buffer warning -> failure
+            out = generate(model, params, jnp.asarray(tokens[:, :4]), 5)
+        assert out.shape == (2, 9)
+
+    def test_mesh_parity_with_single_device(self):
+        """Greedy decode on an 8-device data mesh must produce token-for-token
+        the same output as the single-device path."""
+        from distributed_pytorch_tpu.parallel.mesh import make_mesh
+
+        model = tiny_lm()
+        params, _ = make_params(model, batch=8, seq=6)
+        rng = np.random.default_rng(5)
+        prompt = jnp.asarray(rng.integers(0, 48, (8, 6)), jnp.int32)
+
+        single = generate(model, params, prompt, 7)
+        mesh = make_mesh({"data": 8})
+        sharded = generate(model, params, prompt, 7, mesh=mesh)
+        # Output is batch-sharded; gather for comparison.
+        np.testing.assert_array_equal(np.asarray(sharded), np.asarray(single))
+        assert sharded.sharding.spec == jax.sharding.PartitionSpec("data")
+
+    def test_mesh_parity_with_tensor_parallel_params(self):
+        """data x tensor mesh with megatron-sharded params: same tokens."""
+        from jax.sharding import NamedSharding
+        from distributed_pytorch_tpu.parallel.mesh import make_mesh
+        from distributed_pytorch_tpu.parallel.partitioning import (
+            TRANSFORMER_TP_RULES,
+            make_param_specs,
+        )
+
+        model = tiny_lm()
+        params, _ = make_params(model, batch=4, seq=5)
+        rng = np.random.default_rng(9)
+        prompt = jnp.asarray(rng.integers(0, 48, (4, 5)), jnp.int32)
+
+        single = generate(model, params, prompt, 6)
+        mesh = make_mesh({"data": 4, "tensor": 2})
+        specs = make_param_specs(params, TRANSFORMER_TP_RULES, mesh=mesh)
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), specs
+        )
+        sharded = generate(
+            model, params, prompt, 6, mesh=mesh, param_shardings=shardings
+        )
+        np.testing.assert_array_equal(np.asarray(sharded), np.asarray(single))
+
+    def test_ragged_prompts_on_mesh(self):
+        """prompt_lengths (ragged rows) compose with the sharded path."""
+        from distributed_pytorch_tpu.parallel.mesh import make_mesh
+
+        model = tiny_lm()
+        params, _ = make_params(model, batch=8, seq=6)
+        rng = np.random.default_rng(13)
+        prompt = jnp.asarray(rng.integers(0, 48, (8, 6)), jnp.int32)
+        lengths = jnp.asarray(rng.integers(2, 7, (8,)), jnp.int32)
+
+        single = generate(model, params, prompt, 4, prompt_lengths=lengths)
+        mesh = make_mesh({"data": 8})
+        sharded = generate(
+            model, params, prompt, 4, prompt_lengths=lengths, mesh=mesh
+        )
+        np.testing.assert_array_equal(np.asarray(sharded), np.asarray(single))

@@ -375,6 +375,15 @@ def main():
         out = {
             "device_kind": dev.device_kind,
             "peak_bf16_tflops": peak / 1e12,
+            "flops_basis": (
+                "resnet/vit rows: XLA cost analysis of the compiled step "
+                "(complete - no custom calls). transformer_lm rows: analytic "
+                "model FLOPs, 3*(2*P_matmul*tokens + causal attention "
+                "matmuls), identical for dense and fused head - XLA cannot "
+                "count Pallas custom-call FLOPs and undercounts the chunked "
+                "fused head, so cost analysis would misrank those rows "
+                "(BASELINE.md round 3)."
+            ),
             "workloads": matrix,
         }
         path = os.path.join(

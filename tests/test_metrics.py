@@ -1,0 +1,63 @@
+"""MetricLogger tests — the observability gap the reference leaves open
+(SURVEY.md §5: loss computed but never logged, unused SummaryWriter import at
+``multigpu_profile.py:10``)."""
+
+import json
+
+from distributed_pytorch_tpu.metrics import MetricLogger
+
+
+def parse_lines(text):
+    records = []
+    for line in text.splitlines():
+        if line.startswith("{"):
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return records
+
+
+def test_json_lines_schema(capsys):
+    logger = MetricLogger()
+    logger.log(3, loss=1.5, epoch=0)
+    logger.log(4, eval_loss=0.25)
+    logger.close()
+    records = parse_lines(capsys.readouterr().out)
+    assert len(records) == 2
+    assert records[0]["step"] == 3 and records[0]["loss"] == 1.5
+    assert records[1]["eval_loss"] == 0.25
+    assert all("elapsed_s" in r for r in records)
+
+
+def test_scalars_coerced_to_float(capsys):
+    import numpy as np
+
+    logger = MetricLogger()
+    logger.log(np.int64(1), loss=np.float32(0.5))  # device/np scalars OK
+    records = parse_lines(capsys.readouterr().out)
+    assert records[0] == {
+        "step": 1,
+        "elapsed_s": records[0]["elapsed_s"],
+        "loss": 0.5,
+    }
+
+
+def test_tensorboard_scalars_written(tmp_path, capsys):
+    import pytest
+
+    pytest.importorskip(
+        "torch.utils.tensorboard", reason="optional TB backend not installed"
+    )
+    logger = MetricLogger(tensorboard_dir=str(tmp_path))
+    logger.log(0, loss=2.0)
+    logger.log(1, loss=1.0)
+    logger.close()
+    capsys.readouterr()
+    event_files = list(tmp_path.glob("events.out.tfevents.*"))
+    assert event_files, "no TensorBoard event file written"
+    assert event_files[0].stat().st_size > 0
+
+
+def test_close_without_writer_is_safe():
+    MetricLogger().close()

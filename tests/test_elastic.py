@@ -143,6 +143,27 @@ class TestElasticAgent:
             "saw.2",
         ]
 
+    def test_explicit_jax_coordinator_port(self, tmp_path):
+        """--jax-coordinator-port lands verbatim in COORDINATOR_ADDRESS (the
+        round-2 'silent rdzv_port + 1 grab' is now an explicit, checkable
+        flag)."""
+        result = run_tpurun(
+            tmp_path,
+            """
+            import os
+            assert os.environ["COORDINATOR_ADDRESS"].endswith(":29777"), \
+                os.environ["COORDINATOR_ADDRESS"]
+            open("port_ok", "w").write("ok")
+            """,
+            "--standalone",
+            "--nproc-per-node",
+            "1",
+            "--jax-coordinator-port",
+            "29777",
+        )
+        assert result.returncode == 0, result.stderr
+        assert (tmp_path / "port_ok").exists()
+
     def test_restart_on_worker_failure(self, tmp_path):
         """One worker fails at generation 0; the whole world restarts and
         succeeds at generation 1 (torchrun restart-all semantics)."""

@@ -136,3 +136,43 @@ def test_lm_dp_training_matches_serial():
         s1, l1 = serial(s1, (inputs, targets))
         s2, l2 = dp(s2, put_global_batch(mesh, (np.asarray(inputs), np.asarray(targets))))
     np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+
+
+class TestRematPolicy:
+    """remat / remat_policy variants must be numerically identical — they
+    trade memory for recompute, never math (the 'mlp' policy keeps attention
+    kernels un-recomputed; measured +18% step time for 'full' at T=8192 on
+    v5e, BASELINE.md round 3)."""
+
+    def test_policies_match_no_remat(self):
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)
+        targets = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)
+
+        losses = {}
+        for name, kw in {
+            "none": dict(remat=False),
+            "full": dict(remat=True, remat_policy="full"),
+            "mlp": dict(remat=True, remat_policy="mlp"),
+        }.items():
+            model = TransformerLM(
+                vocab_size=64, d_model=16, n_layers=2, n_heads=2, d_ff=32, **kw
+            )
+            opt = optax.sgd(1e-2)
+            state = create_train_state(model, opt, tokens)
+            step = make_train_step(model.apply, opt, softmax_cross_entropy_loss)
+            for _ in range(3):
+                state, loss = step(state, (tokens, targets))
+            losses[name] = float(loss)
+        np.testing.assert_allclose(losses["none"], losses["full"], rtol=1e-6)
+        np.testing.assert_allclose(losses["none"], losses["mlp"], rtol=1e-6)
+
+    def test_unknown_policy_raises(self):
+        import pytest
+
+        model = TransformerLM(
+            vocab_size=64, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+            remat=True, remat_policy="everything",
+        )
+        with pytest.raises(ValueError, match="remat_policy"):
+            model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))

@@ -18,8 +18,11 @@ that machinery, TPU-native:
   port on the same host, bound by *worker* 0 (``--jax-coordinator-port``,
   default: rendezvous port + 1).
 * **Failure detection** — local: the agent polls its workers; any nonzero exit
-  is a failure. Remote: each agent heartbeats ``hb/<node>`` into the store and
-  a monitor thread watches the failure-generation key and peer heartbeats.
+  is a failure, and with ``--worker-heartbeat-timeout`` a worker that is
+  alive but silent (wedged in a collective, SIGSTOPped) is declared hung
+  when it stops touching its ``TPURUN_HEARTBEAT_FILE`` (the Trainer touches
+  it every batch). Remote: each agent heartbeats ``hb/<node>`` into the store
+  and the monitor watches the failure-generation key and peer heartbeats.
 * **Recovery** — torchrun's restart-all policy: on any failure the detecting
   agent bumps the generation key; every agent kills its local workers,
   re-rendezvouses at the new generation, and respawns, up to
@@ -78,6 +81,13 @@ class ElasticConfig:
     max_restarts: int = 3
     heartbeat_interval: float = 2.0
     heartbeat_timeout: float = 30.0
+    # > 0 enables HUNG-worker detection (exit-code polling only catches death;
+    # a worker wedged in a collective whose peer vanished, or SIGSTOPped,
+    # would otherwise hang the world silently): each worker gets a
+    # TPURUN_HEARTBEAT_FILE env var and must touch that file at least this
+    # often once training starts (the Trainer does so every batch). The clock
+    # starts at spawn, so set it above worst-case startup + compile time.
+    worker_heartbeat_timeout: float = 0.0
     env: Dict[str, str] = field(default_factory=dict)
 
     @property
@@ -97,6 +107,18 @@ class WorkerGroup:
 
     def __init__(self, cfg: ElasticConfig, cmd: List[str], restart_count: int):
         self.procs: List[subprocess.Popen] = []
+        self.hb_dir: Optional[str] = None
+        self.hb_files: List[str] = []
+        self.spawned_at = time.monotonic()
+        # Per-worker (last observed mtime, monotonic time it changed) — the
+        # staleness clock starts at spawn (mtime None until the first touch).
+        self._beats: List[tuple] = [
+            (None, self.spawned_at) for _ in range(cfg.nproc_per_node)
+        ]
+        if cfg.worker_heartbeat_timeout > 0:
+            import tempfile
+
+            self.hb_dir = tempfile.mkdtemp(prefix="tpurun_hb_")
         for local_rank in range(cfg.nproc_per_node):
             env = dict(os.environ)
             env.update(cfg.env)
@@ -107,7 +129,38 @@ class WorkerGroup:
                 LOCAL_RANK=str(local_rank),
                 TPURUN_RESTART_COUNT=str(restart_count),
             )
+            if self.hb_dir is not None:
+                hb_file = os.path.join(self.hb_dir, f"hb_{local_rank}")
+                env["TPURUN_HEARTBEAT_FILE"] = hb_file
+                self.hb_files.append(hb_file)
             self.procs.append(subprocess.Popen(cmd, env=env))
+
+    def hung_worker(self, timeout: float) -> Optional[int]:
+        """Local rank of a live worker whose heartbeat file went stale.
+
+        Staleness is judged on THIS process's monotonic clock: we record when
+        the observed mtime last *changed* (same pattern as the agent-level
+        ``_peer_dead``), so NTP clock steps can neither declare a healthy
+        worker hung nor mask a real hang. A worker that never touched its
+        file is measured from spawn (startup and first-compile count against
+        the timeout — size it accordingly). Finished workers are exempt: no
+        more beats are expected of them."""
+        now = time.monotonic()
+        for local_rank, (proc, hb_file) in enumerate(
+            zip(self.procs, self.hb_files)
+        ):
+            if proc.poll() is not None:
+                continue
+            try:
+                mtime = os.path.getmtime(hb_file)
+            except OSError:
+                mtime = None
+            last_mtime, seen_at = self._beats[local_rank]
+            if mtime != last_mtime:
+                self._beats[local_rank] = (mtime, now)
+            elif now - seen_at > timeout:
+                return local_rank
+        return None
 
     def poll(self) -> Optional[int]:
         """None while all run / after all succeeded; first nonzero exit code if
@@ -133,6 +186,11 @@ class WorkerGroup:
             except subprocess.TimeoutExpired:
                 p.kill()
                 p.wait()
+        if self.hb_dir is not None:
+            import shutil
+
+            shutil.rmtree(self.hb_dir, ignore_errors=True)
+            self.hb_dir = None
 
 
 class ElasticAgent:
@@ -317,6 +375,11 @@ class ElasticAgent:
                 if dead is not None:
                     self.store.add(GEN_KEY, 1)
                     return f"node {dead} heartbeat lost"
+            if cfg.worker_heartbeat_timeout > 0:
+                hung = group.hung_worker(cfg.worker_heartbeat_timeout)
+                if hung is not None:
+                    self.store.add(GEN_KEY, 1)
+                    return f"local worker {hung} hung (heartbeat file stale)"
             time.sleep(0.2)
 
     def _await_world_done(self, generation: int) -> str:
@@ -392,6 +455,15 @@ def make_parser() -> argparse.ArgumentParser:
         "fresh heartbeat (restart-the-world follows)",
     )
     p.add_argument(
+        "--worker-heartbeat-timeout",
+        type=float,
+        default=0.0,
+        help="> 0: declare a LOCAL worker hung (and restart the world) when "
+        "it has not touched its TPURUN_HEARTBEAT_FILE for this many seconds "
+        "(the Trainer touches it every batch); the clock starts at spawn, so "
+        "allow for startup + first compile",
+    )
+    p.add_argument(
         "--standalone",
         action="store_true",
         help="single-node shorthand: nnodes=1, store on an ephemeral local port",
@@ -435,6 +507,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         max_restarts=args.max_restarts,
         heartbeat_interval=args.heartbeat_interval,
         heartbeat_timeout=args.heartbeat_timeout,
+        worker_heartbeat_timeout=args.worker_heartbeat_timeout,
     )
     agent = ElasticAgent(cfg, [sys.executable, args.script] + args.script_args)
 

@@ -147,6 +147,13 @@ class Trainer:
         )
         self._eval_step = None  # built lazily on first evaluate()
         self._eval_step_fns = None  # metric-fn set the cached step was built for
+        # tpurun's hung-worker detector (--worker-heartbeat-timeout): when the
+        # agent sets this env var, touch the file every batch so a wedged
+        # worker (stuck in a collective whose peer died) is distinguishable
+        # from a slow one. None outside tpurun — zero overhead.
+        import os as _os
+
+        self._heartbeat_file = _os.environ.get("TPURUN_HEARTBEAT_FILE")
 
     # ---------------------------------------------------------------- persistence
 
@@ -164,6 +171,10 @@ class Trainer:
             )
 
     def _save_snapshot(self, epoch: int) -> None:
+        # Long synchronous saves (and the paranoid replica check) run no
+        # batches; beat before and after so the hung-worker detector doesn't
+        # mistake a big checkpoint for a wedge.
+        self._touch_heartbeat()
         if self.paranoid:
             from distributed_pytorch_tpu.parallel.consistency import (
                 assert_replicas_consistent,
@@ -183,6 +194,7 @@ class Trainer:
                 f"Epoch {epoch} | {note} at {self.snapshot_path}",
                 flush=True,
             )
+        self._touch_heartbeat()
 
     def _save_checkpoint(self, epoch: int) -> None:
         # Params AND non-trainable model state (BatchNorm running stats):
@@ -209,7 +221,22 @@ class Trainer:
     def _run_batch(self, batch) -> float:
         """One optimizer step (twin of ``_run_batch``, ``single_gpu.py:21-26``)."""
         self.state, loss = self.train_step(self.state, batch)
+        self._touch_heartbeat()
         return loss
+
+    def _touch_heartbeat(self) -> None:
+        """Liveness beat for tpurun's hung-worker detector. Called at every
+        point of progress (each train/eval batch, around snapshot writes) —
+        no-op outside tpurun, and never allowed to kill training."""
+        if self._heartbeat_file is None:
+            return
+        import os
+
+        try:
+            os.close(os.open(self._heartbeat_file, os.O_CREAT | os.O_WRONLY))
+            os.utime(self._heartbeat_file)
+        except OSError:
+            pass
 
     def _run_epoch(self, epoch: int) -> float:
         """One pass over this process's shard (twin of ``_run_epoch``,
@@ -321,6 +348,7 @@ class Trainer:
             else:
                 batch, weights = put_global_batch(self.mesh, ((xs, ys), w))
             out = self._eval_step(self.state, batch, weights)
+            self._touch_heartbeat()
             totals = (
                 out
                 if totals is None
@@ -354,6 +382,7 @@ class Trainer:
         losses, weights = [], []
         for xs, ys in eval_data:
             losses.append(self._eval_step(self.state, self._put_batch(xs, ys)))
+            self._touch_heartbeat()
             weights.append(xs.shape[0])
         if losses:
             host_losses = np.asarray(jnp.stack(losses))

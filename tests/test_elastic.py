@@ -189,6 +189,48 @@ class TestElasticAgent:
         names = {p.name for p in tmp_path.glob("done.*")}
         assert {"done.0.1", "done.1.1"} <= names
 
+    def test_hung_worker_detected_via_heartbeat_file(self, tmp_path):
+        """A worker that stays ALIVE but stops making progress (wedged in a
+        collective, SIGSTOPped, deadlocked) is invisible to exit-code polling;
+        with --worker-heartbeat-timeout the agent watches each worker's
+        TPURUN_HEARTBEAT_FILE and restarts the world when one goes stale."""
+        result = run_tpurun(
+            tmp_path,
+            """
+            import os, sys, time
+            hb = os.environ["TPURUN_HEARTBEAT_FILE"]
+            restart = int(os.environ["TPURUN_RESTART_COUNT"])
+            pid = os.environ["PROCESS_ID"]
+
+            def touch():
+                open(hb, "w").write("x")
+
+            if restart == 0:
+                if pid == "1":
+                    for _ in range(3):
+                        touch()
+                        time.sleep(0.5)
+                    time.sleep(120)  # hang: alive but silent
+                else:
+                    for _ in range(240):  # healthy: keeps beating
+                        touch()
+                        time.sleep(0.5)
+                    sys.exit(1)
+            open(f"done.{pid}.{restart}", "w").write("ok")
+            """,
+            "--standalone",
+            "--nproc-per-node",
+            "2",
+            "--max-restarts",
+            "2",
+            "--worker-heartbeat-timeout",
+            "4",
+        )
+        assert result.returncode == 0, result.stdout + result.stderr
+        assert "hung" in result.stdout
+        names = {p.name for p in tmp_path.glob("done.*")}
+        assert {"done.0.1", "done.1.1"} <= names
+
     def test_restarts_exhausted_is_fatal(self, tmp_path):
         result = run_tpurun(
             tmp_path,

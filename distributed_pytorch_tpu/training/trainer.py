@@ -21,6 +21,7 @@ only, with a cross-host barrier after the write.
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Optional
 
 import jax
@@ -137,8 +138,6 @@ class Trainer:
         # Snapshot probe-on-init: the elasticity contract
         # (reference multigpu_torchrun.py:30-32).
         if snapshot_path is not None:
-            import os
-
             if os.path.exists(snapshot_path):
                 self._load_snapshot(snapshot_path)
 
@@ -151,9 +150,7 @@ class Trainer:
         # agent sets this env var, touch the file every batch so a wedged
         # worker (stuck in a collective whose peer died) is distinguishable
         # from a slow one. None outside tpurun — zero overhead.
-        import os as _os
-
-        self._heartbeat_file = _os.environ.get("TPURUN_HEARTBEAT_FILE")
+        self._heartbeat_file = os.environ.get("TPURUN_HEARTBEAT_FILE")
 
     # ---------------------------------------------------------------- persistence
 
@@ -198,7 +195,9 @@ class Trainer:
 
     def _save_checkpoint(self, epoch: int) -> None:
         # Params AND non-trainable model state (BatchNorm running stats):
-        # the reference's state_dict includes both (multigpu.py:54).
+        # the reference's state_dict includes both (multigpu.py:54). Beat
+        # around the synchronous save, same as _save_snapshot.
+        self._touch_heartbeat()
         save_checkpoint(
             self.checkpoint_path,
             {"params": self.state.params, "model_state": self.state.model_state},
@@ -209,6 +208,7 @@ class Trainer:
                 f"Epoch {epoch} | Training checkpoint saved at {self.checkpoint_path}",
                 flush=True,
             )
+        self._touch_heartbeat()
 
     # ---------------------------------------------------------------- training
 
@@ -230,8 +230,6 @@ class Trainer:
         no-op outside tpurun, and never allowed to kill training."""
         if self._heartbeat_file is None:
             return
-        import os
-
         try:
             os.close(os.open(self._heartbeat_file, os.O_CREAT | os.O_WRONLY))
             os.utime(self._heartbeat_file)

@@ -6,6 +6,7 @@ from distributed_pytorch_tpu.utils.data import (
     ShardedLoader,
 )
 from distributed_pytorch_tpu.utils.datasets import (
+    AugmentedDataset,
     cifar10_or_synthetic,
     load_cifar10,
     normalize_images,
@@ -15,6 +16,7 @@ from distributed_pytorch_tpu.utils.platform import use_fake_cpu_devices
 
 __all__ = [
     "ArrayDataset",
+    "AugmentedDataset",
     "MaterializedDataset",
     "NativeShardedLoader",
     "RandomDataset",

@@ -164,8 +164,12 @@ class ShardedLoader:
         self._epoch = 0
 
     def set_epoch(self, epoch: int) -> None:
-        """Reseed shuffling for ``epoch`` (twin of ``DistributedSampler.set_epoch``)."""
+        """Reseed shuffling for ``epoch`` (twin of ``DistributedSampler.set_epoch``);
+        forwarded to the dataset when it is epoch-aware (e.g.
+        ``AugmentedDataset``: fresh deterministic crops/flips per epoch)."""
         self._epoch = epoch
+        if hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
 
     def shard_indices(self) -> np.ndarray:
         """The (padded, strided) global indices owned by this shard, this epoch."""

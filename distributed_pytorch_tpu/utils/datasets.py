@@ -148,3 +148,41 @@ def as_datasets(arrays: Arrays) -> Tuple[ArrayDataset, ArrayDataset]:
         ArrayDataset(normalize_images(x_train), y_train),
         ArrayDataset(normalize_images(x_test), y_test),
     )
+
+
+class AugmentedDataset:
+    """Standard small-image train augmentation: pad-and-random-crop + random
+    horizontal flip (the canonical CIFAR recipe), deterministic per
+    ``(seed, epoch, index)`` so loss curves are reproducible and multi-process
+    shards agree — the reference's ``DataLoader`` transforms are stochastic;
+    here determinism is what makes serial == DP parity testable.
+
+    Wraps any dataset of NHWC float32 rows. ``ShardedLoader.set_epoch``
+    forwards the epoch so every epoch sees fresh crops/flips.
+    """
+
+    def __init__(self, base, pad: int = 4, seed: int = 0):
+        self.base = base
+        self.pad = pad
+        self.seed = seed
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def __len__(self) -> int:
+        return len(self.base)
+
+    def __getitem__(self, index: int):
+        x, y = self.base[index]
+        rng = np.random.default_rng([self.seed, self._epoch, index])
+        h, w = x.shape[0], x.shape[1]
+        padded = np.pad(
+            x, ((self.pad, self.pad), (self.pad, self.pad), (0, 0)), "reflect"
+        )
+        top = rng.integers(0, 2 * self.pad + 1)
+        left = rng.integers(0, 2 * self.pad + 1)
+        x = padded[top : top + h, left : left + w]
+        if rng.integers(0, 2):
+            x = x[:, ::-1]
+        return np.ascontiguousarray(x), y

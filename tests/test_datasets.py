@@ -107,3 +107,56 @@ class TestNormalize:
             CIFAR10_STD
         )
         np.testing.assert_allclose(out[0, 0, 0], expected, rtol=1e-6)
+
+
+class TestAugmentedDataset:
+    def _base(self):
+        from distributed_pytorch_tpu.utils.data import ArrayDataset
+
+        rng = np.random.default_rng(0)
+        return ArrayDataset(
+            rng.standard_normal((8, 32, 32, 3)).astype(np.float32),
+            rng.integers(0, 10, size=(8,)).astype(np.int32),
+        )
+
+    def test_deterministic_per_epoch_and_index(self):
+        from distributed_pytorch_tpu.utils.datasets import AugmentedDataset
+
+        a, b = AugmentedDataset(self._base()), AugmentedDataset(self._base())
+        a.set_epoch(3)
+        b.set_epoch(3)
+        xa, ya = a[5]
+        xb, yb = b[5]
+        np.testing.assert_array_equal(xa, xb)
+        assert ya == yb
+
+    def test_epoch_changes_augmentation(self):
+        from distributed_pytorch_tpu.utils.datasets import AugmentedDataset
+
+        ds = AugmentedDataset(self._base())
+        ds.set_epoch(0)
+        x0, _ = ds[2]
+        ds.set_epoch(1)
+        x1, _ = ds[2]
+        assert x0.shape == (32, 32, 3)
+        assert not np.array_equal(x0, x1), "epochs must see fresh crops/flips"
+
+    def test_loader_forwards_epoch(self):
+        from distributed_pytorch_tpu.utils.data import ShardedLoader
+        from distributed_pytorch_tpu.utils.datasets import AugmentedDataset
+
+        ds = AugmentedDataset(self._base())
+        loader = ShardedLoader(ds, 4)
+        loader.set_epoch(7)
+        assert ds._epoch == 7
+
+    def test_label_and_shape_preserved(self):
+        from distributed_pytorch_tpu.utils.datasets import AugmentedDataset
+
+        base = self._base()
+        ds = AugmentedDataset(base)
+        for i in range(len(ds)):
+            x, y = ds[i]
+            assert x.shape == base.inputs[i].shape
+            assert y == base.targets[i]
+            assert x.flags["C_CONTIGUOUS"]

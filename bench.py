@@ -255,10 +255,23 @@ def bench_toy_mlp(n_steps: int = 200):
     }
 
 
-def bench_lm(seq_len: int, fused: bool, n_steps: int = 10):
+def bench_lm(
+    seq_len: int,
+    fused: bool,
+    n_steps: int = 10,
+    d_model: int = 512,
+    n_layers: int = 6,
+    n_heads: int = 8,
+    d_ff: int = 2048,
+):
     """TransformerLM bf16 train: vocab 32k, 6 layers, d_model 512. The fused
     LM head (``fused_head_chunk``) is the measured variable: at vocab 32k the
-    [N, V] logits tensor is the largest activation by far."""
+    [N, V] logits tensor is the largest activation by far.
+
+    The non-default dims measure the d_head=128 scale-up rows: BASELINE.md's
+    roofline shows d_head=64 caps the MXU's 128-wide contraction at 50%, so
+    MFU at the reference-ladder size (d_model 512) understates what the
+    framework sustains when the model shape fills the array."""
     import jax
     import numpy as np
     import optax
@@ -271,7 +284,7 @@ def bench_lm(seq_len: int, fused: bool, n_steps: int = 10):
     )
     from distributed_pytorch_tpu.utils.data import ArrayDataset, NativeShardedLoader
 
-    vocab, d_model, n_layers, n_heads, d_ff = 32768, 512, 6, 8, 2048
+    vocab = 32768
     batch = max(1, 16384 // seq_len)  # ~16k tokens per step
     n_chips = jax.device_count()
 
@@ -326,8 +339,10 @@ def bench_lm(seq_len: int, fused: bool, n_steps: int = 10):
     flops = 3.0 * (2.0 * (n_params - embed_params) * tokens + attn_fwd)
     _, elapsed = timed_steps(step, state, list(loader), n_steps, warmup=3)
     tag = "fused" if fused else "dense"
+    default_dims = (d_model, n_layers, n_heads, d_ff) == (512, 6, 8, 2048)
+    size = "" if default_dims else f"_{round(n_params / 1e6)}M_dhead{head_dim}"
     return {
-        "workload": f"transformer_lm_t{seq_len}_{tag}_head",
+        "workload": f"transformer_lm{size}_t{seq_len}_{tag}_head",
         "steps_per_sec": n_steps / elapsed,
         "tokens_per_sec": n_steps * batch * seq_len / elapsed,
         "flops_per_step": flops,
@@ -372,6 +387,15 @@ def main():
         for seq in (2048, 8192):
             for fused in (False, True):
                 matrix.append(attach_mfu(bench_lm(seq, fused), peak))
+        # d_head=128 scale-ups: the MFU the framework sustains once the model
+        # shape fills the MXU's 128-wide contraction (see bench_lm docstring).
+        matrix.append(attach_mfu(
+            bench_lm(8192, True, d_model=1024, n_layers=12, d_ff=4096), peak
+        ))
+        matrix.append(attach_mfu(
+            bench_lm(8192, True, d_model=2048, n_layers=6, n_heads=16,
+                     d_ff=8192), peak
+        ))
         out = {
             "device_kind": dev.device_kind,
             "peak_bf16_tflops": peak / 1e12,

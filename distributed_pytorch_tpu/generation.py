@@ -30,6 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_pytorch_tpu.ops.quant import dequantize_pytree
+
 
 def generate(
     model,
@@ -45,6 +47,7 @@ def generate(
     mesh: Optional[Mesh] = None,
     data_axis: str = "data",
     param_shardings=None,
+    quantize: bool = False,
 ) -> jnp.ndarray:
     """Generate ``max_new_tokens`` continuations for ``prompt`` ``[B, T0]``.
 
@@ -65,8 +68,36 @@ def generate(
     The decode hot loop itself feeds ONE token per step, so the flash kernel
     (built for long query blocks) does not apply; cache reads stay the
     einsum-over-cache path, which XLA fuses well at ``T_step=1``.
+
+    ``quantize=True`` stores the matmul weights as int8 + per-channel scales
+    (``ops.quant``, symmetric absmax) and dequantizes INSIDE the compiled
+    decode loop — decode is HBM-bound on weight reads, so int8 halves the
+    traffic on the quantized weights. Greedy outputs typically match the
+    full-precision path exactly (see tests/test_quant.py).
     """
     decode_model = model.clone(decode=True)
+    if quantize:
+        if param_shardings is not None:
+            raise NotImplementedError(
+                "quantize=True with param_shardings (TP decode) is not "
+                "supported yet: the sharding tree does not match the "
+                "quantized param tree"
+            )
+        from distributed_pytorch_tpu.ops.quant import (
+            QuantTensor,
+            quantize_pytree,
+        )
+
+        already = any(
+            isinstance(leaf, QuantTensor)
+            for leaf in jax.tree_util.tree_leaves(
+                params, is_leaf=lambda x: isinstance(x, QuantTensor)
+            )
+        )
+        # Accept a pre-quantized tree (quantize_pytree run once by the
+        # caller) so repeated generate() calls don't pay re-quantization.
+        if not already:
+            params = quantize_pytree(params)
     batch, prompt_len = prompt.shape
     total_len = prompt_len + max_new_tokens
     if prompt_lengths is None:
@@ -136,8 +167,15 @@ def _compiled_run(decode_model, total_len: int, temperature: float, top_k: int):
         def body(t, carry):
             tokens, cache, rng = carry
             current = jax.lax.dynamic_slice(tokens, (0, t), (batch, 1))
+            # Dequantize (a no-op tree_map when nothing is quantized) INSIDE
+            # the loop body: the int8->compute-dtype convert is a producer
+            # each weight's consumer matmul fuses, so the loop reads int8
+            # from HBM.
+            dtype = getattr(decode_model, "dtype", jnp.bfloat16)
             logits, updated = decode_model.apply(
-                {"params": params, "cache": cache}, current, mutable=["cache"]
+                {"params": dequantize_pytree(params, dtype), "cache": cache},
+                current,
+                mutable=["cache"],
             )
             cache = updated["cache"]
             rng, step_rng = jax.random.split(rng)

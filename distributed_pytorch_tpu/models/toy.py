@@ -7,10 +7,15 @@ import jax.numpy as jnp
 
 
 class ToyRegressor(nn.Module):
-    """A single dense layer: ``(batch, in_features) -> (batch, features)``."""
+    """A single dense layer: ``(batch, in_features) -> (batch, features)``.
+
+    ``dtype`` is the compute dtype (``Policy.compute_dtype``); parameters
+    stay float32 (flax's ``param_dtype`` default) — master weights.
+    """
 
     features: int = 1
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        return nn.Dense(self.features, name="linear")(x)
+        return nn.Dense(self.features, dtype=self.dtype, name="linear")(x)

@@ -1,0 +1,126 @@
+"""Weight-only int8 quantization for memory-bound inference.
+
+No reference analog (the reference is a training tutorial); on TPU the case
+is structural: batched autoregressive decode is HBM-bandwidth-bound on
+*parameter reads* (every generated token re-reads every weight), so halving
+weight bytes approaches 2x tokens/s. This module stores weights as
+``int8 q`` + float32 per-output-channel ``scale`` (symmetric, absmax) and
+dequantizes at the point of use INSIDE the compiled decode loop —
+``q.astype(bf16) * scale`` feeding a matmul is a producer fusion XLA handles,
+so the bf16 weights are never materialized in HBM; the loop reads int8.
+
+Usage (see ``generation.generate(quantize=True)``):
+
+    qtree = quantize_pytree(params, TRANSFORMER_QUANT_RULES)
+    ...inside jit: params = dequantize_pytree(qtree, jnp.bfloat16)
+
+Quantization error for symmetric absmax int8 on well-scaled weights is
+~0.2-0.4% RMS — below bf16 activation noise for decode ranking; the parity
+test asserts top-1 agreement against the f32 path on a trained toy model.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Sequence, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+
+
+@flax.struct.dataclass
+class QuantTensor:
+    """Symmetric int8 tensor: ``value ~= q * scale`` with ``scale`` shaped
+    like ``q`` with the contraction dims collapsed to 1 (broadcast-ready)."""
+
+    q: jnp.ndarray  # int8, original shape
+    scale: jnp.ndarray  # float32, keepdims-reduced over contract dims
+
+
+def quantize_int8(
+    w: jnp.ndarray, contract_dims: Sequence[int]
+) -> QuantTensor:
+    """Per-output-channel symmetric absmax quantization: the scale is computed
+    over ``contract_dims`` (the dims a matmul sums over), so each output
+    channel keeps its own dynamic range."""
+    w = jnp.asarray(w, jnp.float32)
+    absmax = jnp.max(jnp.abs(w), axis=tuple(contract_dims), keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return QuantTensor(q=q, scale=scale)
+
+
+def dequantize(qt: QuantTensor, dtype: Any = jnp.bfloat16) -> jnp.ndarray:
+    """``q * scale`` in ``dtype``. Under jit this is a producer the consumer
+    matmul fuses — no HBM materialization of the dequantized tensor."""
+    return (qt.q.astype(jnp.float32) * qt.scale).astype(dtype)
+
+
+# (path-regex, contract_dims) — which dims of each matched kernel a matmul
+# contracts over, i.e. the dims to reduce when computing per-channel scales.
+# Matches TransformerLM's param tree (models/transformer.py): QKV are
+# DenseGeneral [d_model, H, Dh] (contract 0), attention-out is
+# DenseGeneral(axis=(-2,-1)) [H, Dh, d_model] (contract 0,1), MLP/LM-head
+# kernels are [in, out] (contract 0). Embedding is a gather, not a matmul —
+# quantizing it saves bytes but not decode time; biases/LayerNorms are tiny.
+TRANSFORMER_QUANT_RULES: Sequence[Tuple[str, Tuple[int, ...]]] = (
+    (r".*/attention/(query|key|value)/kernel$", (0,)),
+    (r".*/attention/out/kernel$", (0, 1)),
+    (r".*/mlp/(up|down)/kernel$", (0,)),
+    (r"^lm_head/kernel$", (0,)),
+)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for entry in path:
+        if isinstance(entry, jtu.DictKey):
+            parts.append(str(entry.key))
+        elif isinstance(entry, jtu.SequenceKey):
+            parts.append(str(entry.idx))
+        else:
+            parts.append(str(getattr(entry, "name", entry)))
+    return "/".join(parts)
+
+
+def quantize_pytree(params: Any, rules=TRANSFORMER_QUANT_RULES) -> Any:
+    """Quantize every param whose "/"-joined path matches a rule (first match
+    wins); everything else passes through unchanged. The result has the same
+    tree structure with :class:`QuantTensor` nodes at matched leaves."""
+    compiled = [(re.compile(pattern), dims) for pattern, dims in rules]
+
+    def maybe_quant(path, leaf):
+        path_s = _path_str(path)
+        for pattern, dims in compiled:
+            if pattern.match(path_s):
+                return quantize_int8(leaf, dims)
+        return leaf
+
+    return jtu.tree_map_with_path(maybe_quant, params)
+
+
+def dequantize_pytree(qparams: Any, dtype: Any = jnp.bfloat16) -> Any:
+    """Inverse of :func:`quantize_pytree` — call INSIDE jit so XLA fuses the
+    dequant into each weight's consumer matmul."""
+    return jtu.tree_map(
+        lambda leaf: dequantize(leaf, dtype)
+        if isinstance(leaf, QuantTensor)
+        else leaf,
+        qparams,
+        is_leaf=lambda x: isinstance(x, QuantTensor),
+    )
+
+
+def quantized_bytes(tree: Any) -> Tuple[int, int]:
+    """(bytes_quantized, bytes_original_f32) over matched leaves — the memory
+    story for logs/tests."""
+    q_bytes = orig = 0
+    for leaf in jtu.tree_leaves(
+        tree, is_leaf=lambda x: isinstance(x, QuantTensor)
+    ):
+        if isinstance(leaf, QuantTensor):
+            q_bytes += leaf.q.size + leaf.scale.size * 4
+            orig += leaf.q.size * 4
+    return q_bytes, orig

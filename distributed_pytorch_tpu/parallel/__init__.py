@@ -10,6 +10,8 @@ from distributed_pytorch_tpu.parallel.partitioning import (
     make_param_specs,
     make_state_shardings,
     make_state_specs,
+    make_zero1_shardings,
+    make_zero1_state_specs,
     shard_train_state,
 )
 from distributed_pytorch_tpu.parallel.pipeline import (
@@ -33,6 +35,8 @@ __all__ = [
     "make_param_specs",
     "make_state_shardings",
     "make_state_specs",
+    "make_zero1_shardings",
+    "make_zero1_state_specs",
     "put_global_batch",
     "replicated_sharding",
     "setup_distributed",

@@ -154,6 +154,45 @@ def make_state_specs(state, param_specs):
         model_state=_replicated_like(state.model_state),
         opt_state=_opt_state_specs(state.opt_state, state.params, param_specs),
         step=P(),
+        loss_scale=_replicated_like(state.loss_scale),
+    )
+
+
+def make_zero1_state_specs(state, *, mesh: Mesh, axis: str = "data"):
+    """ZeRO-1: parameters (and model state) stay fully replicated, only the
+    param-shaped optimizer moments (optax ``mu``/``nu``/``trace``) shard over
+    ``axis`` — each data-parallel worker keeps 1/N of the Adam moments,
+    computes 1/N of the weight update, and XLA all-gathers the updates into
+    the replicated new params.
+
+    The middle rung of the sharding ladder (DP < **ZeRO-1** < FSDP/ZeRO-3 <
+    TP): Adam moments are 2/3 of an f32 training state's bytes, so this cuts
+    state memory nearly 3x at large N with no change to forward/backward
+    communication — the gradient all-reduce is unchanged; only the optimizer
+    update gains an all-gather. No reference analog (plain DDP replicates
+    everything, ``multigpu.py:36``). Feed the result to
+    :func:`make_state_shardings`-style lifting yourself or use
+    :func:`make_zero1_shardings`.
+    """
+    moment_specs = make_fsdp_specs(state.params, mesh=mesh, axis=axis)
+    return type(state)(
+        params=_replicated_like(state.params),
+        model_state=_replicated_like(state.model_state),
+        opt_state=_opt_state_specs(state.opt_state, state.params, moment_specs),
+        step=P(),
+        loss_scale=_replicated_like(state.loss_scale),
+    )
+
+
+def make_zero1_shardings(mesh: Mesh, state, *, axis: str = "data"):
+    """TrainState-shaped NamedSharding pytree for ZeRO-1 (see
+    :func:`make_zero1_state_specs`) — feed to ``jax.device_put`` and
+    ``make_train_step(state_sharding=...)``."""
+    specs = make_zero1_state_specs(state, mesh=mesh, axis=axis)
+    return jtu.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
     )
 
 

@@ -2,6 +2,14 @@ from distributed_pytorch_tpu.training.losses import (
     mse_loss,
     softmax_cross_entropy_loss,
 )
+from distributed_pytorch_tpu.training.mixed_precision import (
+    BF16_POLICY,
+    F32_POLICY,
+    FP16_POLICY,
+    DynamicLossScale,
+    Policy,
+    StaticLossScale,
+)
 from distributed_pytorch_tpu.training.train_step import (
     TrainState,
     create_train_state,
@@ -11,6 +19,12 @@ from distributed_pytorch_tpu.training.train_step import (
 from distributed_pytorch_tpu.training.trainer import Trainer
 
 __all__ = [
+    "BF16_POLICY",
+    "F32_POLICY",
+    "FP16_POLICY",
+    "DynamicLossScale",
+    "Policy",
+    "StaticLossScale",
     "TrainState",
     "Trainer",
     "create_train_state",

@@ -1,0 +1,154 @@
+"""Mixed-precision policy + loss scaling.
+
+The reference delegates precision policy entirely to torch (fp32 end to end;
+AMP would be ``torch.cuda.amp`` — never used in the ladder). On TPU the
+idiomatic policy is *bf16 compute over f32 master weights*: flax modules take
+``dtype=`` (compute) and keep ``param_dtype=f32``, and bf16's f32-sized
+exponent needs no loss scaling. This module makes that policy an explicit,
+testable object — and adds the scaling machinery fp16 *does* need, so the
+framework's precision story is complete rather than implicit:
+
+* :class:`Policy` — named dtype triple (param/compute/output) with pytree
+  cast helpers. ``Model(dtype=policy.compute_dtype)`` is the wiring.
+* :class:`StaticLossScale` / :class:`DynamicLossScale` — loss-scale state
+  that rides IN :class:`~.train_step.TrainState` (``loss_scale`` field).
+  When present, the train step scales the loss before ``jax.grad``,
+  unscales the gradients, and **skips the parameter/optimizer/model-state
+  update on any non-finite gradient** (the step counter still advances —
+  it counts attempted steps, mirroring how a torch AMP loop calls
+  ``scaler.step`` every iteration). Dynamic scaling halves on overflow and
+  multiplies by ``factor`` after ``growth_interval`` consecutive finite
+  steps — the standard GradScaler schedule — as pure traced arithmetic
+  (no host round-trip, elastic-snapshot friendly: the scale is checkpointed
+  with the rest of the state).
+
+Everything here is a pytree of scalars; under a mesh the scale replicates
+with the state and the finiteness check is a global reduction XLA inserts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+
+def _cast_floating(tree: Any, dtype) -> Any:
+    """Cast floating-point leaves to ``dtype``; leave ints/bools untouched."""
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+        else x,
+        tree,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Dtype triple: parameters (master), compute, output.
+
+    ``bf16`` is the TPU default policy; ``fp16`` exists for interop and
+    requires a loss scale (5-bit exponent underflows real gradients).
+    """
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    output_dtype: Any = jnp.float32
+
+    def cast_to_param(self, tree: Any) -> Any:
+        return _cast_floating(tree, self.param_dtype)
+
+    def cast_to_compute(self, tree: Any) -> Any:
+        return _cast_floating(tree, self.compute_dtype)
+
+    def cast_to_output(self, tree: Any) -> Any:
+        return _cast_floating(tree, self.output_dtype)
+
+
+F32_POLICY = Policy(compute_dtype=jnp.float32)
+BF16_POLICY = Policy()
+FP16_POLICY = Policy(compute_dtype=jnp.float16)
+
+
+def all_finite(tree: Any) -> jnp.ndarray:
+    """Scalar bool: every element of every leaf is finite."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.array(True)
+    return jnp.all(
+        jnp.stack([jnp.all(jnp.isfinite(leaf)) for leaf in leaves])
+    )
+
+
+class _ScaleOps:
+    """Shared scale/unscale arithmetic (both loss-scale structs carry a
+    float32 scalar ``scale``)."""
+
+    def scale_loss(self, loss: jnp.ndarray) -> jnp.ndarray:
+        # Scale in float32: a float16 loss times a scale near 2**16 would
+        # overflow float16 before the cast. The scaled value only seeds the
+        # backward pass, so promoting it costs nothing.
+        return loss.astype(jnp.float32) * self.scale
+
+    def unscale(self, grads: Any) -> Any:
+        inv = (1.0 / self.scale).astype(jnp.float32)
+        return jax.tree_util.tree_map(
+            lambda g: (g * inv.astype(g.dtype)), grads
+        )
+
+
+@flax.struct.dataclass
+class StaticLossScale(_ScaleOps):
+    """Fixed loss scale. ``adjust`` is a no-op; non-finite steps are still
+    skipped (a fixed scale can overflow transiently on loss spikes)."""
+
+    scale: jnp.ndarray
+
+    @classmethod
+    def create(cls, scale: float) -> "StaticLossScale":
+        return cls(scale=jnp.asarray(scale, jnp.float32))
+
+    def adjust(self, grads_finite: jnp.ndarray) -> "StaticLossScale":
+        del grads_finite
+        return self
+
+
+@flax.struct.dataclass
+class DynamicLossScale(_ScaleOps):
+    """GradScaler-schedule dynamic loss scale: halve on overflow, grow by
+    ``factor`` after ``growth_interval`` consecutive finite steps."""
+
+    scale: jnp.ndarray
+    good_steps: jnp.ndarray
+    growth_interval: int = flax.struct.field(pytree_node=False, default=2000)
+    factor: float = flax.struct.field(pytree_node=False, default=2.0)
+    min_scale: float = flax.struct.field(pytree_node=False, default=1.0)
+
+    @classmethod
+    def create(
+        cls,
+        initial_scale: float = 2.0**15,
+        growth_interval: int = 2000,
+        factor: float = 2.0,
+        min_scale: float = 1.0,
+    ) -> "DynamicLossScale":
+        return cls(
+            scale=jnp.asarray(initial_scale, jnp.float32),
+            good_steps=jnp.zeros((), jnp.int32),
+            growth_interval=growth_interval,
+            factor=factor,
+            min_scale=min_scale,
+        )
+
+    def adjust(self, grads_finite: jnp.ndarray) -> "DynamicLossScale":
+        grown = self.good_steps + 1 >= self.growth_interval
+        new_scale = jnp.where(
+            grads_finite,
+            jnp.where(grown, self.scale * self.factor, self.scale),
+            jnp.maximum(self.scale / self.factor, self.min_scale),
+        )
+        new_good = jnp.where(grads_finite & ~grown, self.good_steps + 1, 0)
+        return self.replace(scale=new_scale, good_steps=new_good)

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_pytorch_tpu.training import mixed_precision as mp
+
 from distributed_pytorch_tpu.parallel.sharding import (
     batch_sharding,
     replicated_sharding,
@@ -44,12 +46,19 @@ class TrainState:
     The checkpointable unit — unlike the reference, optimizer state is part of
     it (the reference never saves optimizer state, a resume-fidelity gap noted
     in SURVEY.md §5; harmless for SGD, wrong for Adam).
+
+    ``loss_scale`` is ``None`` (no scaling — the bf16/f32 default) or a
+    :mod:`.mixed_precision` loss-scale struct; when present the train step
+    scales/unscales around ``jax.grad`` and skips non-finite updates. It
+    lives here, not in the step builder, so it checkpoints/replicates with
+    everything else and the step's behavior follows the state's structure.
     """
 
     params: Any
     model_state: Any
     opt_state: Any
     step: jnp.ndarray
+    loss_scale: Any = None
 
 
 def create_train_state(
@@ -58,6 +67,7 @@ def create_train_state(
     sample_input,
     *,
     rng_seed: int = 0,
+    loss_scale: Any = None,
 ) -> TrainState:
     """Initialize params + optimizer state from a sample input batch."""
     rng = jax.random.PRNGKey(rng_seed)
@@ -77,6 +87,7 @@ def create_train_state(
         model_state=variables,
         opt_state=opt_state,
         step=jnp.zeros((), jnp.int32),
+        loss_scale=loss_scale,
     )
 
 
@@ -117,6 +128,13 @@ def make_train_step(
     ``donate_argnums=(0,)`` lets XLA reuse the old state's buffers for the new
     state (in-place update semantics, halving peak parameter memory).
 
+    When ``state.loss_scale`` is a :mod:`.mixed_precision` loss-scale struct
+    (set via ``create_train_state(..., loss_scale=...)``), the step
+    differentiates the scaled loss, unscales the gradients, skips the
+    param/opt/model-state update on non-finite gradients, and carries the
+    adjusted scale forward — fp16-style training with zero change to this
+    builder's arguments (the behavior keys off the state's pytree structure).
+
     ``apply_takes_targets=True`` is for models that fuse the loss into the
     forward pass (e.g. ``TransformerLM(fused_head_chunk=...)``, whose fused LM
     head never materializes the logits): ``apply_fn`` is called as
@@ -131,6 +149,10 @@ def make_train_step(
     def step(state: TrainState, batch) -> Tuple[TrainState, jnp.ndarray]:
         inputs, targets = batch
         mutable = list(state.model_state.keys())  # static at trace time
+        # None vs a loss-scale struct is pytree STRUCTURE, so this branch is
+        # resolved at trace time — the unscaled path compiles identically to
+        # a build without mixed_precision.
+        loss_scale = state.loss_scale
 
         def micro_loss(params, model_state, mb_inputs, mb_targets):
             variables = {"params": params, **model_state}
@@ -147,12 +169,16 @@ def make_train_step(
             loss = loss_fn(predictions, mb_targets)
             for term in jax.tree_util.tree_leaves(new_model_state.pop("losses", {})):
                 loss = loss + jnp.sum(term)
-            return loss, new_model_state
+            # Differentiate the SCALED loss; report the true one via aux.
+            scaled = (
+                loss_scale.scale_loss(loss) if loss_scale is not None else loss
+            )
+            return scaled, (loss, new_model_state)
 
-        grad_fn = jax.value_and_grad(micro_loss, has_aux=True)
+        grad_fn = jax.grad(micro_loss, has_aux=True)
 
         if grad_accum == 1:
-            (loss, new_model_state), grads = grad_fn(
+            grads, (loss, new_model_state) = grad_fn(
                 state.params, state.model_state, inputs, targets
             )
         else:
@@ -188,7 +214,7 @@ def make_train_step(
 
             def body(carry, mb):
                 model_state, grad_sum, loss_sum = carry
-                (loss, new_ms), grads = grad_fn(state.params, model_state, *mb)
+                grads, (loss, new_ms) = grad_fn(state.params, model_state, *mb)
                 grad_sum = jax.tree_util.tree_map(jnp.add, grad_sum, grads)
                 return (new_ms, grad_sum, loss_sum + loss), None
 
@@ -207,13 +233,31 @@ def make_train_step(
             )
             loss = loss_sum / grad_accum
 
+        new_loss_scale = None
+        new_model_state = dict(new_model_state)
+        if loss_scale is not None:
+            grads = loss_scale.unscale(grads)
+            finite = mp.all_finite(grads)
+            new_loss_scale = loss_scale.adjust(finite)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        if loss_scale is not None:
+            # Overflow step: keep params/opt/model-state; only the scale and
+            # the attempted-step counter move (torch GradScaler semantics).
+            sel = lambda n, o: jnp.where(finite, n, o)  # noqa: E731
+            new_params = jax.tree_util.tree_map(sel, new_params, state.params)
+            new_opt_state = jax.tree_util.tree_map(
+                sel, new_opt_state, state.opt_state
+            )
+            new_model_state = jax.tree_util.tree_map(
+                sel, new_model_state, state.model_state
+            )
         new_state = TrainState(
             params=new_params,
-            model_state=dict(new_model_state),
+            model_state=new_model_state,
             opt_state=new_opt_state,
             step=state.step + 1,
+            loss_scale=new_loss_scale,
         )
         return new_state, loss
 

@@ -79,6 +79,7 @@ class Trainer:
         grad_accum: int = 1,
         async_save: bool = False,
         paranoid: bool = False,
+        loss_scale=None,
     ):
         self.model = model
         self.train_data = train_data
@@ -126,8 +127,10 @@ class Trainer:
                 train_data.pad_final_batch = True
 
         sample_x, _ = next(iter(train_data))
+        # loss_scale: a mixed_precision.{Static,Dynamic}LossScale for fp16
+        # compute policies; rides in TrainState (see train_step.TrainState).
         self.state: TrainState = create_train_state(
-            model, optimizer, sample_x, rng_seed=rng_seed
+            model, optimizer, sample_x, rng_seed=rng_seed, loss_scale=loss_scale
         )
         if mesh is not None:
             # Replicate state across the mesh (the DDP-construction broadcast,

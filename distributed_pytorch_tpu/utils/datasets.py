@@ -177,8 +177,10 @@ class AugmentedDataset:
         x, y = self.base[index]
         rng = np.random.default_rng([self.seed, self._epoch, index])
         h, w = x.shape[0], x.shape[1]
+        # Zero padding, matching the canonical CIFAR recipe (He et al.);
+        # on normalized inputs zero is the per-channel dataset mean.
         padded = np.pad(
-            x, ((self.pad, self.pad), (self.pad, self.pad), (0, 0)), "reflect"
+            x, ((self.pad, self.pad), (self.pad, self.pad), (0, 0))
         )
         top = rng.integers(0, 2 * self.pad + 1)
         left = rng.integers(0, 2 * self.pad + 1)

@@ -21,6 +21,8 @@ from distributed_pytorch_tpu.parallel.partitioning import (
     make_param_specs,
     make_state_shardings,
     make_state_specs,
+    make_zero1_shardings,
+    make_zero1_state_specs,
     shard_train_state,
 )
 from distributed_pytorch_tpu.parallel.sharding import (
@@ -155,3 +157,67 @@ def test_sharded_training_matches_replicated(mode):
     ]
     assert sharded_leaves
     assert not sharded_leaves[0].sharding.is_fully_replicated
+
+
+def test_zero1_specs_shard_moments_not_params():
+    mesh = make_mesh({"data": 8})
+    model = tiny_lm()
+    inputs, _ = make_batch(dp=8)
+    state = create_train_state(model, optax.adam(1e-3), inputs)
+    specs = make_zero1_state_specs(state, mesh=mesh)
+    param_leaves = jtu.tree_leaves(
+        specs.params, is_leaf=lambda x: isinstance(x, P)
+    )
+    assert all(spec == P() for spec in param_leaves)
+    adam = specs.opt_state[0]  # ScaleByAdamState(count, mu, nu)
+    mu_leaves = jtu.tree_leaves(adam.mu, is_leaf=lambda x: isinstance(x, P))
+    assert any(spec != P() for spec in mu_leaves)
+    assert all(
+        axis in (None, "data")
+        for spec in mu_leaves
+        for axis in spec
+    )
+
+
+def test_zero1_training_matches_replicated_dp():
+    """ZeRO-1 (sharded Adam moments, replicated params) is pure placement:
+    the loss curve must match replicated DP, params must stay replicated on
+    device, and the moments must actually be distributed."""
+    model = tiny_lm()
+    inputs, targets = make_batch(dp=8)
+    optimizer = optax.adam(1e-2)
+    mesh = make_mesh({"data": 8})
+    batch = put_global_batch(mesh, (inputs, targets))
+
+    state_dp = create_train_state(model, optimizer, inputs, rng_seed=3)
+    state_dp = shard_train_state(state_dp, replicated_sharding(mesh))
+    step_dp = make_train_step(
+        model.apply, optimizer, softmax_cross_entropy_loss, mesh=mesh
+    )
+    losses_dp = []
+    for _ in range(3):
+        state_dp, loss = step_dp(state_dp, batch)
+        losses_dp.append(float(loss))
+
+    state_z = create_train_state(model, optimizer, inputs, rng_seed=3)
+    shardings = make_zero1_shardings(mesh, state_z)
+    state_z = shard_train_state(state_z, shardings)
+    step_z = make_train_step(
+        model.apply,
+        optimizer,
+        softmax_cross_entropy_loss,
+        mesh=mesh,
+        state_sharding=shardings,
+    )
+    losses_z = []
+    for _ in range(3):
+        state_z, loss = step_z(state_z, batch)
+        losses_z.append(float(loss))
+
+    np.testing.assert_allclose(losses_z, losses_dp, rtol=2e-4)
+    assert all(
+        leaf.sharding.is_fully_replicated
+        for leaf in jtu.tree_leaves(state_z.params)
+    )
+    mu_arrays = jtu.tree_leaves(state_z.opt_state[0].mu)
+    assert any(not a.sharding.is_fully_replicated for a in mu_arrays)

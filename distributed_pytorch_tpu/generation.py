@@ -77,15 +77,10 @@ def generate(
     """
     decode_model = model.clone(decode=True)
     if quantize:
-        if param_shardings is not None:
-            raise NotImplementedError(
-                "quantize=True with param_shardings (TP decode) is not "
-                "supported yet: the sharding tree does not match the "
-                "quantized param tree"
-            )
         from distributed_pytorch_tpu.ops.quant import (
             QuantTensor,
             quantize_pytree,
+            quantize_shardings,
         )
 
         already = any(
@@ -94,6 +89,16 @@ def generate(
                 params, is_leaf=lambda x: isinstance(x, QuantTensor)
             )
         )
+        if param_shardings is not None:
+            if already:
+                raise ValueError(
+                    "pass the UNquantized params when combining quantize="
+                    "True with param_shardings; the sharding tree is lifted "
+                    "onto the quantized tree internally"
+                )
+            # Lift the param shardings onto the quantized tree (int8 q keeps
+            # the kernel's sharding; per-channel scales drop contract axes).
+            param_shardings = quantize_shardings(param_shardings, params)
         # Accept a pre-quantized tree (quantize_pytree run once by the
         # caller) so repeated generate() calls don't pay re-quantization.
         if not already:

@@ -113,6 +113,40 @@ def dequantize_pytree(qparams: Any, dtype: Any = jnp.bfloat16) -> Any:
     )
 
 
+def quantize_shardings(
+    shardings: Any, params: Any, rules=TRANSFORMER_QUANT_RULES
+) -> Any:
+    """Lift a param-tree sharding pytree onto the quantized tree produced by
+    :func:`quantize_pytree` with the same ``rules``: the int8 ``q`` keeps the
+    original kernel's sharding; the ``scale`` (contract dims collapsed to 1)
+    gets the same spec with the contract-dim axes dropped — a size-1 dim
+    cannot be sharded. ``shardings`` leaves must be ``NamedSharding``; pass
+    the original ``params`` tree alongside for path matching.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    compiled = [(re.compile(pattern), dims) for pattern, dims in rules]
+
+    def lift(path, sharding, leaf):
+        path_s = _path_str(path)
+        for pattern, dims in compiled:
+            if pattern.match(path_s):
+                spec = list(sharding.spec)  # may be shorter than ndim
+                spec += [None] * (leaf.ndim - len(spec))
+                scale_spec = [
+                    None if d in dims else spec[d] for d in range(leaf.ndim)
+                ]
+                return QuantTensor(
+                    q=sharding,
+                    scale=NamedSharding(
+                        sharding.mesh, PartitionSpec(*scale_spec)
+                    ),
+                )
+        return sharding
+
+    return jtu.tree_map_with_path(lift, shardings, params)
+
+
 def quantized_bytes(tree: Any) -> Tuple[int, int]:
     """(bytes_quantized, bytes_original_f32) over matched leaves — the memory
     story for logs/tests."""

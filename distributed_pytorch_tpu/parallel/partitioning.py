@@ -188,7 +188,13 @@ def make_zero1_shardings(mesh: Mesh, state, *, axis: str = "data"):
     """TrainState-shaped NamedSharding pytree for ZeRO-1 (see
     :func:`make_zero1_state_specs`) — feed to ``jax.device_put`` and
     ``make_train_step(state_sharding=...)``."""
-    specs = make_zero1_state_specs(state, mesh=mesh, axis=axis)
+    return specs_to_shardings(
+        mesh, make_zero1_state_specs(state, mesh=mesh, axis=axis)
+    )
+
+
+def specs_to_shardings(mesh: Mesh, specs):
+    """PartitionSpec pytree -> NamedSharding pytree on ``mesh``."""
     return jtu.tree_map(
         lambda spec: NamedSharding(mesh, spec),
         specs,
@@ -199,12 +205,7 @@ def make_zero1_shardings(mesh: Mesh, state, *, axis: str = "data"):
 def make_state_shardings(mesh: Mesh, state, param_specs):
     """TrainState-shaped NamedSharding pytree — feed to ``jax.device_put`` (to
     place/reshard a state) and to ``make_train_step(state_sharding=...)``."""
-    specs = make_state_specs(state, param_specs)
-    return jtu.tree_map(
-        lambda spec: NamedSharding(mesh, spec),
-        specs,
-        is_leaf=lambda x: isinstance(x, P),
-    )
+    return specs_to_shardings(mesh, make_state_specs(state, param_specs))
 
 
 def shard_train_state(state, shardings):

@@ -80,6 +80,7 @@ class Trainer:
         async_save: bool = False,
         paranoid: bool = False,
         loss_scale=None,
+        partition_specs=None,
     ):
         self.model = model
         self.train_data = train_data
@@ -132,7 +133,28 @@ class Trainer:
         self.state: TrainState = create_train_state(
             model, optimizer, sample_x, rng_seed=rng_seed, loss_scale=loss_scale
         )
-        if mesh is not None:
+        # partition_specs opens the sharding zoo through the flagship API:
+        # either a params-shaped PartitionSpec tree (TP/FSDP rule output —
+        # lifted onto the whole TrainState, Adam moments following their
+        # params) or a full TrainState-shaped spec tree (e.g.
+        # make_zero1_state_specs). None = plain replicated DP.
+        self.state_sharding = None
+        if partition_specs is not None:
+            if mesh is None:
+                raise ValueError("partition_specs requires mesh=")
+            from distributed_pytorch_tpu.parallel.partitioning import (
+                make_state_specs,
+                specs_to_shardings,
+            )
+
+            specs = (
+                partition_specs
+                if isinstance(partition_specs, TrainState)
+                else make_state_specs(self.state, partition_specs)
+            )
+            self.state_sharding = specs_to_shardings(mesh, specs)
+            self.state = jax.device_put(self.state, self.state_sharding)
+        elif mesh is not None:
             # Replicate state across the mesh (the DDP-construction broadcast,
             # reference multigpu.py:36, minus the network traffic: every
             # process computes identical init from the same seed).
@@ -145,7 +167,8 @@ class Trainer:
                 self._load_snapshot(snapshot_path)
 
         self.train_step = make_train_step(
-            model.apply, optimizer, loss_fn, mesh=mesh, grad_accum=grad_accum
+            model.apply, optimizer, loss_fn, mesh=mesh, grad_accum=grad_accum,
+            state_sharding=self.state_sharding,
         )
         self._eval_step = None  # built lazily on first evaluate()
         self._eval_step_fns = None  # metric-fn set the cached step was built for
@@ -159,7 +182,9 @@ class Trainer:
 
     def _load_snapshot(self, path: str) -> None:
         state, self.epochs_run = load_snapshot(path, self.state)
-        if self.mesh is not None:
+        if self.state_sharding is not None:
+            state = jax.device_put(state, self.state_sharding)
+        elif self.mesh is not None:
             state = jax.device_put(state, replicated_sharding(self.mesh))
         else:
             state = jax.device_put(state)
@@ -336,7 +361,8 @@ class Trainer:
             )
 
             self._eval_step = make_metrics_eval_step(
-                self._eval_apply, fns, mesh=self.mesh
+                self._eval_apply, fns, mesh=self.mesh,
+                state_sharding=self.state_sharding,
             )
             self._eval_step_fns = fns_key
 
@@ -376,7 +402,8 @@ class Trainer:
         duplicates count toward the mean — see ``evaluate``)."""
         if self._eval_step is None or self._eval_step_fns is not None:
             self._eval_step = make_eval_step(
-                self._eval_apply, self.loss_fn, mesh=self.mesh
+                self._eval_apply, self.loss_fn, mesh=self.mesh,
+                state_sharding=self.state_sharding,
             )
             self._eval_step_fns = None
         eval_data = self._prepare_eval_loader(eval_data)

@@ -1,0 +1,109 @@
+"""Text generation from a trained (or randomly initialized) TransformerLM.
+
+The inference-side rung — no reference analog (the reference ladder stops at
+training, SURVEY.md §0); a complete framework needs the sampling path. The
+whole decode is ONE compiled ``lax.fori_loop`` (generation.py): greedy or
+temperature/top-k sampling, ragged prompts, KV caches updated in place.
+
+Flags tour:
+  --snapshot PATH     load params from a training snapshot (else seeded init)
+  --quantize          weight-only int8 decode (ops/quant.py): ~half the
+                      weight HBM traffic; greedy outputs typically identical
+  --fake_devices N    run on N virtual CPU devices; with N > 1 the decode is
+                      sharded over a data mesh (batch + KV caches P("data"))
+
+Run:  python examples/generate_lm.py --batch 4 --new_tokens 32 [--quantize]
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main(args):
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_pytorch_tpu.generation import generate
+    from distributed_pytorch_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(
+        vocab_size=args.vocab,
+        d_model=args.d_model,
+        n_layers=args.n_layers,
+        n_heads=args.n_heads,
+        d_ff=4 * args.d_model,
+        dtype=jnp.float32 if args.f32 else jnp.bfloat16,
+    )
+    params = model.init(
+        jax.random.PRNGKey(args.seed), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    if args.snapshot:
+        from distributed_pytorch_tpu.checkpoint import load_snapshot
+        from distributed_pytorch_tpu.training.train_step import TrainState
+
+        template = TrainState(
+            params=params, model_state={}, opt_state=(), step=jnp.zeros((), jnp.int32)
+        )
+        state, _ = load_snapshot(args.snapshot, template)
+        params = state.params
+
+    rng = np.random.default_rng(args.seed)
+    prompt = jnp.asarray(
+        rng.integers(0, args.vocab, (args.batch, args.prompt_len)), jnp.int32
+    )
+
+    mesh = None
+    if jax.device_count() > 1 and args.batch % jax.device_count() == 0:
+        from distributed_pytorch_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+    out = generate(
+        model,
+        params,
+        prompt,
+        args.new_tokens,
+        temperature=args.temperature,
+        top_k=args.top_k,
+        mesh=mesh,
+        quantize=args.quantize,
+    )
+    out = np.asarray(out)
+    for row in range(min(args.batch, 4)):
+        ids = out[row]
+        print(
+            f"[row {row}] prompt={ids[:args.prompt_len].tolist()} "
+            f"-> continuation={ids[args.prompt_len:].tolist()}"
+        )
+    mode = "quantized int8" if args.quantize else "full precision"
+    where = f"{jax.device_count()}-device mesh" if mesh else "single device"
+    print(f"generated {args.batch}x{args.new_tokens} tokens ({mode}, {where})")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="LM generation (inference rung)")
+    parser.add_argument("--vocab", type=int, default=256)
+    parser.add_argument("--d_model", type=int, default=128)
+    parser.add_argument("--n_layers", type=int, default=4)
+    parser.add_argument("--n_heads", type=int, default=4)
+    parser.add_argument("--batch", type=int, default=4)
+    parser.add_argument("--prompt_len", type=int, default=8)
+    parser.add_argument("--new_tokens", type=int, default=32)
+    parser.add_argument("--temperature", type=float, default=0.0,
+                        help="0 = greedy argmax")
+    parser.add_argument("--top_k", type=int, default=0)
+    parser.add_argument("--quantize", action="store_true",
+                        help="weight-only int8 decode")
+    parser.add_argument("--f32", action="store_true",
+                        help="float32 compute instead of the bf16 default")
+    parser.add_argument("--snapshot", default=None,
+                        help="load params from a training snapshot")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--fake_devices", default=0, type=int,
+                        help="debug: present N virtual CPU devices")
+    args = parser.parse_args()
+    if args.fake_devices:
+        from distributed_pytorch_tpu.utils.platform import use_fake_cpu_devices
+
+        use_fake_cpu_devices(args.fake_devices)
+    main(args)

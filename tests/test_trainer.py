@@ -138,3 +138,71 @@ def test_checkpoint_includes_model_state(tmp_path):
     restored, meta = load_checkpoint(path, template)
     stats = jax.tree_util.tree_leaves(restored["model_state"])
     assert stats and any(not np.allclose(np.asarray(s), 0) for s in stats)
+
+
+def test_trainer_partition_specs_zero1_and_fsdp(tmp_path):
+    """The sharding zoo through the flagship API: Trainer(partition_specs=)
+    with ZeRO-1 (TrainState-shaped specs) and FSDP (params-shaped specs)
+    both match the replicated-DP loss, shard what they claim on device, and
+    survive the snapshot-resume contract under sharded placement."""
+    import optax as _optax
+
+    from distributed_pytorch_tpu.parallel.partitioning import (
+        make_fsdp_specs,
+        make_zero1_state_specs,
+    )
+
+    def make(partition_specs=None, mesh=None, snap=None):
+        return Trainer(
+            ToyRegressor(), _loader(), _optax.adam(1e-2), save_every=1,
+            mesh=mesh, partition_specs=partition_specs,
+            snapshot_path=snap,
+            checkpoint_path=str(tmp_path / "unused.npz"),
+        )
+
+    mesh8 = make_mesh({"data": 8})
+    dp = make(mesh=mesh8)
+    base = dp._run_epoch(0)
+
+    # ZeRO-1 on a 4-device mesh (the toy kernel's dim 20 shards 4-way; it
+    # has no 8-divisible dim): Adam mu sharded, params not.
+    mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
+    z1_specs = make_zero1_state_specs(make(mesh=mesh).state, mesh=mesh)
+    z1 = make(partition_specs=z1_specs, mesh=mesh)
+    np.testing.assert_allclose(z1._run_epoch(0), base, rtol=1e-5)
+    assert all(
+        leaf.sharding.is_fully_replicated
+        for leaf in jax.tree_util.tree_leaves(z1.state.params)
+    )
+    assert any(
+        not a.sharding.is_fully_replicated
+        for a in jax.tree_util.tree_leaves(z1.state.opt_state[0].mu)
+    )
+
+    # FSDP: params-shaped specs, lifted onto the state internally.
+    fsdp_mesh = make_mesh({"data": 2, "fsdp": 4})
+    probe = make(mesh=fsdp_mesh)
+    fsdp_specs = make_fsdp_specs(probe.state.params, mesh=fsdp_mesh)
+    fsdp = make(partition_specs=fsdp_specs, mesh=fsdp_mesh)
+    np.testing.assert_allclose(fsdp._run_epoch(0), base, rtol=1e-5)
+
+    # Snapshot round-trip under sharded placement: resume keeps the specs.
+    snap = str(tmp_path / "z1.npz")
+    t1 = make(partition_specs=z1_specs, mesh=mesh, snap=snap)
+    t1.train(2)
+    t2 = make(partition_specs=z1_specs, mesh=mesh, snap=snap)
+    assert t2.epochs_run == 2
+    assert any(
+        not a.sharding.is_fully_replicated
+        for a in jax.tree_util.tree_leaves(t2.state.opt_state[0].mu)
+    )
+
+
+def test_trainer_partition_specs_requires_mesh():
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="mesh"):
+        Trainer(
+            ToyRegressor(), _loader(), optax.sgd(1e-2), save_every=0,
+            partition_specs={"linear": None},
+        )

@@ -4,7 +4,9 @@ Autoregressive decode re-reads every matmul weight once per generated token,
 so at small batch it is HBM-bandwidth-bound on parameter bytes and int8
 weights approach 2x tokens/s. This measures it honestly on the real chip:
 one compiled fori_loop per variant (generation.generate), value-fetch sync,
-identical greedy outputs asserted.
+per-token greedy agreement reported (exact parity on a trained model is
+pinned by tests/test_quant.py; random-init weights have near-tie argmax
+margins either rounding can flip).
 
     python tools/decode_bench.py [--d_model 1024] [--n_layers 12] \
         [--batch 8] [--new_tokens 128]
@@ -30,7 +32,15 @@ def main():
     p.add_argument("--prompt_len", type=int, default=16)
     p.add_argument("--new_tokens", type=int, default=128)
     p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--fake_devices", type=int, default=0,
+                   help="debug: run on N virtual CPU devices")
     args = p.parse_args()
+    if args.fake_devices:
+        from distributed_pytorch_tpu.utils.platform import (
+            use_fake_cpu_devices,
+        )
+
+        use_fake_cpu_devices(args.fake_devices)
 
     from distributed_pytorch_tpu.generation import generate
     from distributed_pytorch_tpu.models.transformer import TransformerLM
@@ -54,6 +64,15 @@ def main():
     params = model.init(
         jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
     )["params"]
+    # flax stores params float32 (param_dtype default) regardless of the
+    # compute dtype; cast the baseline's weights to bf16 so the A/B compares
+    # 2-byte vs 1-byte HBM reads, not 4 vs 1.
+    bf16_params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16)
+        if jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        params,
+    )
     n_params = sum(
         int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params)
     )
@@ -75,9 +94,14 @@ def main():
         toks = args.batch * args.new_tokens
         return out, toks / min(times)
 
-    out_bf16, tps_bf16 = run(params, False)
+    out_bf16, tps_bf16 = run(bf16_params, False)
     out_int8, tps_int8 = run(qparams, True)
-    match = bool(np.array_equal(np.asarray(out_bf16), np.asarray(out_int8)))
+    # Agreement fraction, not an exact-match assert: these are RANDOM-init
+    # weights, whose argmax margins are near-ties that either rounding (bf16
+    # or int8) can flip — exact greedy parity on a TRAINED model is pinned
+    # by tests/test_quant.py instead.
+    a, b = np.asarray(out_bf16), np.asarray(out_int8)
+    agreement = float(np.mean(a == b))
     print(
         json.dumps(
             {
@@ -93,7 +117,7 @@ def main():
                 "tokens_per_sec_bf16": round(tps_bf16, 1),
                 "tokens_per_sec_int8": round(tps_int8, 1),
                 "speedup": round(tps_int8 / tps_bf16, 3),
-                "greedy_outputs_match": match,
+                "greedy_token_agreement": round(agreement, 4),
             }
         )
     )

@@ -48,6 +48,7 @@ def generate(
     data_axis: str = "data",
     param_shardings=None,
     quantize: bool = False,
+    quantized_cache: bool = False,
 ) -> jnp.ndarray:
     """Generate ``max_new_tokens`` continuations for ``prompt`` ``[B, T0]``.
 
@@ -74,8 +75,17 @@ def generate(
     decode loop — decode is HBM-bound on weight reads, so int8 halves the
     traffic on the quantized weights. Greedy outputs typically match the
     full-precision path exactly (see tests/test_quant.py).
+
+    ``quantized_cache=True`` additionally stores the KV caches as int8 with
+    per-(token, head) scales (``models.transformer.Attention``): at long
+    context the ``[B, T, H, D]`` caches dominate decode memory and traffic,
+    and this halves both. Composes with ``quantize`` and with the mesh path
+    (the scale buffers lead with the batch dim, so they shard ``P(data)``).
     """
-    decode_model = model.clone(decode=True)
+    clone_kw = {"decode": True}
+    if quantized_cache:  # only models with the attribute support it
+        clone_kw["quantized_cache"] = True
+    decode_model = model.clone(**clone_kw)
     if quantize:
         from distributed_pytorch_tpu.ops.quant import (
             QuantTensor,

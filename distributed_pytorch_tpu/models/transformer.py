@@ -72,6 +72,11 @@ class Attention(nn.Module):
     mesh: Optional[Mesh] = None
     sequence_axis: Optional[str] = None
     decode: bool = False  # autoregressive KV-cache mode (see generation.py)
+    # int8 KV cache: at long context the [B, T, H, D] caches — not the
+    # params — dominate decode memory and HBM traffic; symmetric absmax
+    # per-(token, head) quantization (scale over D) halves both. Dequant
+    # happens at the attention einsum, so the loop reads int8.
+    quantized_cache: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -91,8 +96,16 @@ class Attention(nn.Module):
         if self.decode:
             # Cache init pass: size the KV cache to this call's (max) length,
             # then fall through to the normal causal forward.
-            self.variable("cache", "cached_key", jnp.zeros, k_raw.shape, k_raw.dtype)
-            self.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
+            cache_dtype = jnp.int8 if self.quantized_cache else k_raw.dtype
+            self.variable("cache", "cached_key", jnp.zeros, k_raw.shape, cache_dtype)
+            self.variable("cache", "cached_value", jnp.zeros, v.shape, cache_dtype)
+            if self.quantized_cache:
+                self.variable(
+                    "cache", "key_scale", jnp.zeros, k_raw.shape[:-1], jnp.float32
+                )
+                self.variable(
+                    "cache", "value_scale", jnp.zeros, v.shape[:-1], jnp.float32
+                )
             self.variable(
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
             )
@@ -133,15 +146,19 @@ class Attention(nn.Module):
         q = apply_rope(q_raw, positions=positions)
         k = apply_rope(k_raw, positions=positions)
 
-        cached_key.value = jax.lax.dynamic_update_slice(
-            cached_key.value, k.astype(cached_key.value.dtype), (0, index, 0, 0)
-        )
-        cached_value.value = jax.lax.dynamic_update_slice(
-            cached_value.value, v.astype(cached_value.value.dtype), (0, index, 0, 0)
-        )
+        if self.quantized_cache:
+            keys, values = self._update_quantized_cache(
+                cached_key, cached_value, k, v, index
+            )
+        else:
+            cached_key.value = jax.lax.dynamic_update_slice(
+                cached_key.value, k.astype(cached_key.value.dtype), (0, index, 0, 0)
+            )
+            cached_value.value = jax.lax.dynamic_update_slice(
+                cached_value.value, v.astype(cached_value.value.dtype), (0, index, 0, 0)
+            )
+            keys, values = cached_key.value, cached_value.value
         cache_index.value = index + t_step
-
-        keys, values = cached_key.value, cached_value.value
         scale = q.shape[-1] ** -0.5
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, keys) * scale
         # Position k is visible to step-q q when k <= index + q.
@@ -151,6 +168,34 @@ class Attention(nn.Module):
         logits = jnp.where(visible[None, None], logits, NEG_INF)
         weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", weights, values)
+
+    def _update_quantized_cache(self, cached_key, cached_value, k, v, index):
+        """Write this step's k/v as int8 + per-(token, head) float32 scales,
+        and return the DEQUANTIZED full caches for the attention einsums —
+        the dequant (int8 read, convert, scale) fuses into each einsum, so
+        HBM sees int8 + one scale per head-token instead of bf16."""
+        from distributed_pytorch_tpu.ops.quant import quantize_int8
+
+        key_scale = self.variable("cache", "key_scale", lambda: None)
+        value_scale = self.variable("cache", "value_scale", lambda: None)
+
+        def write(cache, scale_var, x):
+            qt = quantize_int8(x, (x.ndim - 1,))  # per-(token, head) over D
+            q8, s = qt.q, jnp.squeeze(qt.scale, -1)  # [B, t, H]
+            cache.value = jax.lax.dynamic_update_slice(
+                cache.value, q8, (0, index, 0, 0)
+            )
+            scale_var.value = jax.lax.dynamic_update_slice(
+                scale_var.value, s, (0, index, 0)
+            )
+            return (
+                cache.value.astype(self.dtype)
+                * scale_var.value[..., None].astype(self.dtype)
+            )
+
+        keys = write(cached_key, key_scale, k)
+        values = write(cached_value, value_scale, v)
+        return keys, values
 
 
 class MLPBlock(nn.Module):
@@ -176,12 +221,14 @@ class TransformerBlock(nn.Module):
     n_experts: int = 0  # >0 swaps the dense MLP for an expert-parallel MoEMLP
     decode: bool = False
     remat_mlp: bool = False  # rematerialize only the MLP branch (see TransformerLM)
+    quantized_cache: bool = False  # int8 KV cache in decode (see Attention)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         x = x + Attention(
             self.n_heads, self.d_model, self.dtype, self.causal,
-            self.mesh, self.sequence_axis, self.decode, name="attention",
+            self.mesh, self.sequence_axis, self.decode,
+            quantized_cache=self.quantized_cache, name="attention",
         )(nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x))
         if self.n_experts > 0:
             cls = nn.remat(MoEMLP) if self.remat_mlp else MoEMLP
@@ -270,6 +317,7 @@ class TransformerLM(nn.Module):
     n_experts: int = 0  # >0: MoE MLPs in every `moe_every`-th block
     moe_every: int = 2
     decode: bool = False  # KV-cache autoregressive mode (see generation.py)
+    quantized_cache: bool = False  # int8 KV cache in decode (see Attention)
     fused_head_chunk: int = 0  # >0: vocab chunk size for the fused CE head
 
     @nn.compact
@@ -294,7 +342,7 @@ class TransformerLM(nn.Module):
             x = block(
                 self.n_heads, self.d_model, self.d_ff, self.dtype,
                 True, self.mesh, self.sequence_axis, moe, self.decode,
-                remat_mlp, name=f"block_{i}",
+                remat_mlp, self.quantized_cache, name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         if self.fused_head_chunk and self.vocab_size % self.fused_head_chunk:

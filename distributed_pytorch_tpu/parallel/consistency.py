@@ -60,12 +60,39 @@ def check_device_replicas(tree: Any) -> None:
                 )
 
 
+def _checksummable_leaves(tree):
+    """Leaves whose values are a replica-consistency subject: everything
+    except jax.Arrays that are deliberately sharded (ZeRO-1/FSDP/TP states
+    via ``Trainer(partition_specs=)``) — a sharded leaf's per-process local
+    data legitimately differs, and gathering a non-addressable array for a
+    checksum would crash multi-host. Sharded placement correctness is the
+    compiler's contract, not a replica property."""
+    for path, leaf in _leaf_paths(tree):
+        if (
+            isinstance(leaf, jax.Array)
+            and getattr(leaf, "sharding", None) is not None
+            and not leaf.sharding.is_fully_replicated
+        ):
+            continue
+        yield path, leaf
+
+
+def _to_host(leaf) -> np.ndarray:
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        # Fully replicated (guaranteed by _checksummable_leaves): any local
+        # shard holds the complete value.
+        return np.asarray(leaf.addressable_shards[0].data)
+    return np.asarray(jax.device_get(leaf))
+
+
 def tree_checksum(tree: Any) -> np.ndarray:
-    """Order-stable float64 checksum vector over the tree's leaves (one entry
-    per leaf: sum of values; NaN-safe via nansum + NaN count)."""
+    """Order-stable float64 checksum vector over the tree's replicated leaves
+    (one entry per leaf: sum of values; NaN-safe via nansum + NaN count).
+    Deliberately sharded leaves are excluded — see
+    :func:`_checksummable_leaves`."""
     sums = []
-    for _, leaf in _leaf_paths(tree):
-        arr = np.asarray(jax.device_get(leaf)).astype(np.float64, copy=False)
+    for _, leaf in _checksummable_leaves(tree):
+        arr = _to_host(leaf).astype(np.float64, copy=False)
         sums.append(np.nansum(arr) + 1e12 * np.count_nonzero(np.isnan(arr)))
     return np.asarray(sums, np.float64)
 
@@ -83,7 +110,7 @@ def check_host_replicas(tree: Any, *, name: str = "state") -> None:
     )  # [n_processes, n_leaves]
     if not np.allclose(gathered, gathered[0], rtol=0, atol=0, equal_nan=True):
         bad = np.where(~np.all(gathered == gathered[0], axis=0))[0]
-        paths = [p for p, _ in _leaf_paths(tree)]
+        paths = [p for p, _ in _checksummable_leaves(tree)]
         raise ReplicaDivergenceError(
             f"{name} diverges across processes at leaves "
             f"{[paths[i] for i in bad[:5]]} (checksum matrix row 0 != others)"

@@ -67,6 +67,7 @@ def main(args):
         top_k=args.top_k,
         mesh=mesh,
         quantize=args.quantize,
+        quantized_cache=args.quantized_cache,
     )
     out = np.asarray(out)
     for row in range(min(args.batch, 4)):
@@ -75,7 +76,12 @@ def main(args):
             f"[row {row}] prompt={ids[:args.prompt_len].tolist()} "
             f"-> continuation={ids[args.prompt_len:].tolist()}"
         )
-    mode = "quantized int8" if args.quantize else "full precision"
+    parts = []
+    if args.quantize:
+        parts.append("int8 weights")
+    if args.quantized_cache:
+        parts.append("int8 KV cache")
+    mode = " + ".join(parts) if parts else "full precision"
     where = f"{jax.device_count()}-device mesh" if mesh else "single device"
     print(f"generated {args.batch}x{args.new_tokens} tokens ({mode}, {where})")
 
@@ -94,6 +100,8 @@ if __name__ == "__main__":
     parser.add_argument("--top_k", type=int, default=0)
     parser.add_argument("--quantize", action="store_true",
                         help="weight-only int8 decode")
+    parser.add_argument("--quantized_cache", action="store_true",
+                        help="int8 KV cache (halves long-context decode memory)")
     parser.add_argument("--f32", action="store_true",
                         help="float32 compute instead of the bf16 default")
     parser.add_argument("--snapshot", default=None,

@@ -168,3 +168,31 @@ def test_divergent_device_replicas_detected():
     evil = jax.make_array_from_single_device_arrays(shape, sharding, buffers)
     with pytest.raises(ReplicaDivergenceError, match="replicated"):
         check_device_replicas({"w": evil})
+
+
+def test_consistency_check_skips_sharded_leaves():
+    """assert_replicas_consistent must tolerate deliberately sharded state
+    (Trainer(partition_specs=...)): sharded leaves are excluded from the
+    checksum (their local data legitimately differs per process), replicated
+    leaves still checked."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_pytorch_tpu.parallel.consistency import (
+        assert_replicas_consistent,
+        tree_checksum,
+    )
+    from distributed_pytorch_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"data": 8})
+    tree = {
+        "replicated": jax.device_put(
+            jnp.ones((8, 4)), NamedSharding(mesh, P())
+        ),
+        "sharded": jax.device_put(
+            jnp.arange(16.0).reshape(8, 2), NamedSharding(mesh, P("data"))
+        ),
+    }
+    assert_replicas_consistent(tree, name="mixed")  # must not raise
+    assert len(tree_checksum(tree)) == 1  # only the replicated leaf counted

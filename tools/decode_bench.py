@@ -101,6 +101,9 @@ def main():
     # or int8) can flip — exact greedy parity on a TRAINED model is pinned
     # by tests/test_quant.py instead.
     a, b = np.asarray(out_bf16), np.asarray(out_int8)
+    # Generated tokens only: the prompt prefix is identical by construction
+    # and would inflate the fraction.
+    a, b = a[:, args.prompt_len :], b[:, args.prompt_len :]
     agreement = float(np.mean(a == b))
     print(
         json.dumps(

@@ -30,6 +30,30 @@ def lm_params(model=None, seed=0):
     return model.init(jax.random.PRNGKey(seed), tokens)["params"]
 
 
+def trained_tiny_lm(steps=30):
+    """Tiny LM trained on a repeating pattern so logits carry real margins
+    (random-init params have near-tie argmax that quantization noise flips).
+    Returns (model, params, the training sequences)."""
+    from distributed_pytorch_tpu.training.losses import (
+        softmax_cross_entropy_loss,
+    )
+    from distributed_pytorch_tpu.training.train_step import (
+        create_train_state,
+        make_train_step,
+    )
+
+    model = tiny_lm()
+    seq = np.tile(np.arange(16, dtype=np.int32), (8, 2))  # [8, 32]
+    inputs, targets = seq[:, :-1], seq[:, 1:]
+    state = create_train_state(model, optax.adam(1e-2), inputs)
+    step = make_train_step(
+        model.apply, optax.adam(1e-2), softmax_cross_entropy_loss
+    )
+    for _ in range(steps):
+        state, _ = step(state, (jnp.asarray(inputs), jnp.asarray(targets)))
+    return model, state.params, seq
+
+
 class TestQuantizeInt8:
     def test_roundtrip_error_bound(self):
         rng = np.random.default_rng(0)
@@ -110,30 +134,11 @@ class TestQuantizedDecodeParity:
         match the full-precision path token for token (quant noise ~0.3% RMS
         is far below typical logit margins on a structured task)."""
         from distributed_pytorch_tpu.generation import generate
-        from distributed_pytorch_tpu.training.losses import (
-            softmax_cross_entropy_loss,
-        )
-        from distributed_pytorch_tpu.training.train_step import (
-            create_train_state,
-            make_train_step,
-        )
 
-        model = tiny_lm()
-        # Train a few steps on a repeating pattern so logits have real margins
-        # (pure-random params can have near-ties that int8 noise flips).
-        rng = np.random.default_rng(2)
-        seq = np.tile(np.arange(16, dtype=np.int32), (8, 2))  # [8, 32]
-        inputs, targets = seq[:, :-1], seq[:, 1:]
-        state = create_train_state(model, optax.adam(1e-2), inputs)
-        step = make_train_step(
-            model.apply, optax.adam(1e-2), softmax_cross_entropy_loss
-        )
-        for _ in range(30):
-            state, _ = step(state, (jnp.asarray(inputs), jnp.asarray(targets)))
-
+        model, params, seq = trained_tiny_lm()
         prompt = jnp.asarray(seq[:2, :8], jnp.int32)
-        full = generate(model, state.params, prompt, 12)
-        quant = generate(model, state.params, prompt, 12, quantize=True)
+        full = generate(model, params, prompt, 12)
+        quant = generate(model, params, prompt, 12, quantize=True)
         np.testing.assert_array_equal(np.asarray(full), np.asarray(quant))
 
     def test_prequantized_tree_accepted(self):
@@ -186,33 +191,12 @@ class TestQuantizedDecodeParity:
 
 
 class TestQuantizedKVCache:
-    def _trained_lm(self):
-        import optax
-        from distributed_pytorch_tpu.training.losses import (
-            softmax_cross_entropy_loss,
-        )
-        from distributed_pytorch_tpu.training.train_step import (
-            create_train_state,
-            make_train_step,
-        )
-
-        model = tiny_lm()
-        seq = np.tile(np.arange(16, dtype=np.int32), (8, 2))
-        inputs, targets = seq[:, :-1], seq[:, 1:]
-        state = create_train_state(model, optax.adam(1e-2), inputs)
-        step = make_train_step(
-            model.apply, optax.adam(1e-2), softmax_cross_entropy_loss
-        )
-        for _ in range(30):
-            state, _ = step(state, (jnp.asarray(inputs), jnp.asarray(targets)))
-        return model, state.params, seq
-
     def test_int8_cache_greedy_parity(self):
         """Per-(token, head) int8 KV cache: greedy continuations on a trained
         model match the bf16-cache path token for token."""
         from distributed_pytorch_tpu.generation import generate
 
-        model, params, seq = self._trained_lm()
+        model, params, seq = trained_tiny_lm()
         prompt = jnp.asarray(seq[:2, :8], jnp.int32)
         full = generate(model, params, prompt, 12)
         q = generate(model, params, prompt, 12, quantized_cache=True)
@@ -237,7 +221,7 @@ class TestQuantizedKVCache:
         from distributed_pytorch_tpu.generation import generate
         from distributed_pytorch_tpu.parallel.mesh import make_mesh
 
-        model, params, seq = self._trained_lm()
+        model, params, seq = trained_tiny_lm()
         prompt = jnp.asarray(seq[:8, :8], jnp.int32)
         single = generate(
             model, params, prompt, 8, quantize=True, quantized_cache=True

@@ -26,6 +26,8 @@ there is no user-space NCCL analog.
 
 from distributed_pytorch_tpu.checkpoint import (
     AsyncCheckpointer,
+    export_orbax,
+    import_orbax,
     load_checkpoint,
     load_snapshot,
     save_checkpoint,
@@ -66,6 +68,8 @@ __all__ = [
     "StepProfiler",
     "TrainState",
     "Trainer",
+    "export_orbax",
+    "import_orbax",
     "is_main_process",
     "load_checkpoint",
     "load_snapshot",

@@ -206,3 +206,73 @@ def load_snapshot(path: str, template: Any) -> Tuple[Any, int]:
     """
     state, meta = load_checkpoint(path, template)
     return state, int(meta.get("epochs_run", 0))
+
+
+# -------------------------------------------------------- orbax interop
+
+def export_orbax(path: str, state: Any, *, epochs_run: int = 0) -> None:
+    """Write ``state`` as an Orbax (tensorstore) checkpoint directory — the
+    JAX-ecosystem interchange format — so checkpoints trained here load in
+    any Orbax-consuming stack (and vice versa through
+    :func:`import_orbax`). Process-0-only with a cross-host barrier, like
+    :func:`save_checkpoint`. ``epochs_run`` rides in a sibling JSON file
+    (Orbax trees hold arrays, not metadata).
+
+    The npz format (:func:`save_checkpoint`) stays the framework's native
+    snapshot: single-file, atomic-replace, template-validated. This bridge
+    exists for interop, not as a replacement.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    # Gather on EVERY process before the writer gate: _to_host on sharded
+    # leaves is a cross-host collective (process_allgather); gating it on
+    # process 0 would deadlock multi-host (the save_checkpoint invariant).
+    host_tree = jax.tree_util.tree_map(_to_host, state)
+    if is_main_process():
+        checkpointer = ocp.PyTreeCheckpointer()
+        checkpointer.save(path, host_tree, force=True)
+        with open(path + ".meta.json", "w") as f:
+            json.dump({"epochs_run": int(epochs_run)}, f)
+    barrier("orbax_export")
+
+
+def import_orbax(path: str, template: Any) -> Tuple[Any, int]:
+    """Load an Orbax checkpoint directory into ``template``'s structure;
+    returns ``(tree, epochs_run)`` (0 when no sidecar metadata exists —
+    e.g. a checkpoint produced by another framework)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    checkpointer = ocp.PyTreeCheckpointer()
+    restored = checkpointer.restore(path)
+    # Orbax restores a nested dict whose leaf ORDER (alphabetical keys) need
+    # not match the template's dataclass field order — align by path string,
+    # not position.
+    by_path = {
+        _path_str(p): leaf
+        for p, leaf in jax.tree_util.tree_flatten_with_path(restored)[0]
+    }
+    flat_t, treedef_t = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for p, tmpl in flat_t:
+        key = _path_str(p)
+        if key not in by_path:
+            raise KeyError(
+                f"orbax checkpoint at {path} missing leaf {key!r} "
+                f"(has: {sorted(by_path)[:5]}...)"
+            )
+        value = np.asarray(by_path[key])
+        tmpl_arr = np.asarray(tmpl)
+        if value.shape != tmpl_arr.shape:
+            raise ValueError(
+                f"orbax leaf {key!r} shape {value.shape} != template "
+                f"{tmpl_arr.shape}"
+            )
+        leaves.append(value.astype(tmpl_arr.dtype))
+    epochs = 0
+    meta_path = path + ".meta.json"
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            epochs = int(json.load(f).get("epochs_run", 0))
+    return jax.tree_util.tree_unflatten(treedef_t, leaves), epochs

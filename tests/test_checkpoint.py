@@ -73,3 +73,61 @@ def test_shape_mismatch_raises(tmp_path):
     )
     with pytest.raises(ValueError):
         load_checkpoint(path, bigger)
+
+
+class TestOrbaxInterop:
+    @pytest.fixture(autouse=True)
+    def _require_orbax(self):
+        pytest.importorskip("orbax.checkpoint")
+
+    def test_roundtrip_trainstate(self, tmp_path):
+        import jax.numpy as jnp
+        import optax
+
+        from distributed_pytorch_tpu.checkpoint import (
+            export_orbax,
+            import_orbax,
+        )
+        from distributed_pytorch_tpu.models import ToyRegressor
+        from distributed_pytorch_tpu.training.train_step import (
+            create_train_state,
+            make_train_step,
+        )
+        from distributed_pytorch_tpu.training.losses import mse_loss
+
+        rng = np.random.default_rng(0)
+        xs = jnp.asarray(rng.standard_normal((32, 20)), jnp.float32)
+        ys = jnp.asarray(rng.standard_normal((32, 1)), jnp.float32)
+        opt = optax.adam(1e-2)
+        state = create_train_state(ToyRegressor(), opt, xs)
+        step = make_train_step(ToyRegressor().apply, opt, mse_loss)
+        state, _ = step(state, (xs, ys))
+
+        path = str(tmp_path / "orbax_ckpt")
+        export_orbax(path, state, epochs_run=5)
+        template = create_train_state(ToyRegressor(), opt, xs)
+        restored, epochs = import_orbax(path, template)
+        assert epochs == 5
+        for a, b in zip(
+            jax.tree_util.tree_leaves(state),
+            jax.tree_util.tree_leaves(restored),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6
+            )
+
+    def test_missing_metadata_defaults_to_zero(self, tmp_path):
+        import jax.numpy as jnp
+
+        from distributed_pytorch_tpu.checkpoint import (
+            export_orbax,
+            import_orbax,
+        )
+
+        tree = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+        path = str(tmp_path / "bare")
+        export_orbax(path, tree)
+        os.unlink(path + ".meta.json")
+        restored, epochs = import_orbax(path, tree)
+        assert epochs == 0
+        np.testing.assert_array_equal(np.asarray(restored["w"]), 1.0)

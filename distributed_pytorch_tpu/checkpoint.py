@@ -168,20 +168,31 @@ def load_checkpoint(path: str, template: Any) -> Tuple[Any, Dict]:
     """
     with np.load(path) as data:
         meta = json.loads(bytes(data[_META_KEY].tobytes()).decode("utf-8"))
-        paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
-        leaves = []
-        for p, tmpl in paths_and_leaves:
-            key = _path_str(p)
-            if key not in data:
-                raise KeyError(f"checkpoint {path} missing leaf {key!r}")
-            value = data[key]
-            tmpl_arr = np.asarray(tmpl)
-            if value.shape != tmpl_arr.shape:
-                raise ValueError(
-                    f"checkpoint leaf {key!r} shape {value.shape} != template {tmpl_arr.shape}"
-                )
-            leaves.append(value.astype(tmpl_arr.dtype))
-    return jax.tree_util.tree_unflatten(treedef, leaves), meta
+        tree = _align_to_template(data, template, source=f"checkpoint {path}")
+    return tree, meta
+
+
+def _align_to_template(mapping, template: Any, *, source: str) -> Any:
+    """Rebuild ``template``'s structure from ``mapping`` (any object with
+    ``key in mapping`` / ``mapping[key]``, keyed by "/"-joined leaf paths):
+    missing keys raise, shapes are validated, values cast to the template
+    leaf's dtype. The single leaf-restoration contract, shared by
+    :func:`load_checkpoint` (npz) and :func:`import_orbax`."""
+    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for p, tmpl in paths_and_leaves:
+        key = _path_str(p)
+        if key not in mapping:
+            raise KeyError(f"{source} missing leaf {key!r}")
+        value = np.asarray(mapping[key])
+        tmpl_arr = np.asarray(tmpl)
+        if value.shape != tmpl_arr.shape:
+            raise ValueError(
+                f"{source} leaf {key!r} shape {value.shape} != template "
+                f"{tmpl_arr.shape}"
+            )
+        leaves.append(value.astype(tmpl_arr.dtype))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 def _snapshot_meta(epochs_run: int) -> Dict:
@@ -214,9 +225,14 @@ def export_orbax(path: str, state: Any, *, epochs_run: int = 0) -> None:
     """Write ``state`` as an Orbax (tensorstore) checkpoint directory — the
     JAX-ecosystem interchange format — so checkpoints trained here load in
     any Orbax-consuming stack (and vice versa through
-    :func:`import_orbax`). Process-0-only with a cross-host barrier, like
-    :func:`save_checkpoint`. ``epochs_run`` rides in a sibling JSON file
+    :func:`import_orbax`). ``epochs_run`` rides in a sibling JSON file
     (Orbax trees hold arrays, not metadata).
+
+    Multi-host: EVERY process must call this — the host gather on sharded
+    leaves is a cross-host collective, and orbax's ``save`` itself runs
+    internal ``sync_global_processes`` barriers on all hosts (it gates the
+    actual write on its primary host internally). Only the metadata sidecar
+    is process-0-gated here.
 
     The npz format (:func:`save_checkpoint`) stays the framework's native
     snapshot: single-file, atomic-replace, template-validated. This bridge
@@ -225,15 +241,24 @@ def export_orbax(path: str, state: Any, *, epochs_run: int = 0) -> None:
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
-    # Gather on EVERY process before the writer gate: _to_host on sharded
-    # leaves is a cross-host collective (process_allgather); gating it on
-    # process 0 would deadlock multi-host (the save_checkpoint invariant).
     host_tree = jax.tree_util.tree_map(_to_host, state)
+    checkpointer = ocp.PyTreeCheckpointer()
+    checkpointer.save(path, host_tree, force=True)
     if is_main_process():
-        checkpointer = ocp.PyTreeCheckpointer()
-        checkpointer.save(path, host_tree, force=True)
-        with open(path + ".meta.json", "w") as f:
-            json.dump({"epochs_run": int(epochs_run)}, f)
+        # Atomic sidecar write (tmp + replace), like every write path here:
+        # a truncated meta.json would fail import_orbax where a missing one
+        # correctly defaults to epoch 0.
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(path) or ".", suffix=".meta.tmp"
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(_snapshot_meta(epochs_run), f)
+            os.replace(tmp, path + ".meta.json")
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
     barrier("orbax_export")
 
 
@@ -253,26 +278,12 @@ def import_orbax(path: str, template: Any) -> Tuple[Any, int]:
         _path_str(p): leaf
         for p, leaf in jax.tree_util.tree_flatten_with_path(restored)[0]
     }
-    flat_t, treedef_t = jax.tree_util.tree_flatten_with_path(template)
-    leaves = []
-    for p, tmpl in flat_t:
-        key = _path_str(p)
-        if key not in by_path:
-            raise KeyError(
-                f"orbax checkpoint at {path} missing leaf {key!r} "
-                f"(has: {sorted(by_path)[:5]}...)"
-            )
-        value = np.asarray(by_path[key])
-        tmpl_arr = np.asarray(tmpl)
-        if value.shape != tmpl_arr.shape:
-            raise ValueError(
-                f"orbax leaf {key!r} shape {value.shape} != template "
-                f"{tmpl_arr.shape}"
-            )
-        leaves.append(value.astype(tmpl_arr.dtype))
+    tree = _align_to_template(
+        by_path, template, source=f"orbax checkpoint {path}"
+    )
     epochs = 0
     meta_path = path + ".meta.json"
     if os.path.exists(meta_path):
         with open(meta_path) as f:
             epochs = int(json.load(f).get("epochs_run", 0))
-    return jax.tree_util.tree_unflatten(treedef_t, leaves), epochs
+    return tree, epochs

@@ -232,3 +232,61 @@ class TestQuantizedKVCache:
             mesh=mesh,
         )
         np.testing.assert_array_equal(np.asarray(single), np.asarray(sharded))
+
+
+class TestDecodeByteAccounting:
+    """Structural proof (no hardware needed): XLA's own cost analysis of
+    the compiled decode program shows the int8 KV cache reads fewer bytes —
+    a storage-level saving, so it holds on every backend. (The WEIGHT-quant
+    traffic saving is fusion-dependent — the CPU backend materializes the
+    dequantized weights instead of fusing the convert into the dot — so its
+    verification is the on-chip A/B in tools/decode_bench.py, not a CPU
+    byte count.) The fori_loop body is counted once, so this is per-step
+    traffic."""
+
+    @staticmethod
+    def _body_bytes(model, params, batch, total_len):
+        from distributed_pytorch_tpu.generation import _compiled_run
+
+        decode = model.clone(decode=True)
+        abstract = jax.eval_shape(
+            decode.init,
+            jax.random.PRNGKey(0),
+            jnp.zeros((batch, total_len), jnp.int32),
+        )["cache"]
+        cache = jtu.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), abstract)
+        tokens = jnp.zeros((batch, total_len), jnp.int32)
+        lengths = jnp.full((batch,), 4, jnp.int32)
+        rng = jax.random.PRNGKey(0)
+        run = _compiled_run(decode, total_len, 0.0, 0)
+        analysis = run.lower(
+            params, tokens, cache, lengths, rng
+        ).compile().cost_analysis()
+        if isinstance(analysis, list):
+            analysis = analysis[0]
+        return float(analysis["bytes accessed"])
+
+    def test_int8_cache_cuts_program_bytes(self):
+        # The cache dominates this shape (tiny model, B=4, T=256 -> ~2 MB of
+        # bf16 KV cache vs ~100 KB of weights).
+        kw = dict(
+            vocab_size=128, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+            dtype=jnp.bfloat16,
+        )
+        params = TransformerLM(**kw).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        bf16 = jtu.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            params,
+        )
+        full = self._body_bytes(
+            TransformerLM(**kw), bf16, batch=4, total_len=256
+        )
+        quant = self._body_bytes(
+            TransformerLM(**kw, quantized_cache=True), bf16, batch=4,
+            total_len=256,
+        )
+        assert quant < 0.75 * full, (quant, full)

@@ -77,19 +77,24 @@ def _gather_arrays(tree: Any, metadata: Optional[Dict]) -> Dict[str, np.ndarray]
     return arrays
 
 
-def _write_npz(path: str, arrays: Dict[str, np.ndarray]) -> None:
-    """Atomic durable write: tmp file in the target dir + ``os.replace``."""
+def _atomic_write(path: str, write_fn, *, mode: str = "wb") -> None:
+    """Atomic durable write: tmp file in the target dir + ``os.replace``.
+    ``write_fn(file_object)`` produces the content."""
     directory = os.path.dirname(os.path.abspath(path))
     os.makedirs(directory, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp.npz")
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
     try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, **arrays)
+        with os.fdopen(fd, mode) as f:
+            write_fn(f)
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+
+
+def _write_npz(path: str, arrays: Dict[str, np.ndarray]) -> None:
+    _atomic_write(path, lambda f: np.savez(f, **arrays))
 
 
 def save_checkpoint(path: str, tree: Any, metadata: Optional[Dict] = None) -> None:
@@ -185,13 +190,20 @@ def _align_to_template(mapping, template: Any, *, source: str) -> Any:
         if key not in mapping:
             raise KeyError(f"{source} missing leaf {key!r}")
         value = np.asarray(mapping[key])
-        tmpl_arr = np.asarray(tmpl)
-        if value.shape != tmpl_arr.shape:
+        # Shape/dtype only — NEVER materialize the template leaf: a sharded
+        # TrainState template (Trainer(partition_specs=)) spans
+        # non-addressable devices and cannot be fetched.
+        if hasattr(tmpl, "shape") and hasattr(tmpl, "dtype"):
+            tmpl_shape, tmpl_dtype = tuple(tmpl.shape), tmpl.dtype
+        else:
+            tmpl_arr = np.asarray(tmpl)
+            tmpl_shape, tmpl_dtype = tmpl_arr.shape, tmpl_arr.dtype
+        if value.shape != tmpl_shape:
             raise ValueError(
                 f"{source} leaf {key!r} shape {value.shape} != template "
-                f"{tmpl_arr.shape}"
+                f"{tmpl_shape}"
             )
-        leaves.append(value.astype(tmpl_arr.dtype))
+        leaves.append(value.astype(tmpl_dtype))
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
@@ -245,20 +257,13 @@ def export_orbax(path: str, state: Any, *, epochs_run: int = 0) -> None:
     checkpointer = ocp.PyTreeCheckpointer()
     checkpointer.save(path, host_tree, force=True)
     if is_main_process():
-        # Atomic sidecar write (tmp + replace), like every write path here:
-        # a truncated meta.json would fail import_orbax where a missing one
-        # correctly defaults to epoch 0.
-        fd, tmp = tempfile.mkstemp(
-            dir=os.path.dirname(path) or ".", suffix=".meta.tmp"
+        # Atomic sidecar write: a truncated meta.json would fail
+        # import_orbax where a missing one correctly defaults to epoch 0.
+        _atomic_write(
+            path + ".meta.json",
+            lambda f: json.dump(_snapshot_meta(epochs_run), f),
+            mode="w",
         )
-        try:
-            with os.fdopen(fd, "w") as f:
-                json.dump(_snapshot_meta(epochs_run), f)
-            os.replace(tmp, path + ".meta.json")
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
     barrier("orbax_export")
 
 

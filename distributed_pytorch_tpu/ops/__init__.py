@@ -1,4 +1,5 @@
-"""Hot ops: attention cores (dense / ring / Pallas flash) and fused losses."""
+"""Hot ops: attention cores (dense / ring / Pallas flash), fused losses,
+and int8 weight/KV quantization."""
 
 from distributed_pytorch_tpu.ops.attention import (
     dot_product_attention,
@@ -8,10 +9,20 @@ from distributed_pytorch_tpu.ops.flash_attention import flash_attention
 from distributed_pytorch_tpu.ops.fused_cross_entropy import (
     fused_linear_cross_entropy,
 )
+from distributed_pytorch_tpu.ops.quant import (
+    QuantTensor,
+    dequantize_pytree,
+    quantize_int8,
+    quantize_pytree,
+)
 
 __all__ = [
+    "QuantTensor",
+    "dequantize_pytree",
     "dot_product_attention",
     "flash_attention",
     "fused_linear_cross_entropy",
+    "quantize_int8",
+    "quantize_pytree",
     "ring_attention",
 ]

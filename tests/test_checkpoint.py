@@ -52,7 +52,7 @@ def test_atomic_write_no_partial_file(tmp_path):
     path = str(tmp_path / "ckpt.npz")
     save_checkpoint(path, state.params)
     # No stray tmp files left behind.
-    assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp.npz")] == []
+    assert [f for f in os.listdir(tmp_path) if ".tmp" in f] == []
 
 
 def test_template_structure_mismatch_raises(tmp_path):

@@ -203,3 +203,43 @@ class TestSnapshotRoundTrip:
         assert int(restored.loss_scale.good_steps) == int(
             state.loss_scale.good_steps
         )
+
+
+class TestLossScaleOnMesh:
+    def test_dynamic_scale_dp_parity(self):
+        """Loss scaling composes with the data mesh: the scale replicates
+        with the state, the finiteness check is a global reduction, and the
+        loss curve matches the single-device scaled run exactly."""
+        from distributed_pytorch_tpu.parallel.mesh import make_mesh
+        from distributed_pytorch_tpu.parallel.sharding import (
+            put_global_batch,
+            replicated_sharding,
+        )
+
+        batches = toy_batches(n=4, batch=32)
+        ls = lambda: DynamicLossScale.create(  # noqa: E731
+            initial_scale=64.0, growth_interval=2
+        )
+
+        single_state, single_step, _ = build(loss_scale=ls())
+        mesh = make_mesh({"data": 8})
+        model = ToyRegressor()
+        opt = optax.sgd(1e-2)
+        mesh_state = create_train_state(
+            model, opt, batches[0][0], loss_scale=ls()
+        )
+        mesh_state = jax.device_put(mesh_state, replicated_sharding(mesh))
+        mesh_step = make_train_step(model.apply, opt, mse_loss, mesh=mesh)
+
+        for batch in batches:
+            single_state, loss_a = single_step(single_state, batch)
+            mesh_state, loss_b = mesh_step(
+                mesh_state, put_global_batch(mesh, batch)
+            )
+            np.testing.assert_allclose(
+                float(loss_a), float(loss_b), rtol=1e-6
+            )
+        assert float(mesh_state.loss_scale.scale) == float(
+            single_state.loss_scale.scale
+        )
+        assert float(mesh_state.loss_scale.scale) == 256.0  # grew twice

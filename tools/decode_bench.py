@@ -1,8 +1,10 @@
-"""Decode throughput A/B: bf16 weights vs weight-only int8 (ops/quant.py).
+"""Decode throughput A/B: bf16 baseline vs weight-only int8 vs int8 KV
+cache (ops/quant.py, Attention(quantized_cache=True)).
 
 Autoregressive decode re-reads every matmul weight once per generated token,
 so at small batch it is HBM-bandwidth-bound on parameter bytes and int8
-weights approach 2x tokens/s. This measures it honestly on the real chip:
+weights approach 2x tokens/s; at long context the KV-cache reads take over,
+which the ``tokens_per_sec_int8_kv_cache`` row measures. This measures it honestly on the real chip:
 one compiled fori_loop per variant (generation.generate), value-fetch sync,
 per-token greedy agreement reported (exact parity on a trained model is
 pinned by tests/test_quant.py; random-init weights have near-tie argmax
@@ -79,16 +81,15 @@ def main():
     qparams = quantize_pytree(params)  # once, off the clock
     q_bytes, orig_f32 = quantized_bytes(qparams)
 
-    def run(p, quantize):
+    def run(p, quantize, quantized_cache=False):
         # Warm (compile) + timed repeats; each call is one compiled loop.
-        out = generate(model, p, prompt, args.new_tokens, quantize=quantize)
+        kw = dict(quantize=quantize, quantized_cache=quantized_cache)
+        out = generate(model, p, prompt, args.new_tokens, **kw)
         np.asarray(out)
         times = []
         for _ in range(args.repeats):
             t0 = time.perf_counter()
-            out = generate(
-                model, p, prompt, args.new_tokens, quantize=quantize
-            )
+            out = generate(model, p, prompt, args.new_tokens, **kw)
             np.asarray(out)
             times.append(time.perf_counter() - t0)
         toks = args.batch * args.new_tokens
@@ -96,6 +97,7 @@ def main():
 
     out_bf16, tps_bf16 = run(bf16_params, False)
     out_int8, tps_int8 = run(qparams, True)
+    _, tps_qcache = run(bf16_params, False, quantized_cache=True)
     # Agreement fraction, not an exact-match assert: these are RANDOM-init
     # weights, whose argmax margins are near-ties that either rounding (bf16
     # or int8) can flip — exact greedy parity on a TRAINED model is pinned
@@ -119,7 +121,9 @@ def main():
                 "bf16_weight_MB": round(orig_f32 / 2 / 1e6, 1),
                 "tokens_per_sec_bf16": round(tps_bf16, 1),
                 "tokens_per_sec_int8": round(tps_int8, 1),
+                "tokens_per_sec_int8_kv_cache": round(tps_qcache, 1),
                 "speedup": round(tps_int8 / tps_bf16, 3),
+                "kv_cache_speedup": round(tps_qcache / tps_bf16, 3),
                 "greedy_token_agreement": round(agreement, 4),
             }
         )

@@ -15,6 +15,7 @@ from distributed_pytorch_tpu.ops.quant import (
     quantize_int8,
     quantize_pytree,
 )
+from distributed_pytorch_tpu.ops.quant_matmul import quant_matmul
 
 __all__ = [
     "QuantTensor",
@@ -22,6 +23,7 @@ __all__ = [
     "dot_product_attention",
     "flash_attention",
     "fused_linear_cross_entropy",
+    "quant_matmul",
     "quantize_int8",
     "quantize_pytree",
     "ring_attention",

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from distributed_pytorch_tpu.utils.platform import on_tpu
+
 NEG_INF = -1e30
 
 
@@ -246,7 +248,7 @@ def ring_attention(
     if blocks_fit and not interpret and (fit_k % 128 != 0):
         blocks_fit = False  # lane alignment (see flash_attention)
     if use_flash is None:
-        use_flash = (jax.default_backend() == "tpu" or interpret) and blocks_fit
+        use_flash = (on_tpu() or interpret) and blocks_fit
     elif use_flash and not blocks_fit:
         raise ValueError(
             f"use_flash=True but no legal flash tiling for local block "

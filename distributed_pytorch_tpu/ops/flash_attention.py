@@ -40,6 +40,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
+from distributed_pytorch_tpu.utils.platform import on_tpu
 from distributed_pytorch_tpu.ops.attention import (
     NEG_INF,
     axis_if_divisible,
@@ -412,7 +413,7 @@ def resolve_blocks(
     if (
         autotune_enabled()
         and not interpret
-        and jax.default_backend() == "tpu"
+        and on_tpu()
         # Multi-process SPMD: the sweep's winner is timing-dependent, and
         # hosts choosing different blocks would trace divergent programs
         # around the same collectives (hang/crash). Every host must take
@@ -454,7 +455,7 @@ def flash_attention(
     """
     b, t, h, d = q.shape
     if interpret is None:
-        if jax.default_backend() != "tpu":
+        if not on_tpu():
             # No TPU and no explicit interpret request: the dense XLA path is
             # far faster than the Pallas interpreter — use it.
             return dot_product_attention(q, k, v, causal=causal)

@@ -1,0 +1,104 @@
+"""Pallas TPU kernel: activation @ int8-weight matmul with in-VMEM dequant.
+
+The guaranteed-fused counterpart to ``dequantize() + @``: the weight tile is
+read from HBM as **int8**, converted and scaled in VMEM registers, and fed
+straight to the MXU — the bf16/f32 weight tensor never exists in HBM. This
+is the fallback for the case where XLA chooses to materialize the dequant
+instead of fusing it into the dot (observed on the CPU backend; the TPU
+fusion A/B is ``tools/decode_bench.py`` — see BASELINE.md "pending on-chip
+measurements"). Decode-shaped: small-batch x [B, K] against q [K, N].
+
+Grid: one program per N-block; K is kept whole in VMEM (int8 K x block_n
+tiles are small — 8192 x 512 is 4 MB of the ~16 MB VMEM).
+
+Off-TPU the public op falls back to the dequantize + matmul XLA path, so
+tests run everywhere; ``interpret=True`` runs the actual kernel logic on
+CPU for correctness tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from distributed_pytorch_tpu.ops.quant import QuantTensor, dequantize
+from distributed_pytorch_tpu.utils.platform import on_tpu
+
+
+def _kernel(x_ref, q_ref, s_ref, o_ref):
+    x = x_ref[:]  # [B, K] float32
+    w = q_ref[:].astype(jnp.float32)  # [K, bn] int8 -> f32, in VMEM
+    acc = jax.lax.dot_general(
+        x,
+        w,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[:] = acc * s_ref[:]  # s: [1, bn] per-output-channel scales
+
+
+def _pad_rows(x: jnp.ndarray, multiple: int) -> jnp.ndarray:
+    rows = x.shape[0]
+    padded = -(-rows // multiple) * multiple
+    if padded == rows:
+        return x
+    return jnp.pad(x, ((0, padded - rows), (0, 0)))
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def _quant_matmul_tpu(
+    x: jnp.ndarray,
+    q: jnp.ndarray,
+    scale: jnp.ndarray,
+    *,
+    block_n: int,
+    interpret: bool,
+) -> jnp.ndarray:
+    batch, k = x.shape
+    n = q.shape[1]
+    x32 = _pad_rows(x.astype(jnp.float32), 8)  # f32 sublane multiple
+    grid = (n // block_n,)
+    out = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((x32.shape[0], k), lambda j: (0, 0)),
+            pl.BlockSpec((k, block_n), lambda j: (0, j)),
+            pl.BlockSpec((1, block_n), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((x32.shape[0], block_n), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((x32.shape[0], n), jnp.float32),
+        interpret=interpret,
+    )(x32, q, scale)
+    return out[:batch].astype(x.dtype)
+
+
+def quant_matmul(
+    x: jnp.ndarray,
+    qt: QuantTensor,
+    *,
+    block_n: int = 512,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """``x [B, K] @ dequant(qt) [K, N] -> [B, N]`` reading int8 weights.
+
+    ``qt`` must be a 2-D :class:`~.quant.QuantTensor` quantized over its
+    contraction dim (``quantize_int8(w, (0,))`` — scale shape ``[1, N]``).
+    Runs the Pallas kernel on TPU (or under ``interpret=True``); elsewhere
+    falls back to the XLA dequant + matmul path.
+    """
+    if qt.q.ndim != 2 or qt.scale.shape != (1, qt.q.shape[1]):
+        raise ValueError(
+            f"need a 2-D weight quantized over dim 0; got q {qt.q.shape}, "
+            f"scale {qt.scale.shape}"
+        )
+    n = qt.q.shape[1]
+    use_kernel = interpret or on_tpu()
+    if not use_kernel or n % block_n != 0:
+        return (x @ dequantize(qt, x.dtype)).astype(x.dtype)
+    return _quant_matmul_tpu(
+        x, qt.q, qt.scale, block_n=block_n, interpret=interpret
+    )

@@ -22,3 +22,11 @@ def use_fake_cpu_devices(n: int = 8) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def on_tpu() -> bool:
+    """True when the default backend compiles for TPU (the predicate the
+    Pallas kernels key on — same check as ops/flash_attention.py)."""
+    import jax
+
+    return jax.default_backend() == "tpu"

@@ -4,11 +4,15 @@ cache (ops/quant.py, Attention(quantized_cache=True)).
 Autoregressive decode re-reads every matmul weight once per generated token,
 so at small batch it is HBM-bandwidth-bound on parameter bytes and int8
 weights approach 2x tokens/s; at long context the KV-cache reads take over,
-which the ``tokens_per_sec_int8_kv_cache`` row measures. This measures it honestly on the real chip:
+which the separate long-decode cache pair measures
+(``tokens_per_sec_{bf16,int8}_cache`` / ``kv_cache_speedup`` at
+``--cache_new_tokens``). This measures it honestly on the real chip:
 one compiled fori_loop per variant (generation.generate), value-fetch sync,
 per-token greedy agreement reported (exact parity on a trained model is
 pinned by tests/test_quant.py; random-init weights have near-tie argmax
-margins either rounding can flip).
+margins either rounding can flip, and over long horizons one flip cascades —
+every later token differs — so agreement fractions decay with decode length
+by construction, not by numeric degradation).
 
     python tools/decode_bench.py [--d_model 1024] [--n_layers 12] \
         [--batch 8] [--new_tokens 128]
@@ -34,6 +38,8 @@ def main():
     p.add_argument("--prompt_len", type=int, default=16)
     p.add_argument("--new_tokens", type=int, default=128)
     p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--cache_new_tokens", type=int, default=2048,
+                   help="decode length for the KV-cache A/B pair")
     p.add_argument("--fake_devices", type=int, default=0,
                    help="debug: run on N virtual CPU devices")
     args = p.parse_args()
@@ -81,15 +87,16 @@ def main():
     qparams = quantize_pytree(params)  # once, off the clock
     q_bytes, orig_f32 = quantized_bytes(qparams)
 
-    def run(p, quantize, quantized_cache=False):
+    def run(p, quantize):
         # Warm (compile) + timed repeats; each call is one compiled loop.
-        kw = dict(quantize=quantize, quantized_cache=quantized_cache)
-        out = generate(model, p, prompt, args.new_tokens, **kw)
+        out = generate(model, p, prompt, args.new_tokens, quantize=quantize)
         np.asarray(out)
         times = []
         for _ in range(args.repeats):
             t0 = time.perf_counter()
-            out = generate(model, p, prompt, args.new_tokens, **kw)
+            out = generate(
+                model, p, prompt, args.new_tokens, quantize=quantize
+            )
             np.asarray(out)
             times.append(time.perf_counter() - t0)
         toks = args.batch * args.new_tokens
@@ -97,7 +104,6 @@ def main():
 
     out_bf16, tps_bf16 = run(bf16_params, False)
     out_int8, tps_int8 = run(qparams, True)
-    _, tps_qcache = run(bf16_params, False, quantized_cache=True)
     # Agreement fraction, not an exact-match assert: these are RANDOM-init
     # weights, whose argmax margins are near-ties that either rounding (bf16
     # or int8) can flip — exact greedy parity on a TRAINED model is pinned
@@ -107,6 +113,32 @@ def main():
     # and would inflate the fraction.
     a, b = a[:, args.prompt_len :], b[:, args.prompt_len :]
     agreement = float(np.mean(a == b))
+
+    # KV-cache A/B is its own pair at LONG context (the cache is a rounding
+    # error next to the weights at the default 144-token length; the cache
+    # claim only means anything when cache bytes rival weight bytes).
+    def run_cache(quantized_cache):
+        kw = dict(quantize=False, quantized_cache=quantized_cache)
+        out = generate(
+            model, bf16_params, prompt, args.cache_new_tokens, **kw
+        )
+        np.asarray(out)
+        times = []
+        for _ in range(args.repeats):  # same methodology as the weight A/B
+            t0 = time.perf_counter()
+            out = generate(
+                model, bf16_params, prompt, args.cache_new_tokens, **kw
+            )
+            np.asarray(out)
+            times.append(time.perf_counter() - t0)
+        toks = args.batch * args.cache_new_tokens
+        return out, toks / min(times)
+
+    out_c16, tps_c16 = run_cache(False)
+    out_c8, tps_c8 = run_cache(True)
+    c16 = np.asarray(out_c16)[:, args.prompt_len :]
+    c8 = np.asarray(out_c8)[:, args.prompt_len :]
+    cache_agreement = float(np.mean(c16 == c8))
     print(
         json.dumps(
             {
@@ -121,10 +153,13 @@ def main():
                 "bf16_weight_MB": round(orig_f32 / 2 / 1e6, 1),
                 "tokens_per_sec_bf16": round(tps_bf16, 1),
                 "tokens_per_sec_int8": round(tps_int8, 1),
-                "tokens_per_sec_int8_kv_cache": round(tps_qcache, 1),
                 "speedup": round(tps_int8 / tps_bf16, 3),
-                "kv_cache_speedup": round(tps_qcache / tps_bf16, 3),
                 "greedy_token_agreement": round(agreement, 4),
+                "cache_ab_new_tokens": args.cache_new_tokens,
+                "tokens_per_sec_bf16_cache": round(tps_c16, 1),
+                "tokens_per_sec_int8_cache": round(tps_c8, 1),
+                "kv_cache_speedup": round(tps_c8 / tps_c16, 3),
+                "cache_token_agreement": round(cache_agreement, 4),
             }
         )
     )

@@ -15,9 +15,13 @@ Mechanics:
 * each loop step feeds ONE token per sequence, updates the caches in place
   (functionally — donated buffers under jit), and samples the next token
   (greedy, temperature, optional top-k);
-* prompt handling needs no separate prefill phase: while ``t`` is inside the
-  prompt the sampled token is discarded in favor of the prompt token, so
-  prompts of ragged lengths work with one code path.
+* the common prompt prefix (up to the shortest row's length) is PREFILLED
+  in one batched forward — a single MXU-friendly pass instead of
+  ``min_len`` serial single-token steps (``Attention._decode_step`` handles
+  multi-token chunks: per-position RoPE and an intra-chunk causal mask);
+* past the prefill, ragged prompts need no special casing: while ``t`` is
+  inside a row's prompt the sampled token is discarded in favor of the
+  prompt token, so one loop covers every row.
 """
 
 from __future__ import annotations
@@ -136,6 +140,13 @@ def generate(
         axis=1,
     )
     prompt_lengths = jnp.asarray(prompt_lengths, jnp.int32)
+    # Static prefill length, computed HOST-SIDE before any device placement
+    # (a batch-sharded array could span non-addressable devices). Clamped to
+    # 1: a zero-length row means position 0 is already generated, so the
+    # serial loop must start at t=0. -1 below because the loop body at
+    # position t decides token t+1 — the last prefix token must go through
+    # the loop to produce the first prediction.
+    prefill_len = max(1, int(np.min(np.asarray(prompt_lengths))))
 
     if mesh is not None:
         batch_sh = NamedSharding(mesh, P(data_axis))
@@ -157,15 +168,24 @@ def generate(
             lambda s: jnp.zeros(s.shape, s.dtype), abstract
         )
 
-    run = _compiled_run(decode_model, total_len, float(temperature), int(top_k))
+    run = _compiled_run(
+        decode_model, total_len, float(temperature), int(top_k), prefill_len
+    )
     return run(params, tokens0, cache, prompt_lengths, rng)
 
 
 @functools.lru_cache(maxsize=32)
-def _compiled_run(decode_model, total_len: int, temperature: float, top_k: int):
-    """Jitted decode loop, cached per (model config, length, sampling config)
-    so repeated generate() calls with the same shapes reuse the executable
-    (flax modules are frozen dataclasses, hence hashable cache keys)."""
+def _compiled_run(
+    decode_model,
+    total_len: int,
+    temperature: float,
+    top_k: int,
+    prefill_len: int = 1,
+):
+    """Jitted decode loop, cached per (model config, length, sampling config,
+    prefill length) so repeated generate() calls with the same shapes reuse
+    the executable (flax modules are frozen dataclasses, hence hashable
+    cache keys)."""
 
     def sample(logits, step_rng):
         if temperature <= 0.0:
@@ -178,6 +198,22 @@ def _compiled_run(decode_model, total_len: int, temperature: float, top_k: int):
 
     def run(params, tokens, cache, prompt_lengths, rng):
         batch = tokens.shape[0]
+        dtype = getattr(decode_model, "dtype", jnp.bfloat16)
+
+        if prefill_len > 1:
+            # One batched forward over the common prefix: every row's tokens
+            # at positions [0, prefill_len-1) are true prompt tokens (it is
+            # the MINIMUM prompt length), so the caches fill in parallel and
+            # the serial loop starts at prefill_len-1 with cache_index
+            # already there — the same invariant (cache_index == t at body
+            # entry) the single-token path maintains.
+            chunk = tokens[:, : prefill_len - 1]
+            _, updated = decode_model.apply(
+                {"params": dequantize_pytree(params, dtype), "cache": cache},
+                chunk,
+                mutable=["cache"],
+            )
+            cache = updated["cache"]
 
         def body(t, carry):
             tokens, cache, rng = carry
@@ -186,7 +222,6 @@ def _compiled_run(decode_model, total_len: int, temperature: float, top_k: int):
             # the loop body: the int8->compute-dtype convert is a producer
             # each weight's consumer matmul fuses, so the loop reads int8
             # from HBM.
-            dtype = getattr(decode_model, "dtype", jnp.bfloat16)
             logits, updated = decode_model.apply(
                 {"params": dequantize_pytree(params, dtype), "cache": cache},
                 current,
@@ -206,7 +241,7 @@ def _compiled_run(decode_model, total_len: int, temperature: float, top_k: int):
             return tokens, cache, rng
 
         tokens, _, _ = jax.lax.fori_loop(
-            0, total_len - 1, body, (tokens, cache, rng)
+            prefill_len - 1, total_len - 1, body, (tokens, cache, rng)
         )
         return tokens
 

@@ -350,6 +350,102 @@ def bench_lm(
     }
 
 
+def bench_scaling(n_steps: int = 10, per_chip_batch: int = 8, seq_len: int = 512):
+    """DP weak-scaling efficiency: fixed per-chip work, growing device count.
+
+    The BASELINE.json north star (>=90% per-chip efficiency at 1->8->32) needs
+    a harness before it needs hardware: this measures steps/s on submeshes of
+    1, 2, 4, ..., N devices with the SAME per-chip batch. Ideal weak scaling
+    keeps steps/s flat, so ``efficiency = steps_per_sec(n) / steps_per_sec(1)``.
+    On the 8-virtual-CPU rig this exercises the real DP code path (sharded
+    batch, replicated state, XLA gradient all-reduce); on a real slice the
+    identical command reports ICI-backed numbers. Single-device rigs (the one
+    tunneled chip) report n=1 only, marked ``awaiting_hardware``.
+
+    Workload: a small TransformerLM — enough matmul work per step that the
+    all-reduce is a realistic fraction, small enough to run on CPU devices.
+    """
+    import jax
+    import numpy as np
+    import optax
+
+    from distributed_pytorch_tpu.models.transformer import TransformerLM
+    from distributed_pytorch_tpu.parallel.mesh import make_mesh
+    from distributed_pytorch_tpu.parallel.sharding import (
+        put_global_batch,
+        replicated_sharding,
+    )
+    from distributed_pytorch_tpu.training.losses import softmax_cross_entropy_loss
+    from distributed_pytorch_tpu.training.train_step import (
+        create_train_state,
+        make_train_step,
+    )
+
+    import jax.numpy as jnp
+
+    devices = jax.devices()
+    counts = [n for n in (1, 2, 4, 8, 16, 32, 64) if n <= len(devices)]
+    on_cpu = devices[0].platform == "cpu"
+    if on_cpu:
+        # The virtual-device rig shares one host's cores across all N
+        # "devices" and emulates bf16 — keep per-device work tiny so the
+        # n=8 leg (8x total host FLOPs under weak scaling) stays fast.
+        seq_len = 128
+        vocab = 2048
+        model = TransformerLM(
+            vocab_size=vocab, d_model=128, n_layers=2, n_heads=4, d_ff=512,
+            dtype=jnp.float32,
+        )
+    else:
+        vocab = 8192
+        model = TransformerLM(
+            vocab_size=vocab, d_model=256, n_layers=4, n_heads=8, d_ff=1024,
+            dtype=jnp.bfloat16,
+        )
+    optimizer = optax.adam(1e-4)
+    rng = np.random.default_rng(0)
+
+    rows = []
+    base_sps = None
+    for n in counts:
+        mesh = make_mesh({"data": n}, devices=devices[:n])
+        batch = per_chip_batch * n
+        inputs = rng.integers(0, vocab, (batch, seq_len)).astype(np.int32)
+        targets = rng.integers(0, vocab, (batch, seq_len)).astype(np.int32)
+        state = create_train_state(model, optimizer, inputs[:1])
+        state = jax.device_put(state, replicated_sharding(mesh))
+        step_fn = make_train_step(
+            model.apply, optimizer, softmax_cross_entropy_loss, mesh=mesh
+        )
+        gbatch = put_global_batch(mesh, (inputs, targets))
+        _, elapsed = timed_steps(step_fn, state, [gbatch], n_steps, warmup=2)
+        sps = n_steps / elapsed
+        if base_sps is None:
+            base_sps = sps
+        rows.append(
+            {
+                "n_devices": n,
+                "per_chip_batch": per_chip_batch,
+                "steps_per_sec": round(sps, 4),
+                "tokens_per_sec": round(sps * batch * seq_len, 1),
+                "per_chip_efficiency": round(sps / base_sps, 4),
+            }
+        )
+    return {
+        "mode": "weak_scaling_dp",
+        "workload": f"transformer_lm_small_t{seq_len}_b{per_chip_batch}_per_chip",
+        "platform": devices[0].platform,
+        "device_kind": devices[0].device_kind,
+        # True until this runs on a real multi-chip slice: a single tunneled
+        # chip can't scale, and N virtual CPU "devices" share one host's
+        # cores, so their weak-scaling "efficiency" measures host-core
+        # saturation (expected ~1/N), not the interconnect. The harness is
+        # validated here; the number waits for hardware.
+        "awaiting_hardware": on_cpu or len(devices) == 1,
+        "rows": rows,
+    }
+
+
 def attach_mfu(result: dict, peak: float) -> dict:
     per_chip = result["flops_per_step"] * result["steps_per_sec"] / result["n_chips"]
     result["model_tflops_per_sec_per_chip"] = round(per_chip / 1e12, 2)
@@ -361,18 +457,167 @@ def attach_mfu(result: dict, peak: float) -> dict:
     return result
 
 
+def init_backend_with_retry(
+    retries: int = 3, base_delay: float = 10.0, attempt_timeout: float = 180.0
+):
+    """Initialize the JAX backend with bounded retry + backoff + watchdog.
+
+    The round-3 driver run lost its entire perf record because one wedged
+    tunnel turned ``jax.devices()`` into a 40-line traceback — and a wedged
+    relay can also make it HANG forever (observed: 2.5h+ with zero CPU).
+    So each attempt runs in a daemon thread under ``attempt_timeout``; a
+    hang is converted into a reportable failure instead of an eternal
+    driver stall. Transient unavailability (tunnel reconnect, TPU runtime
+    restart) gets a few patient retries; persistent failure must surface
+    as a structured result, not a stack trace. Returns ``(device, None)``
+    on success or ``(None, last_error_string)`` after exhausting retries.
+    A timed-out attempt leaves its thread parked inside the C++ client —
+    callers should exit via ``os._exit`` after printing, which ``main``
+    does.
+    """
+    import queue
+    import threading
+
+    import jax
+
+    last_err = None
+    for attempt in range(retries):
+        result_q: "queue.Queue" = queue.Queue()
+
+        def probe(q=result_q):
+            try:
+                q.put(("ok", jax.devices()[0]))
+            except Exception as e:  # RuntimeError / JaxRuntimeError
+                q.put(("err", f"{type(e).__name__}: {e}"))
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        try:
+            kind, payload = result_q.get(timeout=attempt_timeout)
+        except queue.Empty:
+            kind, payload = "err", (
+                f"TimeoutError: backend init hung > {attempt_timeout:.0f}s "
+                "(wedged tunnel?)"
+            )
+            # The probe thread is stuck inside backend init; a fresh attempt
+            # in this process would just join the same wedged dial. Stop
+            # retrying and report.
+            return None, payload
+        if kind == "ok":
+            return payload, None
+        last_err = payload
+        # Drop the cached failed-backend state so the next attempt
+        # actually re-dials instead of replaying the cached error.
+        try:
+            from jax._src import xla_bridge as _xb
+
+            _xb._clear_backends()
+        except Exception:
+            pass
+        if attempt < retries - 1:
+            delay = base_delay * (2**attempt)
+            print(
+                f"# backend init failed (attempt {attempt + 1}/{retries}), "
+                f"retrying in {delay:.0f}s: {last_err.splitlines()[0]}",
+                flush=True,
+            )
+            time.sleep(delay)
+    return None, last_err
+
+
+def emit_failure(error: str, detail: str, stage: str) -> None:
+    """One parseable JSON line for the driver — never a bare traceback."""
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_bf16_train_steps_per_sec",
+                "value": None,
+                "unit": "steps/s",
+                "vs_baseline": None,
+                "error": error,
+                "stage": stage,
+                "detail": detail.splitlines()[-1][:400] if detail else "",
+            }
+        )
+    )
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--matrix", action="store_true",
         help="run the full workload matrix and write BENCH_MATRIX.json",
     )
+    parser.add_argument(
+        "--scaling", action="store_true",
+        help="measure DP scaling efficiency over all local devices and "
+        "write BENCH_SCALING.json",
+    )
+    parser.add_argument(
+        "--fake_devices", type=int, default=0, metavar="N",
+        help="run on N virtual CPU devices instead of the real backend "
+        "(the --scaling rig until a multi-chip slice exists)",
+    )
     args = parser.parse_args()
 
-    import jax
+    if args.fake_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={args.fake_devices}"
+        ).strip()
+        import jax
 
-    dev = jax.devices()[0]
+        # Env-var JAX_PLATFORMS is overridden by tunnel platform plugins
+        # (which then dial a possibly-dead relay); the config update after
+        # import is authoritative.
+        jax.config.update("jax_platforms", "cpu")
+
+    dev, err = init_backend_with_retry()
+    if dev is None:
+        emit_failure("backend_unavailable", err or "", stage="init")
+        # A timed-out probe thread may still be parked inside the C++
+        # client; don't let interpreter teardown hang on it.
+        import sys
+
+        sys.stdout.flush()
+        os._exit(0)
     peak = peak_flops_per_chip(dev)
+
+    try:
+        run_benches(args, dev, peak)
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        emit_failure(
+            "bench_failed", f"{type(e).__name__}: {e}", stage="measure"
+        )
+
+
+def run_benches(args, dev, peak):
+    if args.scaling:
+        # Exclusive mode (one JSON line per invocation): measure DP weak
+        # scaling over every local device and stop — the 8-virtual-CPU rig
+        # runs this without paying for the ResNet headline on CPU.
+        scaling = bench_scaling()
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_SCALING.json"
+        )
+        with open(path, "w") as f:
+            json.dump(scaling, f, indent=1)
+        last = scaling["rows"][-1]
+        print(
+            json.dumps(
+                {
+                    "metric": f"dp_weak_scaling_efficiency_{last['n_devices']}dev",
+                    "value": last["per_chip_efficiency"],
+                    "unit": "ratio_vs_1dev",
+                    "vs_baseline": last["per_chip_efficiency"],
+                    "awaiting_hardware": scaling["awaiting_hardware"],
+                }
+            )
+        )
+        return
 
     headline = attach_mfu(bench_resnet(32), peak)
 

@@ -240,11 +240,15 @@ def export_orbax(path: str, state: Any, *, epochs_run: int = 0) -> None:
     :func:`import_orbax`). ``epochs_run`` rides in a sibling JSON file
     (Orbax trees hold arrays, not metadata).
 
-    Multi-host: EVERY process must call this — the host gather on sharded
-    leaves is a cross-host collective, and orbax's ``save`` itself runs
-    internal ``sync_global_processes`` barriers on all hosts (it gates the
-    actual write on its primary host internally). Only the metadata sidecar
-    is process-0-gated here.
+    Multi-host: EVERY process must call this — orbax's ``save`` runs
+    internal ``sync_global_processes`` barriers on all hosts. Only the
+    metadata sidecar is process-0-gated here.
+
+    Sharded leaves are handed to orbax AS live ``jax.Array``\\ s: its
+    tensorstore backend writes each host's addressable shards directly, so
+    no process materializes the full global state in host memory — the
+    models big enough to need sharded checkpointing are exactly the ones a
+    per-leaf host allgather would OOM.
 
     The npz format (:func:`save_checkpoint`) stays the framework's native
     snapshot: single-file, atomic-replace, template-validated. This bridge
@@ -253,9 +257,8 @@ def export_orbax(path: str, state: Any, *, epochs_run: int = 0) -> None:
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
-    host_tree = jax.tree_util.tree_map(_to_host, state)
     checkpointer = ocp.PyTreeCheckpointer()
-    checkpointer.save(path, host_tree, force=True)
+    checkpointer.save(path, state, force=True)
     if is_main_process():
         # Atomic sidecar write: a truncated meta.json would fail
         # import_orbax where a missing one correctly defaults to epoch 0.

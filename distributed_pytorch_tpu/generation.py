@@ -117,6 +117,20 @@ def generate(
         # caller) so repeated generate() calls don't pay re-quantization.
         if not already:
             params = quantize_pytree(params)
+            from distributed_pytorch_tpu.ops.quant import quant_coverage
+
+            coverage = quant_coverage(params)
+            if coverage < 0.5:
+                import warnings
+
+                warnings.warn(
+                    f"quantize=True matched only {coverage:.0%} of param "
+                    "elements — the quant rules likely don't cover this "
+                    "model's kernels (see ops.quant.TRANSFORMER_QUANT_RULES); "
+                    "decode will still read the unmatched weights in full "
+                    "precision",
+                    stacklevel=2,
+                )
     batch, prompt_len = prompt.shape
     total_len = prompt_len + max_new_tokens
     if prompt_lengths is None:
@@ -147,6 +161,14 @@ def generate(
     # position t decides token t+1 — the last prefix token must go through
     # the loop to produce the first prediction.
     prefill_len = max(1, int(np.min(np.asarray(prompt_lengths))))
+    # Bucket DOWN to a power of two: prefill_len is part of the compile-cache
+    # key, and with naturally varied prompt lengths an exact value would
+    # compile a fresh decode executable per distinct batch-minimum (thrashing
+    # the 32-entry cache). Rounding down is always safe — positions between
+    # the bucketed prefill and each row's true prompt length are replayed by
+    # the serial loop's keep-prompt path — and costs at most 2x the prefill
+    # tokens while capping the number of variants at log2(T).
+    prefill_len = 1 << (prefill_len.bit_length() - 1)
 
     if mesh is not None:
         batch_sh = NamedSharding(mesh, P(data_axis))

@@ -69,6 +69,13 @@ TRANSFORMER_QUANT_RULES: Sequence[Tuple[str, Tuple[int, ...]]] = (
     (r".*/attention/(query|key|value)/kernel$", (0,)),
     (r".*/attention/out/kernel$", (0, 1)),
     (r".*/mlp/(up|down)/kernel$", (0,)),
+    # MoE stacked expert kernels [E, in, out] (models/moe.py einsums
+    # "ebcm,emf" / "ebcf,efm" contract dim 1) — in an MoE model these ARE
+    # the bulk of the params, so skipping them would quietly gut the int8
+    # memory win. Scales stay per-(expert, out-channel). The tiny router
+    # Dense stays full precision (it feeds a float32 softmax for routing
+    # stability).
+    (r".*/moe/(up|down)_kernel$", (1,)),
     (r"^lm_head/kernel$", (0,)),
 )
 
@@ -158,3 +165,25 @@ def quantized_bytes(tree: Any) -> Tuple[int, int]:
             q_bytes += leaf.q.size + leaf.scale.size * 4
             orig += leaf.q.size * 4
     return q_bytes, orig
+
+
+def quant_coverage(tree: Any) -> float:
+    """Fraction of parameter ELEMENTS held in :class:`QuantTensor` leaves.
+
+    Rule tables match by path, and a silent non-match (a renamed module, a
+    new model family) would quietly ship a "quantized" model that still
+    reads most of its weights in full precision. Callers that quantize on
+    behalf of a user (``generation.generate``) check this and warn when the
+    rules missed the bulk of the params — the repo's no-silent-caps
+    convention.
+    """
+    quantized = total = 0
+    for leaf in jtu.tree_leaves(
+        tree, is_leaf=lambda x: isinstance(x, QuantTensor)
+    ):
+        if isinstance(leaf, QuantTensor):
+            quantized += leaf.q.size
+            total += leaf.q.size
+        elif hasattr(leaf, "size"):
+            total += leaf.size
+    return quantized / total if total else 0.0

@@ -8,12 +8,14 @@ instead of fusing it into the dot (observed on the CPU backend; the TPU
 fusion A/B is ``tools/decode_bench.py`` — see BASELINE.md "pending on-chip
 measurements"). Decode-shaped: small-batch x [B, K] against q [K, N].
 
-Grid: one program per N-block; K is kept whole in VMEM (int8 K x block_n
-tiles are small — 8192 x 512 is 4 MB of the ~16 MB VMEM).
-
-Off-TPU the public op falls back to the dequantize + matmul XLA path, so
-tests run everywhere; ``interpret=True`` runs the actual kernel logic on
-CPU for correctness tests.
+Grid: ``(N/block_n, K/block_k)`` — K is TILED, not held whole in VMEM.
+TPU grid execution is sequential with the last dimension fastest, so each
+output block accumulates over its K tiles in place and applies the
+per-channel scales once on the final tile. Per-program VMEM residency is
+``block_k * block_n`` int8 (+ its f32 convert) plus the small x/out tiles,
+so arbitrary K fits; shapes whose dims no supported tile divides fall back
+to the XLA dequant + matmul path instead of failing in the Mosaic compiler
+(the n % block_n fallback generalized, per ADVICE round 3).
 """
 
 from __future__ import annotations
@@ -27,17 +29,32 @@ from jax.experimental import pallas as pl
 from distributed_pytorch_tpu.ops.quant import QuantTensor, dequantize
 from distributed_pytorch_tpu.utils.platform import on_tpu
 
+# Candidate K-tile sizes, largest first: bigger tiles amortize grid overhead;
+# 128 is the MXU contraction width and the f32 lane tile, so every candidate
+# keeps the x tile lane-aligned. 2048 int8 x 512 lanes = 1 MB int8 + 4 MB f32
+# convert per tile — comfortable in ~16 MB VMEM.
+_BLOCK_K_CANDIDATES = (2048, 1024, 512, 256, 128)
+
 
 def _kernel(x_ref, q_ref, s_ref, o_ref):
-    x = x_ref[:]  # [B, K] float32
-    w = q_ref[:].astype(jnp.float32)  # [K, bn] int8 -> f32, in VMEM
-    acc = jax.lax.dot_general(
+    kid = pl.program_id(1)
+
+    @pl.when(kid == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    x = x_ref[:]  # [B, block_k] float32
+    w = q_ref[:].astype(jnp.float32)  # [block_k, bn] int8 -> f32, in VMEM
+    o_ref[:] += jax.lax.dot_general(
         x,
         w,
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    o_ref[:] = acc * s_ref[:]  # s: [1, bn] per-output-channel scales
+
+    @pl.when(kid == pl.num_programs(1) - 1)
+    def _finish():
+        o_ref[:] = o_ref[:] * s_ref[:]  # s: [1, bn] per-output-channel
 
 
 def _pad_rows(x: jnp.ndarray, multiple: int) -> jnp.ndarray:
@@ -48,28 +65,33 @@ def _pad_rows(x: jnp.ndarray, multiple: int) -> jnp.ndarray:
     return jnp.pad(x, ((0, padded - rows), (0, 0)))
 
 
-@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("block_n", "block_k", "interpret")
+)
 def _quant_matmul_tpu(
     x: jnp.ndarray,
     q: jnp.ndarray,
     scale: jnp.ndarray,
     *,
     block_n: int,
+    block_k: int,
     interpret: bool,
 ) -> jnp.ndarray:
     batch, k = x.shape
     n = q.shape[1]
     x32 = _pad_rows(x.astype(jnp.float32), 8)  # f32 sublane multiple
-    grid = (n // block_n,)
+    grid = (n // block_n, k // block_k)
     out = pl.pallas_call(
         _kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((x32.shape[0], k), lambda j: (0, 0)),
-            pl.BlockSpec((k, block_n), lambda j: (0, j)),
-            pl.BlockSpec((1, block_n), lambda j: (0, j)),
+            pl.BlockSpec((x32.shape[0], block_k), lambda j, kk: (0, kk)),
+            pl.BlockSpec((block_k, block_n), lambda j, kk: (kk, j)),
+            pl.BlockSpec((1, block_n), lambda j, kk: (0, j)),
         ],
-        out_specs=pl.BlockSpec((x32.shape[0], block_n), lambda j: (0, j)),
+        # Independent of the K grid index: the same output block is revisited
+        # across K tiles (sequential on TPU), accumulating in place.
+        out_specs=pl.BlockSpec((x32.shape[0], block_n), lambda j, kk: (0, j)),
         out_shape=jax.ShapeDtypeStruct((x32.shape[0], n), jnp.float32),
         interpret=interpret,
     )(x32, q, scale)
@@ -87,18 +109,21 @@ def quant_matmul(
 
     ``qt`` must be a 2-D :class:`~.quant.QuantTensor` quantized over its
     contraction dim (``quantize_int8(w, (0,))`` — scale shape ``[1, N]``).
-    Runs the Pallas kernel on TPU (or under ``interpret=True``); elsewhere
-    falls back to the XLA dequant + matmul path.
+    Runs the Pallas kernel on TPU (or under ``interpret=True``); elsewhere —
+    or when no supported tile divides N and K evenly — falls back to the
+    XLA dequant + matmul path, so every shape computes correctly and only
+    aligned ones take the kernel.
     """
     if qt.q.ndim != 2 or qt.scale.shape != (1, qt.q.shape[1]):
         raise ValueError(
             f"need a 2-D weight quantized over dim 0; got q {qt.q.shape}, "
             f"scale {qt.scale.shape}"
         )
-    n = qt.q.shape[1]
+    k, n = qt.q.shape
+    block_k = next((c for c in _BLOCK_K_CANDIDATES if k % c == 0), None)
     use_kernel = interpret or on_tpu()
-    if not use_kernel or n % block_n != 0:
+    if not use_kernel or n % block_n != 0 or block_k is None:
         return (x @ dequantize(qt, x.dtype)).astype(x.dtype)
     return _quant_matmul_tpu(
-        x, qt.q, qt.scale, block_n=block_n, interpret=interpret
+        x, qt.q, qt.scale, block_n=block_n, block_k=block_k, interpret=interpret
     )

@@ -344,3 +344,112 @@ class TestQuantMatmulKernel:
         qt = quantize_int8(w, (0,))
         with _pytest.raises(ValueError, match="2-D"):
             quant_matmul(jnp.ones((2, 8), jnp.float32), qt)
+
+
+class TestMoEQuantCoverage:
+    """Round-3 ADVICE: MoE expert kernels are the bulk of an MoE model's
+    params — the rules must cover them, and generate(quantize=True) must
+    report, not hide, poor rule coverage."""
+
+    def _moe_params(self):
+        model = TransformerLM(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+            n_experts=4, moe_every=1,
+        )
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        return model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    def test_expert_kernels_quantized(self):
+        from distributed_pytorch_tpu.ops.quant import quant_coverage
+
+        params = self._moe_params()
+        qtree = quantize_pytree(params, TRANSFORMER_QUANT_RULES)
+        flat = jtu.tree_flatten_with_path(
+            qtree, is_leaf=lambda x: isinstance(x, QuantTensor)
+        )[0]
+        quantized_paths = {
+            "/".join(str(getattr(e, "key", e)) for e in path)
+            for path, leaf in flat
+            if isinstance(leaf, QuantTensor)
+        }
+        assert any("moe/up_kernel" in p for p in quantized_paths)
+        assert any("moe/down_kernel" in p for p in quantized_paths)
+        # The float32-softmax router stays full precision.
+        assert not any("router" in p for p in quantized_paths)
+        # With experts covered, the matched fraction is the bulk of params.
+        assert quant_coverage(qtree) > 0.5
+
+    def test_expert_quant_numerics(self):
+        params = self._moe_params()
+        qtree = quantize_pytree(params, TRANSFORMER_QUANT_RULES)
+        back = dequantize_pytree(qtree, jnp.float32)
+        for (path, a), (_, b) in zip(
+            jtu.tree_flatten_with_path(params)[0],
+            jtu.tree_flatten_with_path(back)[0],
+        ):
+            a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+            denom = np.sqrt(np.mean(a**2)) or 1.0
+            assert np.sqrt(np.mean((a - b) ** 2)) / denom < 0.01, path
+
+    def test_coverage_warning_on_unmatched_tree(self):
+        import warnings
+
+        from distributed_pytorch_tpu.generation import generate
+
+        model = tiny_lm()
+        # A param tree whose paths the rules cannot match (as if from a
+        # model family the rule table doesn't know).
+        foreign = {"encoder": {"w_in": jnp.ones((32, 64), jnp.float32)}}
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            try:
+                generate(
+                    model,
+                    foreign,
+                    jnp.zeros((1, 4), jnp.int32),
+                    1,
+                    quantize=True,
+                )
+            except Exception:
+                pass  # apply fails on the foreign tree; the warning fires first
+        assert any("matched only" in str(w.message) for w in caught)
+
+
+class TestQuantMatmulKTiling:
+    """K is tiled (grid dim 1) with in-place accumulation; shapes no tile
+    divides fall back to the XLA path (round-3 ADVICE: whole-K-in-VMEM)."""
+
+    def _ref_and_out(self, b, k, n, **kw):
+        from distributed_pytorch_tpu.ops.quant_matmul import quant_matmul
+
+        rng = np.random.default_rng(7)
+        x = jnp.asarray(rng.standard_normal((b, k)) * 0.5, jnp.float32)
+        w = (rng.standard_normal((k, n)) * 0.05).astype(np.float32)
+        qt = quantize_int8(jnp.asarray(w), (0,))
+        ref = x @ dequantize(qt, jnp.float32)
+        out = quant_matmul(x, qt, interpret=True, **kw)
+        return np.asarray(ref), np.asarray(out)
+
+    def test_multiple_k_tiles(self):
+        # 384 = 3 x 128: smallest candidate divides, so 3 accumulation steps.
+        ref, out = self._ref_and_out(b=4, k=384, n=512, block_n=128)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_large_k_tile_selection(self):
+        # 2048 divides: single biggest tile; exercises candidate ordering.
+        ref, out = self._ref_and_out(b=2, k=2048, n=128, block_n=128)
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+    def test_fallback_on_unaligned_k(self):
+        from distributed_pytorch_tpu.ops.quant_matmul import quant_matmul
+
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.standard_normal((4, 100)), jnp.float32)
+        w = (rng.standard_normal((100, 128)) * 0.1).astype(np.float32)
+        qt = quantize_int8(jnp.asarray(w), (0,))
+        out = quant_matmul(x, qt, block_n=128)  # 100 has no 128-mult tile
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(x @ dequantize(qt, jnp.float32)),
+            rtol=1e-5,
+        )

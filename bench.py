@@ -525,14 +525,22 @@ def init_backend_with_retry(
     return None, last_err
 
 
-def emit_failure(error: str, detail: str, stage: str) -> None:
-    """One parseable JSON line for the driver — never a bare traceback."""
+def emit_failure(
+    error: str,
+    detail: str,
+    stage: str,
+    metric: str = "resnet50_bf16_train_steps_per_sec",
+    unit: str = "steps/s",
+) -> None:
+    """One parseable JSON line for the driver — never a bare traceback.
+    ``metric`` names the measurement that FAILED so records keyed by metric
+    name don't log a spurious headline failure for e.g. a --scaling run."""
     print(
         json.dumps(
             {
-                "metric": "resnet50_bf16_train_steps_per_sec",
+                "metric": metric,
                 "value": None,
-                "unit": "steps/s",
+                "unit": unit,
                 "vs_baseline": None,
                 "error": error,
                 "stage": stage,
@@ -572,9 +580,18 @@ def main():
         # import is authoritative.
         jax.config.update("jax_platforms", "cpu")
 
+    scaling_metric = "dp_weak_scaling_efficiency"
+    metric, unit = (
+        (scaling_metric, "ratio_vs_1dev") if args.scaling
+        else ("resnet50_bf16_train_steps_per_sec", "steps/s")
+    )
+
     dev, err = init_backend_with_retry()
     if dev is None:
-        emit_failure("backend_unavailable", err or "", stage="init")
+        emit_failure(
+            "backend_unavailable", err or "", stage="init",
+            metric=metric, unit=unit,
+        )
         # A timed-out probe thread may still be parked inside the C++
         # client; don't let interpreter teardown hang on it.
         import sys
@@ -590,7 +607,8 @@ def main():
 
         traceback.print_exc()
         emit_failure(
-            "bench_failed", f"{type(e).__name__}: {e}", stage="measure"
+            "bench_failed", f"{type(e).__name__}: {e}", stage="measure",
+            metric=metric, unit=unit,
         )
 
 

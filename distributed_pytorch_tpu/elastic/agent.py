@@ -29,6 +29,14 @@ that machinery, TPU-native:
   ``--max-restarts``. Training survives because the Trainer's snapshot
   contract (probe-on-init, epoch-offset resume — reference
   ``multigpu_torchrun.py:30-40,57-65``) makes workers idempotent.
+* **Elastic world size** — ``--nnodes MIN:MAX`` (the torchrun elastic form,
+  reference launcher surface ``slurm/sbatch_run.sh:17-23``): when a node is
+  lost for good, the next rendezvous waits ``--scale-down-grace`` seconds
+  for the full world and then re-forms with the >= MIN nodes that joined —
+  dense re-ranks, smaller ``NUM_PROCESSES``, loaders re-shard from the new
+  env on snapshot resume (every sample still visited exactly once per
+  epoch). A node that revives later triggers one restart and scales the
+  world back up.
 
 Single node (``--standalone``) and multi-node (``--nnodes``/``--node-rank``/
 ``--rdzv-endpoint host:port``, the ``sbatch_run.sh:17-23`` shape) use the
@@ -64,6 +72,8 @@ FATAL_KEY = "tpurun/fatal"  # set when restarts are exhausted or world aborts
 DONE_PREFIX = "tpurun/done/"  # done/<gen> counts agents whose workers finished
 ACK_PREFIX = "tpurun/ack/"  # ack/<gen> exit barrier: node 0 keeps the store up until all ack
 JOIN_PREFIX = "tpurun/join/"  # join/<gen> counts agents present at <gen>
+MEMBER_PREFIX = "tpurun/member/"  # member/<gen>/<orig_rank> -> "1" (who joined)
+WORLD_PREFIX = "tpurun/world/"  # world/<gen> -> "0,2,..." settled membership
 HB_PREFIX = "tpurun/hb/"  # hb/<node_rank> -> monotonically increasing beat
 
 
@@ -71,6 +81,16 @@ HB_PREFIX = "tpurun/hb/"  # hb/<node_rank> -> monotonically increasing beat
 class ElasticConfig:
     nproc_per_node: int = 1
     nnodes: int = 1
+    # torchrun's ``--nnodes MIN:MAX`` lower bound: when a node dies for good,
+    # the next rendezvous waits ``scale_down_grace`` seconds for the full
+    # world, then re-forms with every node that DID join (>= min_nnodes) —
+    # dense re-ranked, workers respawned with the smaller NUM_PROCESSES, and
+    # the loader re-sharding from the new env on resume. min_nnodes == nnodes
+    # (the default) disables scale-down: rendezvous insists on a full world.
+    # Node 0 can never be scaled out — it hosts the store (exactly like
+    # torchrun's c10d endpoint host).
+    min_nnodes: int = 0  # 0 -> nnodes (fixed-size world)
+    scale_down_grace: float = 30.0
     node_rank: int = 0
     rdzv_host: str = "127.0.0.1"
     rdzv_port: int = 29400
@@ -95,6 +115,10 @@ class ElasticConfig:
         return self.nnodes * self.nproc_per_node
 
     @property
+    def min_world_nodes(self) -> int:
+        return self.min_nnodes or self.nnodes
+
+    @property
     def coordinator_address(self) -> str:
         port = self.jax_coordinator_port
         if port is None:
@@ -103,9 +127,25 @@ class ElasticConfig:
 
 
 class WorkerGroup:
-    """The local workers of one agent: spawn, poll, terminate."""
+    """The local workers of one agent: spawn, poll, terminate.
 
-    def __init__(self, cfg: ElasticConfig, cmd: List[str], restart_count: int):
+    ``members`` is the settled node membership of this generation (original
+    node ranks, sorted): with scale-down it can be smaller than
+    ``cfg.nnodes``, and the env contract is computed from the DENSE rank of
+    this node within it — workers always see a contiguous, gap-free
+    PROCESS_ID space sized to the live world.
+    """
+
+    def __init__(
+        self,
+        cfg: ElasticConfig,
+        cmd: List[str],
+        restart_count: int,
+        members: Optional[List[int]] = None,
+    ):
+        members = members if members is not None else list(range(cfg.nnodes))
+        world_size = len(members) * cfg.nproc_per_node
+        dense_rank = members.index(cfg.node_rank)
         self.procs: List[subprocess.Popen] = []
         self.hb_dir: Optional[str] = None
         self.hb_files: List[str] = []
@@ -124,8 +164,8 @@ class WorkerGroup:
             env.update(cfg.env)
             env.update(
                 COORDINATOR_ADDRESS=cfg.coordinator_address,
-                NUM_PROCESSES=str(cfg.world_size),
-                PROCESS_ID=str(cfg.node_rank * cfg.nproc_per_node + local_rank),
+                NUM_PROCESSES=str(world_size),
+                PROCESS_ID=str(dense_rank * cfg.nproc_per_node + local_rank),
                 LOCAL_RANK=str(local_rank),
                 TPURUN_RESTART_COUNT=str(restart_count),
             )
@@ -232,8 +272,12 @@ class ElasticAgent:
         if client is not None:
             client.close()
 
-    def _peer_dead(self) -> Optional[int]:
+    def _peer_dead(self, members: Optional[List[int]] = None) -> Optional[int]:
         """Node rank of a peer whose heartbeat went stale, if any.
+
+        Only peers in ``members`` (this generation's settled world) are
+        consulted: after a scale-down, the long-dead node's stale beat must
+        not re-trigger a restart every generation.
 
         Staleness is judged purely on this node's monotonic clock — the beat
         value is an opaque counter, never a timestamp — so cross-host clock
@@ -243,7 +287,8 @@ class ElasticAgent:
         first heartbeat write must still be declared dead, not waited on
         forever."""
         now = time.monotonic()
-        for rank in range(self.cfg.nnodes):
+        ranks = members if members is not None else range(self.cfg.nnodes)
+        for rank in ranks:
             if rank == self.cfg.node_rank:
                 continue
             beat = self.store.get(f"{HB_PREFIX}{rank}")
@@ -254,7 +299,7 @@ class ElasticAgent:
                 return rank
         return None
 
-    def _seed_peer_clocks(self) -> None:
+    def _seed_peer_clocks(self, members: Optional[List[int]] = None) -> None:
         """(Re)start every peer's staleness clock at monitor start.
 
         Each generation grants each peer a fresh ``heartbeat_timeout`` window:
@@ -262,29 +307,87 @@ class ElasticAgent:
         pre-freeze beat value must not count as already-stale (that would
         re-declare a recovered node dead instantly and burn extra restarts)."""
         now = time.monotonic()
-        for rank in range(self.cfg.nnodes):
+        ranks = members if members is not None else range(self.cfg.nnodes)
+        for rank in ranks:
             if rank != self.cfg.node_rank:
                 last_beat = self._peer_beats.get(rank, (None, None))[0]
                 self._peer_beats[rank] = (last_beat, now)
 
     # ------------------------------------------------------------- lifecycle
-    def _rendezvous(self, timeout: float = 600.0) -> int:
-        """Join the current generation and block until all ``nnodes`` agents
-        are present at it. Concurrent failures can bump the generation while
-        we wait (two agents may each bump for the same incident — ADD is
-        atomic, so the world just skips a number); re-join whatever the latest
-        generation is, joining each at most once so counts stay exact."""
+    def _rendezvous(self, timeout: float = 600.0) -> tuple:
+        """Join the current generation and block until the world settles;
+        returns ``(generation, members)`` where ``members`` is the sorted
+        list of original node ranks in this generation's world.
+
+        Concurrent failures can bump the generation while we wait (two
+        agents may each bump for the same incident — ADD is atomic, so the
+        world just skips a number); re-join whatever the latest generation
+        is, joining each at most once so counts stay exact.
+
+        Membership is decided by ONE writer — node 0, which hosts the store
+        and is therefore always present — and published under
+        ``world/<gen>``, so every agent sees the identical member list with
+        no read races. Node 0 publishes the moment all ``nnodes`` agents
+        join; with ``min_nnodes < nnodes`` (torchrun's ``MIN:MAX``) it also
+        publishes after ``scale_down_grace`` seconds once at least
+        ``min_nnodes`` joined — the scale-down path. An agent that joins
+        AFTER the world settled without it (a node revived past the grace
+        window) bumps the generation, forcing a fresh rendezvous that
+        includes it — torchrun's join-triggers-restart, which is also the
+        scale-UP path. While a world is degraded, every later rendezvous
+        pays the grace wait for the missing nodes before re-settling small;
+        keep ``scale_down_grace`` modest.
+        """
+        cfg = self.cfg
         deadline = time.monotonic() + timeout
+        grace_start = time.monotonic()
         while time.monotonic() < deadline:
             generation = int(self.store.get(GEN_KEY) or 0)
             if generation not in self._joined_generations:
+                # Membership mark BEFORE the join count: when the counter
+                # reads n, all n member keys are already visible.
+                self.store.set(f"{MEMBER_PREFIX}{generation}/{cfg.node_rank}", "1")
                 self.store.add(f"{JOIN_PREFIX}{generation}", 1)
                 self._joined_generations.add(generation)
-            joined = self.store.wait_ge(
-                f"{JOIN_PREFIX}{generation}", self.cfg.nnodes, timeout=2.0
-            )
-            if joined is not None and int(self.store.get(GEN_KEY) or 0) == generation:
-                return generation
+                grace_start = time.monotonic()
+            world = self.store.get(f"{WORLD_PREFIX}{generation}")
+            if world is None:
+                joined = self.store.wait_ge(
+                    f"{JOIN_PREFIX}{generation}", cfg.nnodes, timeout=2.0
+                )
+                if int(self.store.get(GEN_KEY) or 0) != generation:
+                    continue  # bumped while waiting: rejoin at the new gen
+                if cfg.node_rank != 0:
+                    continue  # wait for node 0's published decision
+                present = sorted(
+                    r
+                    for r in range(cfg.nnodes)
+                    if self.store.get(f"{MEMBER_PREFIX}{generation}/{r}")
+                )
+                if joined is not None or (
+                    time.monotonic() - grace_start > cfg.scale_down_grace
+                    and len(present) >= cfg.min_world_nodes
+                ):
+                    if joined is None:
+                        print(
+                            f"[tpurun] scale-down: only {len(present)}/"
+                            f"{cfg.nnodes} node(s) joined gen {generation} "
+                            f"within {cfg.scale_down_grace:.0f}s grace; "
+                            f"re-forming with nodes {present}",
+                            flush=True,
+                        )
+                    self.store.set(
+                        f"{WORLD_PREFIX}{generation}",
+                        ",".join(str(r) for r in present),
+                    )
+                continue
+            members = [int(r) for r in world.split(",")]
+            if cfg.node_rank not in members:
+                # The world settled without us (we are a revived latecomer):
+                # force a fresh generation that includes everyone.
+                self.store.add(GEN_KEY, 1)
+                continue
+            return generation, members
         raise RuntimeError(
             f"rendezvous timed out ({self.cfg.nnodes} nodes expected)"
         )
@@ -296,19 +399,22 @@ class ElasticAgent:
         restarts = 0
         try:
             while True:
-                generation = self._rendezvous()
+                generation, members = self._rendezvous()
                 if cfg.node_rank == 0:
                     print(
-                        f"[tpurun] generation {generation}: {cfg.nnodes} node(s) x "
-                        f"{cfg.nproc_per_node} proc(s), world={cfg.world_size}",
+                        f"[tpurun] generation {generation}: {len(members)} "
+                        f"node(s) x {cfg.nproc_per_node} proc(s), "
+                        f"world={len(members) * cfg.nproc_per_node}",
                         flush=True,
                     )
-                group = self._group = WorkerGroup(cfg, self.cmd, restarts)
-                failure = self._monitor(group, generation)
+                group = self._group = WorkerGroup(
+                    cfg, self.cmd, restarts, members=members
+                )
+                failure = self._monitor(group, generation, members)
                 if failure is None:
-                    # Local workers all succeeded; wait for every agent.
+                    # Local workers all succeeded; wait for every live agent.
                     self.store.add(f"{DONE_PREFIX}{generation}", 1)
-                    result = self._await_world_done(generation)
+                    result = self._await_world_done(generation, len(members))
                     if result == "done":
                         # Exit barrier: the store lives on node 0, so node 0
                         # must not tear it down until every agent has seen
@@ -318,7 +424,7 @@ class ElasticAgent:
                             if self.cfg.node_rank == 0:
                                 self.store.wait_ge(
                                     f"{ACK_PREFIX}{generation}",
-                                    self.cfg.nnodes,
+                                    len(members),
                                     timeout=60.0,
                                 )
                         except (ConnectionError, OSError):
@@ -347,7 +453,12 @@ class ElasticAgent:
             self._stop_hb.set()
             self.close()
 
-    def _monitor(self, group: WorkerGroup, generation: int) -> Optional[str]:
+    def _monitor(
+        self,
+        group: WorkerGroup,
+        generation: int,
+        members: Optional[List[int]] = None,
+    ) -> Optional[str]:
         """Poll local workers + the store until success (None) or failure (str).
 
         On local failure, bumps the generation so every other agent restarts
@@ -355,7 +466,8 @@ class ElasticAgent:
         """
         cfg = self.cfg
         last_peer_check = 0.0
-        self._seed_peer_clocks()
+        n_peers = len(members) if members is not None else cfg.nnodes
+        self._seed_peer_clocks(members)
         while True:
             code = group.poll()
             if code is not None:
@@ -369,9 +481,9 @@ class ElasticAgent:
             if self.store.get(FATAL_KEY):
                 return "fatal"
             now = time.monotonic()
-            if cfg.nnodes > 1 and now - last_peer_check > cfg.heartbeat_interval:
+            if n_peers > 1 and now - last_peer_check > cfg.heartbeat_interval:
                 last_peer_check = now
-                dead = self._peer_dead()
+                dead = self._peer_dead(members)
                 if dead is not None:
                     self.store.add(GEN_KEY, 1)
                     return f"node {dead} heartbeat lost"
@@ -382,13 +494,13 @@ class ElasticAgent:
                     return f"local worker {hung} hung (heartbeat file stale)"
             time.sleep(0.2)
 
-    def _await_world_done(self, generation: int) -> str:
-        """After local success: block until all agents report done ('done') or a
-        failure elsewhere bumps the generation ('restart')."""
+    def _await_world_done(self, generation: int, n_members: int) -> str:
+        """After local success: block until all live agents report done
+        ('done') or a failure elsewhere bumps the generation ('restart')."""
         while True:
             try:
                 done = self.store.wait_ge(
-                    f"{DONE_PREFIX}{generation}", self.cfg.nnodes, timeout=1.0
+                    f"{DONE_PREFIX}{generation}", n_members, timeout=1.0
                 )
                 if done is not None:
                     return "done"
@@ -426,8 +538,21 @@ def make_parser() -> argparse.ArgumentParser:
         description="Elastic launcher for distributed_pytorch_tpu (torchrun twin)",
     )
     p.add_argument("--nproc-per-node", type=int, default=1)
-    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument(
+        "--nnodes",
+        default="1",
+        help="node count N, or MIN:MAX (torchrun elastic form): start with "
+        "up to MAX nodes and allow the world to re-form with as few as MIN "
+        "when nodes are lost for good (--scale-down-grace)",
+    )
     p.add_argument("--node-rank", type=int, default=0)
+    p.add_argument(
+        "--scale-down-grace",
+        type=float,
+        default=30.0,
+        help="with --nnodes MIN:MAX, how long each rendezvous waits for the "
+        "full MAX world before settling for the >= MIN nodes that joined",
+    )
     p.add_argument(
         "--rdzv-endpoint",
         default="127.0.0.1:29400",
@@ -486,10 +611,25 @@ def _free_ports(n: int) -> List[int]:
             s.close()
 
 
+def _parse_nnodes(spec: str) -> tuple:
+    """``"4"`` -> (4, 4); ``"1:4"`` -> (1, 4) (torchrun MIN:MAX)."""
+    s = str(spec)
+    if ":" in s:
+        lo, hi = s.split(":", 1)
+        lo, hi = int(lo), int(hi)
+    else:
+        lo = hi = int(s)
+    if not (1 <= lo <= hi):
+        raise ValueError(f"invalid --nnodes {spec!r}: need 1 <= MIN <= MAX")
+    return lo, hi
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = make_parser().parse_args(argv)
+    min_nnodes, max_nnodes = _parse_nnodes(args.nnodes)
+    args.nnodes = max_nnodes
     if args.standalone:
-        args.nnodes, args.node_rank = 1, 0
+        args.nnodes, args.node_rank, min_nnodes = 1, 0, 1
         # The ephemeral store port's neighbor may be in use; pick two distinct
         # free ports rather than gambling on rdzv_port + 1.
         rdzv_port, coord_port = _free_ports(2)
@@ -500,6 +640,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     cfg = ElasticConfig(
         nproc_per_node=args.nproc_per_node,
         nnodes=args.nnodes,
+        min_nnodes=min_nnodes,
+        scale_down_grace=args.scale_down_grace,
         node_rank=args.node_rank,
         rdzv_host=host,
         rdzv_port=port,

@@ -9,7 +9,15 @@ tiered lookup, cheapest first:
 3. the on-disk cache of this machine's own measured sweeps (``~/.cache/...``),
 4. a shipped table measured on real hardware (``DEFAULT_TABLE`` below, keyed
    by device kind), nearest-``T`` entry wins,
-5. the conservative fallback ``(512, 1024)``.
+5. for device kinds with no measured entry, the VMEM-reasoned
+   :func:`analytic_default` (largest legal tile, square-preferred — see its
+   docstring; the old bare ``(512, 1024)`` guess remains only as the
+   last-resort ``_FALLBACK`` when no candidate is legal).
+
+To add a NEW device kind to the shipped table, run
+``tools/flash_autotune_gen.py`` on one host of that kind — it sweeps the
+standard shapes and prints a ready-to-paste ``DEFAULT_TABLE`` entry plus a
+``FLASH_BLOCKS_TABLE`` JSON for immediate pod deployment.
 
 A full *measured sweep* (``autotune()``) compiles and times each legal
 ``(block_q, block_k)`` candidate with value-fetch synchronization and caches
@@ -113,6 +121,30 @@ def candidates(t: int, d: int) -> Iterable[Tuple[int, int]]:
                 yield bq, bk
 
 
+def analytic_default(t: int, d: int) -> Tuple[int, int]:
+    """VMEM-reasoned block choice for device kinds with no measured entry.
+
+    Every TPU generation since v4 carries >=16 MB of VMEM per core, so the
+    same 12 MB working budget as :func:`candidates` is legal everywhere the
+    kernel runs. Among legal candidates, pick the largest tile area (fewer
+    grid steps, longer MXU contractions per program — the direction every
+    measured v5e sweep moved in from T=8192 up), breaking ties toward
+    square blocks ((1024, 1024) won those sweeps over (512, 2048) at equal
+    area). This replaces the old bare ``(512, 1024)`` guess, which pinned
+    pods on unmeasured chips to a tiling the v5e sweep beat by 6-10%.
+    """
+    # Stricter than candidates(): the sweep probes compile-failures at
+    # runtime, but this path must never hand out a tiling that cannot
+    # compile. The backward kernel holds ~3 score-shaped [bq, bk] f32
+    # buffers (S, P, dS), so the measured legality boundary on v5e sits at
+    # area 2^20 — (1024, 2048) fails to lower while every area<=2^20
+    # candidate compiles (BASELINE.md round-2 sweep log).
+    legal = [c for c in candidates(t, d) if c[0] * c[1] <= 1 << 20]
+    if not legal:
+        return _FALLBACK
+    return max(legal, key=lambda c: (c[0] * c[1], min(c)))
+
+
 def lookup(
     t: int,
     d: int,
@@ -141,7 +173,8 @@ def lookup(
         near = min(table, key=lambda k: (abs(k[0] - t), abs(k[1] - d)))
         blocks = table[near]
     else:
-        blocks = _FALLBACK
+        # Unknown chip: reason from VMEM legality instead of guessing.
+        blocks = analytic_default(t, d)
     # Memoize table/fallback hits too: repeat lookups (one per trace) must
     # not re-open the disk cache file.
     _runtime_cache[key] = blocks

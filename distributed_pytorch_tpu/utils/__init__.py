@@ -11,6 +11,7 @@ from distributed_pytorch_tpu.utils.datasets import (
     load_cifar10,
     normalize_images,
     synthetic_cifar10,
+    synthetic_oracle_accuracy,
 )
 from distributed_pytorch_tpu.utils.platform import use_fake_cpu_devices
 
@@ -25,5 +26,6 @@ __all__ = [
     "load_cifar10",
     "normalize_images",
     "synthetic_cifar10",
+    "synthetic_oracle_accuracy",
     "use_fake_cpu_devices",
 ]

@@ -12,11 +12,14 @@ module supplies the data half:
   into ``data_dir`` once; air-gapped rigs ship the ``.npz``.
 * :func:`synthetic_cifar10` — a deterministic, *learnable* 10-class stand-in
   with CIFAR-10's exact shapes/dtypes for machines with no dataset and no
-  network (this CI rig): each class has a fixed random 32x32x3 template,
-  samples are template + Gaussian noise. A real model trains to high accuracy
-  on it, so the full real-data path (normalize -> augment-free train ->
-  exact eval accuracy) is exercised end to end; it is clearly labeled and
-  never silently substituted (``cifar10_or_synthetic`` prints which one ran).
+  network (this CI rig): low-contrast class templates under heavy Gaussian
+  noise, tuned to a KNOWN ~6.5% Bayes error (the nearest-template rule is
+  Bayes-optimal here; :func:`synthetic_oracle_accuracy` computes the
+  ceiling). A model must learn over multiple epochs to approach the oracle,
+  so the rung exercises the full recipe (normalize -> augment -> SGD
+  schedule -> exact eval accuracy), not just shape plumbing; it is clearly
+  labeled and never silently substituted (``cifar10_or_synthetic`` prints
+  which one ran).
 * :func:`normalize_images` — uint8 HWC -> float32 NHWC with per-channel
   standardization (the torchvision ``transforms.Normalize`` twin).
 """
@@ -99,19 +102,48 @@ def load_cifar10(data_dir: str = "data") -> Arrays:
     )
 
 
-def synthetic_cifar10(
-    n_train: int = 50000, n_test: int = 10000, seed: int = 0, noise: float = 0.35
-) -> Arrays:
-    """Deterministic learnable 10-class dataset with CIFAR-10 shapes/dtypes.
-
-    Class ``c``'s images are ``template_c + noise`` (templates drawn once from
-    ``U[0,255]``, noise ~ N(0, noise*128)), clipped back to uint8. At the
-    default noise the Bayes error is near zero but single pixels are
-    uninformative, so a model must actually learn the templates — accuracy is
-    a meaningful end-to-end signal, while no real-data claim is implied.
-    """
+def _synthetic_templates(
+    seed: int, contrast: float
+) -> np.ndarray:
+    """The 10 class templates: mid-gray plus a +-``contrast`` gray-level
+    pattern. Deterministic per seed; shared by the generator and the
+    Bayes-oracle classifier."""
     rng = np.random.default_rng(seed)
-    templates = rng.uniform(0, 255, size=(10, 32, 32, 3)).astype(np.float32)
+    patterns = rng.standard_normal((10, 32, 32, 3)).astype(np.float32)
+    return 128.0 + contrast * patterns
+
+
+def synthetic_cifar10(
+    n_train: int = 50000,
+    n_test: int = 10000,
+    seed: int = 0,
+    noise: float = 0.35,
+    contrast: float = 2.6,
+) -> Arrays:
+    """Deterministic learnable 10-class dataset with CIFAR-10 shapes/dtypes
+    and a KNOWN, non-trivial Bayes error.
+
+    Class ``c``'s images are ``template_c + noise``: templates sit at
+    mid-gray +- a ``contrast``-gray-level pattern (~2.6 levels by default)
+    under per-pixel Gaussian noise of sigma ``noise*128`` (~45 levels), so
+    the per-pixel SNR is ~0.06 — single pixels carry almost nothing and a
+    classifier must pool evidence across all 3072. Because the generative
+    model is an isotropic Gaussian mixture, the nearest-template rule is
+    Bayes-optimal; at the defaults its measured accuracy is ~93.5%
+    (:func:`synthetic_oracle_accuracy`), i.e. ~6.5% Bayes error. That makes
+    this rung test *learning*, not shape-compatibility: a model cannot hit
+    the ceiling by memorizing gross pixel values in epoch 1 (the round-3
+    stand-in's failure mode — full-contrast templates reached accuracy
+    1.0000 immediately), and its eval accuracy converging into the oracle
+    band over epochs is a meaningful end-to-end recipe signal. No real-data
+    claim is implied. Clipping DOES touch the noise tails (+-3 sigma is
+    ~134 levels around mid-gray, so roughly 0.1-0.3% of pixels rail per
+    tail) and uint8 rounding quantizes the rest; both effects are
+    empirically negligible — nearest-template is exactly Bayes-optimal only
+    for the unclipped mixture, and the quoted ~93.5% oracle accuracy is the
+    MEASURED value on the clipped data, not a Gaussian-theory number.
+    """
+    templates = _synthetic_templates(seed, contrast)
 
     def split(n, seed_offset):
         r = np.random.default_rng([seed, seed_offset])
@@ -122,6 +154,30 @@ def synthetic_cifar10(
     x_train, y_train = split(n_train, 1)
     x_test, y_test = split(n_test, 2)
     return x_train, y_train, x_test, y_test
+
+
+def synthetic_oracle_accuracy(
+    x: np.ndarray,
+    y: np.ndarray,
+    seed: int = 0,
+    contrast: float = 2.6,
+    batch: int = 2048,
+) -> float:
+    """Accuracy of the Bayes-optimal (nearest-template) classifier on
+    synthetic data produced by :func:`synthetic_cifar10` with the same
+    ``seed``/``contrast`` — the ceiling any trained model is converging
+    toward. Computed in batches so 50k images stay cheap."""
+    templates = _synthetic_templates(seed, contrast).reshape(10, -1)
+    correct = 0
+    for i in range(0, len(x), batch):
+        xb = x[i : i + batch].astype(np.float32).reshape(-1, templates.shape[1])
+        d = (
+            (xb**2).sum(1, keepdims=True)
+            - 2.0 * xb @ templates.T
+            + (templates**2).sum(1)[None, :]
+        )
+        correct += int((d.argmin(1) == y[i : i + batch]).sum())
+    return correct / len(x)
 
 
 def cifar10_or_synthetic(data_dir: str = "data", **synth_kw):

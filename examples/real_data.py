@@ -42,6 +42,17 @@ def main(args):
         arrays = tuple(a[: n] for a, n in zip(
             arrays, (args.subset, args.subset, n_test, n_test)
         ))
+    oracle = None
+    if not is_real:
+        # The stand-in's Bayes ceiling (~0.935 at defaults): the number the
+        # eval-accuracy curve should converge toward over epochs — printed so
+        # the curve is interpretable, not just "went up".
+        from distributed_pytorch_tpu.utils.datasets import (
+            synthetic_oracle_accuracy,
+        )
+
+        oracle = synthetic_oracle_accuracy(arrays[2], arrays[3])
+        print(f"[datasets] synthetic Bayes-oracle accuracy: {oracle:.4f}")
     train_ds, test_ds = as_datasets(arrays)
     if args.augment:
         # Standard CIFAR recipe (pad-4 random crop + flip) — what a sane
@@ -79,10 +90,13 @@ def main(args):
         trainer._run_epoch(epoch)
         trainer.epochs_run = epoch + 1
         metrics = trainer.evaluate(eval_loader, metric_fns=metric_fns)
+        tag = "real CIFAR-10" if is_real else (
+            f"synthetic stand-in, oracle {oracle:.4f}"
+        )
         print(
             f"epoch {epoch}: eval_loss={metrics.get('loss', float('nan')):.4f} "
             f"eval_accuracy={metrics.get('accuracy', float('nan')):.4f} "
-            f"({'real CIFAR-10' if is_real else 'synthetic stand-in'})",
+            f"({tag})",
             flush=True,
         )
     return metrics

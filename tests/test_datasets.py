@@ -79,20 +79,70 @@ class TestSyntheticCifar10:
         assert x_train.shape == (64, 32, 32, 3)
         assert set(np.unique(y_train)) <= set(range(10))
 
-    def test_classes_are_separable(self):
-        """A nearest-template classifier must solve it — the stand-in's whole
-        point is that accuracy is a meaningful end-to-end signal."""
-        x_train, y_train, x_test, y_test = synthetic_cifar10(
-            n_train=500, n_test=100
+    def test_oracle_accuracy_in_design_band(self):
+        """The Bayes-optimal (true nearest-template) classifier lands in the
+        designed ~5-10%-error band: the stand-in is hard enough to test
+        learning but solvable enough that accuracy is a real signal."""
+        from distributed_pytorch_tpu.utils.datasets import (
+            synthetic_oracle_accuracy,
         )
-        means = np.stack(
-            [x_train[y_train == c].mean(axis=0) for c in range(10)]
-        )
-        d = ((x_test.astype(np.float32)[:, None] - means[None]) ** 2).sum(
-            axis=(2, 3, 4)
-        )
-        accuracy = (d.argmin(axis=1) == y_test).mean()
-        assert accuracy > 0.95
+
+        _, _, x_test, y_test = synthetic_cifar10(n_train=1, n_test=2000)
+        oracle = synthetic_oracle_accuracy(x_test, y_test)
+        assert 0.90 <= oracle <= 0.96, oracle
+
+    def test_learning_takes_multiple_epochs(self):
+        """The round-3 stand-in hit accuracy 1.0 in epoch 1, proving only
+        plumbing. Here a linear learner (nearest-template is linear, so it
+        can solve the task) must IMPROVE over epochs and end well above
+        chance but below the oracle — i.e. the rung now measures learning
+        dynamics, not shape compatibility."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from distributed_pytorch_tpu.utils.datasets import normalize_images
+
+        x_tr, y_tr, x_te, y_te = synthetic_cifar10(n_train=4000, n_test=1000)
+        xt = normalize_images(x_tr).reshape(len(x_tr), -1)
+        xe = jnp.asarray(normalize_images(x_te).reshape(len(x_te), -1))
+        ye = jnp.asarray(y_te)
+
+        opt = optax.sgd(2e-3, momentum=0.9)
+        params = (jnp.zeros((3072, 10)), jnp.zeros((10,)))
+        opt_state = opt.init(params)
+
+        def loss_fn(p, x, y):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                x @ p[0] + p[1], y
+            ).mean()
+
+        @jax.jit
+        def step(p, s, x, y):
+            grads = jax.grad(loss_fn)(p, x, y)
+            updates, s = opt.update(grads, s, p)
+            return optax.apply_updates(p, updates), s
+
+        rng = np.random.default_rng(0)
+        accs = []
+        for _ in range(6):
+            order = rng.permutation(len(xt))
+            for i in range(0, len(xt), 128):
+                idx = order[i : i + 128]
+                params, opt_state = step(
+                    params, opt_state, jnp.asarray(xt[idx]),
+                    jnp.asarray(y_tr[idx]),
+                )
+            logits = xe @ params[0] + params[1]
+            accs.append(float((jnp.argmax(logits, 1) == ye).mean()))
+        # Epoch 1 must NOT already be at the ceiling...
+        assert accs[0] < 0.75, accs
+        # ...later epochs keep improving into the band (above chance=0.1,
+        # below the ~0.92 oracle; 4k samples cap a linear learner ~0.78)...
+        best_late = max(accs[3:])
+        assert 0.75 <= best_late <= 0.88, accs
+        # ...and the multi-epoch gain is real, not noise.
+        assert best_late - accs[0] >= 0.03, accs
 
 
 class TestNormalize:

@@ -301,6 +301,145 @@ class TestElasticAgent:
         assert (tmp_path / "n.3").read_text() == "1"
 
 
+def test_parse_nnodes_forms():
+    from distributed_pytorch_tpu.elastic.agent import _parse_nnodes
+
+    assert _parse_nnodes("4") == (4, 4)
+    assert _parse_nnodes("1:4") == (1, 4)
+    assert _parse_nnodes(2) == (2, 2)
+    for bad in ("0:2", "3:2", "0"):
+        with pytest.raises(ValueError):
+            _parse_nnodes(bad)
+
+
+class TestScaleDown:
+    """--nnodes MIN:MAX (torchrun elastic form): a 2-agent world loses one
+    node PERMANENTLY; the survivor's next rendezvous waits the scale-down
+    grace, re-forms the world at size 1, and training completes with every
+    sample still covered exactly once per completed epoch (the loader
+    re-shards from the new NUM_PROCESSES)."""
+
+    WORKER = """
+    import json, os, sys, time
+
+    pid = int(os.environ["PROCESS_ID"])
+    W = int(os.environ["NUM_PROCESSES"])
+    N, EPOCHS = 16, 3
+
+    start = 0
+    if os.path.exists("state.json"):
+        start = json.load(open("state.json"))["epochs_done"]
+
+    for epoch in range(start, EPOCHS):
+        open(f"start.{epoch}.{pid}.w{W}", "w").write("")
+        time.sleep(1.5)  # the kill window: mid-epoch work
+        idx = list(range(pid, N, W))  # DistributedSampler-style stride shard
+        with open(f"cov.{epoch}.{pid}.w{W}", "w") as f:
+            json.dump(idx, f)
+        # Filesystem stand-in for the end-of-epoch collective: an epoch only
+        # counts as done when EVERY rank of this world contributed — exactly
+        # like a real SPMD step, which cannot complete on a half-dead world.
+        deadline = time.time() + 60
+        while not all(
+            os.path.exists(f"cov.{epoch}.{r}.w{W}") for r in range(W)
+        ):
+            if time.time() > deadline:
+                sys.exit(9)
+            time.sleep(0.1)
+        if pid == 0:
+            open(f"done.{epoch}.w{W}", "w").write("")
+            with open("state.json.tmp", "w") as f:
+                json.dump({"epochs_done": epoch + 1}, f)
+            os.replace("state.json.tmp", "state.json")
+        time.sleep(0.2)  # barrier slack before the next epoch
+    """
+
+    def test_world_reforms_smaller_with_full_coverage(self, tmp_path):
+        import json
+
+        port = free_port()
+        worker = tmp_path / "worker.py"
+        worker.write_text(textwrap.dedent(self.WORKER))
+        env = dict(os.environ, PYTHONPATH=REPO)
+
+        def launch(node_rank):
+            return subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "distributed_pytorch_tpu.elastic",
+                    "--nnodes",
+                    "1:2",
+                    "--node-rank",
+                    str(node_rank),
+                    "--nproc-per-node",
+                    "1",
+                    "--rdzv-endpoint",
+                    f"127.0.0.1:{port}",
+                    "--heartbeat-interval",
+                    "0.5",
+                    "--heartbeat-timeout",
+                    "4",
+                    "--scale-down-grace",
+                    "4",
+                    "--max-restarts",
+                    "2",
+                    str(worker),
+                ],
+                env=env,
+                cwd=tmp_path,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                start_new_session=True,  # killpg must reap agent AND worker
+            )
+
+        agent0 = launch(0)
+        agent1 = launch(1)
+        try:
+            # Wait until node 1's worker is INSIDE epoch 1, then kill its
+            # whole process group — agent and worker die for good.
+            deadline = time.time() + 90
+            while not (tmp_path / "start.1.1.w2").exists():
+                assert time.time() < deadline, "epoch 1 never started"
+                assert agent0.poll() is None, agent0.communicate()[1]
+                time.sleep(0.1)
+            os.killpg(os.getpgid(agent1.pid), signal.SIGKILL)
+
+            out, err = agent0.communicate(timeout=120)
+            assert agent0.returncode == 0, out + err
+            assert "scale-down" in out, out
+        finally:
+            for a in (agent0, agent1):
+                try:
+                    os.killpg(os.getpgid(a.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+        # Every completed epoch covers all 16 samples exactly once, under
+        # whichever world size completed it.
+        full = set(range(16))
+        done = sorted(p.name for p in tmp_path.glob("done.*"))
+        completed = {}
+        for name in done:
+            _, epoch, w = name.split(".")
+            completed[int(epoch)] = int(w[1:])
+        assert sorted(completed) == [0, 1, 2], done
+        for epoch, w in completed.items():
+            cov = []
+            for r in range(w):
+                cov.extend(
+                    json.load(open(tmp_path / f"cov.{epoch}.{r}.w{w}"))
+                )
+            assert sorted(cov) == sorted(full), (epoch, w, cov)
+            assert len(cov) == len(set(cov)), (epoch, cov)
+        # The kill landed mid-epoch-1, so epochs 1 and 2 must have been
+        # completed by the re-formed single-node world.
+        assert completed[2] == 1, completed
+        assert completed[1] == 1, completed
+        assert completed[0] == 2, completed
+
+
 # ------------------------------------------------- live-JAX fault injection
 
 

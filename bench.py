@@ -627,10 +627,13 @@ def run_benches(args, dev, peak):
         print(
             json.dumps(
                 {
-                    "metric": f"dp_weak_scaling_efficiency_{last['n_devices']}dev",
+                    # Same metric name as the failure path emits, so a
+                    # driver keying records by metric associates both.
+                    "metric": "dp_weak_scaling_efficiency",
                     "value": last["per_chip_efficiency"],
                     "unit": "ratio_vs_1dev",
                     "vs_baseline": last["per_chip_efficiency"],
+                    "n_devices": last["n_devices"],
                     "awaiting_hardware": scaling["awaiting_hardware"],
                 }
             )

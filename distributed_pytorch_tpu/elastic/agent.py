@@ -111,7 +111,10 @@ class ElasticConfig:
     env: Dict[str, str] = field(default_factory=dict)
 
     @property
-    def world_size(self) -> int:
+    def max_world_size(self) -> int:
+        """Worker count of a FULL world. With ``--nnodes MIN:MAX`` the live
+        world can be smaller — the per-generation size is always
+        ``len(members) * nproc_per_node`` (see :class:`WorkerGroup`)."""
         return self.nnodes * self.nproc_per_node
 
     @property
@@ -233,6 +236,21 @@ class WorkerGroup:
             self.hb_dir = None
 
 
+class _Retry(Exception):
+    """Internal: loop the rendezvous again; carries the grace clock, which
+    resets when a NEW generation was joined during the pass."""
+
+    def __init__(self, grace_start: float):
+        self.grace_start = grace_start
+
+
+class WorldCompleted(Exception):
+    """The rendezvous store vanished mid-rendezvous: the store lives on node
+    0's agent, which tears it down only when the world finished — so a node
+    still trying to (re)join (e.g. one revived after a scale-down) should
+    exit cleanly, not crash with a ConnectionError."""
+
+
 class ElasticAgent:
     """One per node. Runs the rendezvous/spawn/monitor/restart loop."""
 
@@ -342,55 +360,68 @@ class ElasticAgent:
         deadline = time.monotonic() + timeout
         grace_start = time.monotonic()
         while time.monotonic() < deadline:
-            generation = int(self.store.get(GEN_KEY) or 0)
-            if generation not in self._joined_generations:
-                # Membership mark BEFORE the join count: when the counter
-                # reads n, all n member keys are already visible.
-                self.store.set(f"{MEMBER_PREFIX}{generation}/{cfg.node_rank}", "1")
-                self.store.add(f"{JOIN_PREFIX}{generation}", 1)
-                self._joined_generations.add(generation)
-                grace_start = time.monotonic()
-            world = self.store.get(f"{WORLD_PREFIX}{generation}")
-            if world is None:
-                joined = self.store.wait_ge(
-                    f"{JOIN_PREFIX}{generation}", cfg.nnodes, timeout=2.0
-                )
-                if int(self.store.get(GEN_KEY) or 0) != generation:
-                    continue  # bumped while waiting: rejoin at the new gen
-                if cfg.node_rank != 0:
-                    continue  # wait for node 0's published decision
-                present = sorted(
-                    r
-                    for r in range(cfg.nnodes)
-                    if self.store.get(f"{MEMBER_PREFIX}{generation}/{r}")
-                )
-                if joined is not None or (
-                    time.monotonic() - grace_start > cfg.scale_down_grace
-                    and len(present) >= cfg.min_world_nodes
-                ):
-                    if joined is None:
-                        print(
-                            f"[tpurun] scale-down: only {len(present)}/"
-                            f"{cfg.nnodes} node(s) joined gen {generation} "
-                            f"within {cfg.scale_down_grace:.0f}s grace; "
-                            f"re-forming with nodes {present}",
-                            flush=True,
-                        )
-                    self.store.set(
-                        f"{WORLD_PREFIX}{generation}",
-                        ",".join(str(r) for r in present),
-                    )
-                continue
-            members = [int(r) for r in world.split(",")]
-            if cfg.node_rank not in members:
-                # The world settled without us (we are a revived latecomer):
-                # force a fresh generation that includes everyone.
-                self.store.add(GEN_KEY, 1)
-                continue
-            return generation, members
+            try:
+                return self._rendezvous_once(cfg, grace_start)
+            except _Retry as r:
+                grace_start = r.grace_start
+            except (ConnectionError, OSError):
+                # Store gone: node 0's agent tears it down only after the
+                # world completed. A node still (re)joining — e.g. revived
+                # after a scale-down — should exit cleanly, not crash.
+                raise WorldCompleted() from None
         raise RuntimeError(
             f"rendezvous timed out ({self.cfg.nnodes} nodes expected)"
         )
+
+    def _rendezvous_once(self, cfg, grace_start):
+        """One pass of the join/settle/read protocol; raises ``_Retry`` to
+        loop (carrying the possibly-reset grace clock)."""
+        generation = int(self.store.get(GEN_KEY) or 0)
+        if generation not in self._joined_generations:
+            # Membership mark BEFORE the join count: when the counter
+            # reads n, all n member keys are already visible.
+            self.store.set(f"{MEMBER_PREFIX}{generation}/{cfg.node_rank}", "1")
+            self.store.add(f"{JOIN_PREFIX}{generation}", 1)
+            self._joined_generations.add(generation)
+            grace_start = time.monotonic()
+        world = self.store.get(f"{WORLD_PREFIX}{generation}")
+        if world is None:
+            joined = self.store.wait_ge(
+                f"{JOIN_PREFIX}{generation}", cfg.nnodes, timeout=2.0
+            )
+            if int(self.store.get(GEN_KEY) or 0) != generation:
+                raise _Retry(grace_start)  # bumped: rejoin at the new gen
+            if cfg.node_rank != 0:
+                raise _Retry(grace_start)  # await node 0's decision
+            present = sorted(
+                r
+                for r in range(cfg.nnodes)
+                if self.store.get(f"{MEMBER_PREFIX}{generation}/{r}")
+            )
+            if joined is not None or (
+                time.monotonic() - grace_start > cfg.scale_down_grace
+                and len(present) >= cfg.min_world_nodes
+            ):
+                if joined is None:
+                    print(
+                        f"[tpurun] scale-down: only {len(present)}/"
+                        f"{cfg.nnodes} node(s) joined gen {generation} "
+                        f"within {cfg.scale_down_grace:.0f}s grace; "
+                        f"re-forming with nodes {present}",
+                        flush=True,
+                    )
+                self.store.set(
+                    f"{WORLD_PREFIX}{generation}",
+                    ",".join(str(r) for r in present),
+                )
+            raise _Retry(grace_start)
+        members = [int(r) for r in world.split(",")]
+        if cfg.node_rank not in members:
+            # The world settled without us (we are a revived latecomer):
+            # force a fresh generation that includes everyone.
+            self.store.add(GEN_KEY, 1)
+            raise _Retry(grace_start)
+        return generation, members
 
     def run(self) -> int:
         cfg = self.cfg
@@ -399,7 +430,15 @@ class ElasticAgent:
         restarts = 0
         try:
             while True:
-                generation, members = self._rendezvous()
+                try:
+                    generation, members = self._rendezvous()
+                except WorldCompleted:
+                    print(
+                        "[tpurun] rendezvous store gone — the world "
+                        "completed without this (revived) node; exiting",
+                        flush=True,
+                    )
+                    return 0
                 if cfg.node_rank == 0:
                     print(
                         f"[tpurun] generation {generation}: {len(members)} "

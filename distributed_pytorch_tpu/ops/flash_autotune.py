@@ -199,9 +199,18 @@ def autotune(
     causal: bool = True,
     steps: int = 5,
     verbose: bool = False,
+    force: bool = False,
 ) -> Tuple[int, int]:
     """Measured sweep: times causal fwd+bwd for every legal candidate and
-    caches the winner (in-process + on disk). Returns (block_q, block_k)."""
+    caches the winner (in-process + on disk). Returns (block_q, block_k).
+
+    ``force=True`` skips the cache READS (still writes) — the table
+    generator uses it so a re-run after a compiler upgrade (or with a
+    different ``bh``, which the cache key deliberately omits) re-measures
+    instead of replaying stale winners. A sweep in which EVERY candidate
+    fails to compile returns the legacy fallback but does NOT cache it:
+    an unmeasured guess must never masquerade as a measured winner.
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -212,12 +221,13 @@ def autotune(
     dtype_name = jnp.dtype(dtype).name
     device_kind = _device_kind()
     key = _key(device_kind, t, d, dtype_name, causal)
-    if key in _runtime_cache:
-        return _runtime_cache[key]
-    disk = _load_disk_cache()
-    if key in disk:  # a previous process already swept this shape
-        _runtime_cache[key] = disk[key]
-        return disk[key]
+    if not force:
+        if key in _runtime_cache:
+            return _runtime_cache[key]
+        disk = _load_disk_cache()
+        if key in disk:  # a previous process already swept this shape
+            _runtime_cache[key] = disk[key]
+            return disk[key]
 
     rng = np.random.default_rng(0)
     q, k, v = (
@@ -250,6 +260,18 @@ def autotune(
             print(f"  ({bq:5d},{bk:5d}): {dt * 1e3:8.2f} ms")
         if dt < best_dt:
             best, best_dt = (bq, bk), dt
+    if best_dt == float("inf"):
+        # Nothing compiled: report the uncached fallback so callers (and the
+        # table generator, which checks the disk cache to tell measured from
+        # guessed) can see this shape was NOT measured.
+        import warnings
+
+        warnings.warn(
+            f"flash autotune: no (block_q, block_k) candidate compiled for "
+            f"T={t} d={d} on {device_kind!r}; returning uncached fallback "
+            f"{_FALLBACK}"
+        )
+        return _FALLBACK
     _runtime_cache[key] = best
     disk = _load_disk_cache()
     disk[key] = best
@@ -262,32 +284,71 @@ def autotune_enabled() -> bool:
     return os.environ.get("FLASH_AUTOTUNE", "") not in ("", "0")
 
 
-def main() -> None:
-    """Sweep representative shapes on the current device and print a table."""
+def main(argv=None) -> None:
+    """Sweep representative shapes on the current device; print a
+    ready-to-paste ``DEFAULT_TABLE`` entry and optionally export a
+    ``FLASH_BLOCKS_TABLE`` JSON. ``tools/flash_autotune_gen.py`` is the
+    documented alias of this entry point — one implementation, two names.
+
+    Only MEASURED winners are emitted: ``--force`` re-sweeps past any
+    cached entry, and a shape where every candidate failed to compile is
+    reported and EXCLUDED from both outputs (measured-ness is checked
+    against the disk cache, which a failed sweep never writes)."""
     import argparse
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--seq_lens", default="2048,8192,16384")
     parser.add_argument("--head_dims", default="64,128")
-    parser.add_argument("--bh", default=16, type=int)
+    parser.add_argument("--bh", default=16, type=int, help="batch*heads")
     parser.add_argument(
         "--export", default="",
         help="write the swept entries to this JSON (ship to pod hosts via "
         "FLASH_BLOCKS_TABLE so every host picks identical blocks)",
     )
-    args = parser.parse_args()
+    parser.add_argument(
+        "--force", action="store_true",
+        help="re-measure even when a cached winner exists (use after a "
+        "compiler/runtime upgrade or with a different --bh)",
+    )
+    args = parser.parse_args(argv)
     kind = _device_kind()
+    if kind == "unknown":
+        raise SystemExit("no JAX backend reachable — run on the target device")
     print(f"device: {kind}")
-    swept = {}
+    entries = {}  # (t, d) -> measured blocks
+    shipped = {}  # full key -> blocks, for --export
+    failed = []
     for t in (int(x) for x in args.seq_lens.split(",")):
         for d in (int(x) for x in args.head_dims.split(",")):
-            blocks = autotune(t, d, bh=args.bh, verbose=True)
-            print(f"T={t:6d} d={d:4d} -> {blocks}")
-            swept[_key(kind, t, d, "bfloat16", True)] = blocks
+            blocks = autotune(t, d, bh=args.bh, verbose=True, force=args.force)
+            key = _key(kind, t, d, "bfloat16", True)
+            if key not in _load_disk_cache():
+                print(f"T={t:6d} d={d:4d} -> MEASUREMENT FAILED (excluded)")
+                failed.append((t, d))
+                continue
+            analytic = analytic_default(t, d)
+            marker = "  (= analytic default)" if blocks == analytic else ""
+            print(f"T={t:6d} d={d:4d} -> {blocks}{marker}")
+            entries[(t, d)] = blocks
+            shipped[key] = blocks
+
+    if entries:
+        print("\n# Paste into ops/flash_autotune.py DEFAULT_TABLE:")
+        print(f'    "{kind.lower()}": {{')
+        for (t, d), (bq, bk) in sorted(entries.items()):
+            print(f"        ({t}, {d}): ({bq}, {bk}),")
+        print("    },")
+    if failed:
+        print(f"\n# NOT measured (every candidate failed to compile): {failed}")
     if args.export:
         with open(args.export, "w") as f:
-            json.dump({json.dumps(list(k)): list(v) for k, v in swept.items()}, f)
-        print(f"exported {len(swept)} entries to {args.export}")
+            json.dump(
+                {json.dumps(list(k)): list(v) for k, v in shipped.items()}, f
+            )
+        print(
+            f"exported {len(shipped)} measured entries to {args.export} — "
+            "deploy with FLASH_BLOCKS_TABLE=<path> on every pod host"
+        )
 
 
 if __name__ == "__main__":

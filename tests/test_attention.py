@@ -39,6 +39,7 @@ def test_ring_degenerates_on_trivial_axis():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_gradients_match_dense():
     """d(loss)/d(q,k,v) must agree with dense attention — the backward pass is
     what training actually exercises."""
@@ -101,6 +102,7 @@ def test_ring_flash_hops_match_dense(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_flash_gradients_match_dense():
     mesh = make_mesh({"sequence": 4}, devices=jax.devices()[:4])
     q, k, v = _qkv(b=2, t=64, h=2, d=16)

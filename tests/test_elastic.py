@@ -189,6 +189,7 @@ class TestElasticAgent:
         names = {p.name for p in tmp_path.glob("done.*")}
         assert {"done.0.1", "done.1.1"} <= names
 
+    @pytest.mark.slow
     def test_hung_worker_detected_via_heartbeat_file(self, tmp_path):
         """A worker that stays ALIVE but stops making progress (wedged in a
         collective, SIGSTOPped, deadlocked) is invisible to exit-code polling;
@@ -247,6 +248,7 @@ class TestElasticAgent:
         assert result.returncode == 1
         assert "giving up" in result.stderr
 
+    @pytest.mark.slow
     def test_two_node_rendezvous(self, tmp_path):
         """Two agents on one machine = the sbatch_run.sh multinode shape."""
         port = free_port()
@@ -312,6 +314,7 @@ def test_parse_nnodes_forms():
             _parse_nnodes(bad)
 
 
+@pytest.mark.slow
 class TestScaleDown:
     """--nnodes MIN:MAX (torchrun elastic form): a 2-agent world loses one
     node PERMANENTLY; the survivor's next rendezvous waits the scale-down
@@ -438,6 +441,131 @@ class TestScaleDown:
         assert completed[2] == 1, completed
         assert completed[1] == 1, completed
         assert completed[0] == 2, completed
+
+
+@pytest.mark.slow
+class TestScaleUp:
+    """The reverse path: a node that revives AFTER the world scaled down
+    joins the store, finds itself excluded from the settled membership,
+    bumps the generation, and the world re-forms at full size."""
+
+    WORKER = """
+    import json, os, sys, time
+
+    pid = int(os.environ["PROCESS_ID"])
+    W = int(os.environ["NUM_PROCESSES"])
+    N, EPOCHS = 16, 8
+
+    start = 0
+    if os.path.exists("state.json"):
+        start = json.load(open("state.json"))["epochs_done"]
+
+    for epoch in range(start, EPOCHS):
+        open(f"start.{epoch}.{pid}.w{W}", "w").write("")
+        time.sleep(1.0)
+        idx = list(range(pid, N, W))
+        with open(f"cov.{epoch}.{pid}.w{W}", "w") as f:
+            json.dump(idx, f)
+        deadline = time.time() + 60
+        while not all(
+            os.path.exists(f"cov.{epoch}.{r}.w{W}") for r in range(W)
+        ):
+            if time.time() > deadline:
+                sys.exit(9)
+            time.sleep(0.1)
+        if pid == 0:
+            open(f"done.{epoch}.w{W}", "w").write("")
+            with open("state.json.tmp", "w") as f:
+                json.dump({"epochs_done": epoch + 1}, f)
+            os.replace("state.json.tmp", "state.json")
+        time.sleep(0.2)
+    """
+
+    def test_revived_node_rejoins_and_world_regrows(self, tmp_path):
+        port = free_port()
+        worker = tmp_path / "worker.py"
+        worker.write_text(textwrap.dedent(self.WORKER))
+        env = dict(os.environ, PYTHONPATH=REPO)
+
+        def launch(node_rank):
+            return subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "distributed_pytorch_tpu.elastic",
+                    "--nnodes",
+                    "1:2",
+                    "--node-rank",
+                    str(node_rank),
+                    "--nproc-per-node",
+                    "1",
+                    "--rdzv-endpoint",
+                    f"127.0.0.1:{port}",
+                    "--heartbeat-interval",
+                    "0.5",
+                    "--heartbeat-timeout",
+                    "3",
+                    "--scale-down-grace",
+                    "3",
+                    "--max-restarts",
+                    "4",
+                    str(worker),
+                ],
+                env=env,
+                cwd=tmp_path,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                start_new_session=True,
+            )
+
+        agent0 = launch(0)
+        agent1 = launch(1)
+        agent1b = None
+        try:
+            deadline = time.time() + 90
+            while not (tmp_path / "start.1.1.w2").exists():
+                assert time.time() < deadline, "epoch 1 never started"
+                time.sleep(0.1)
+            os.killpg(os.getpgid(agent1.pid), signal.SIGKILL)
+
+            # Wait for the scaled-down world to actually complete an epoch
+            # (proof the world is running at w1), then revive node 1.
+            deadline = time.time() + 90
+            while not list(tmp_path.glob("done.*.w1")):
+                assert time.time() < deadline, "never scaled down to w1"
+                assert agent0.poll() is None, agent0.communicate()[1]
+                time.sleep(0.1)
+            agent1b = launch(1)
+
+            # The revived agent must force a regrow: some LATER epoch
+            # completes at w2 again.
+            deadline = time.time() + 90
+            while True:
+                w1_done = {
+                    int(p.name.split(".")[1])
+                    for p in tmp_path.glob("done.*.w1")
+                }
+                w2_done = {
+                    int(p.name.split(".")[1])
+                    for p in tmp_path.glob("done.*.w2")
+                }
+                if w1_done and w2_done and max(w2_done) > min(w1_done):
+                    break
+                assert time.time() < deadline, (w1_done, w2_done)
+                assert agent0.poll() is None, agent0.communicate()[1]
+                time.sleep(0.2)
+
+            out, err = agent0.communicate(timeout=120)
+            assert agent0.returncode == 0, out + err
+        finally:
+            for a in (agent0, agent1, agent1b):
+                if a is None:
+                    continue
+                try:
+                    os.killpg(os.getpgid(a.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
 
 
 # ------------------------------------------------- live-JAX fault injection

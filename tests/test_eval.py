@@ -40,6 +40,7 @@ def test_evaluate_does_not_mutate_state():
     assert int(trainer.state.step) == step_before
 
 
+@pytest.mark.slow
 def test_evaluate_batchnorm_uses_running_stats():
     """ResNet eval must run with use_running_average=True: identical inputs in
     different batch compositions give identical per-sample outputs (train-mode
@@ -72,6 +73,7 @@ def test_evaluate_batchnorm_uses_running_stats():
     np.testing.assert_allclose(np.asarray(full), halves, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_evaluate_includes_moe_aux_loss():
     """Eval loss must include sown penalty terms, matching the train-step
     loss definition (frozen params + same batch => identical numbers)."""
@@ -200,6 +202,7 @@ class TestExactEval:
         real = np.concatenate([i[w > 0] for i, w in per_shard])
         assert sorted(real.tolist()) == list(range(41))
 
+    @pytest.mark.slow
     def test_accuracy_metric(self):
         """metric_fns adds exact per-sample accuracy; returns a dict."""
         import numpy as np

@@ -138,6 +138,7 @@ def test_lm_fused_head_param_tree_identical():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_lm_fused_head_loss_and_grads_match_dense():
     sd, sf, step_d, step_f, batch = _lm_pair()
     for _ in range(3):  # a few optimizer steps: grads must match too

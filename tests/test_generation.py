@@ -1,6 +1,7 @@
 """KV-cache generation tests: the decode path must agree exactly with the
 full-context forward pass."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -20,6 +21,7 @@ def make_params(model, batch=2, seq=12, seed=0):
     return model.init(jax.random.PRNGKey(1), jnp.asarray(tokens))["params"], tokens
 
 
+@pytest.mark.slow
 def test_decode_logits_match_full_forward():
     """Feeding tokens one at a time through the KV cache must reproduce the
     full-context causal logits at every position."""
@@ -56,6 +58,7 @@ def test_greedy_generation_is_deterministic_and_preserves_prompt():
     assert out1.shape == (3, 14)
 
 
+@pytest.mark.slow
 def test_greedy_matches_incremental_full_forward():
     """Greedy generate == repeatedly running the full model and taking argmax
     of the last position (the no-cache oracle)."""
@@ -116,6 +119,7 @@ class TestShardedGeneration:
             out = generate(model, params, jnp.asarray(tokens[:, :4]), 5)
         assert out.shape == (2, 9)
 
+    @pytest.mark.slow
     def test_mesh_parity_with_single_device(self):
         """Greedy decode on an 8-device data mesh must produce token-for-token
         the same output as the single-device path."""
@@ -133,6 +137,7 @@ class TestShardedGeneration:
         np.testing.assert_array_equal(np.asarray(sharded), np.asarray(single))
         assert sharded.sharding.spec == jax.sharding.PartitionSpec("data")
 
+    @pytest.mark.slow
     def test_mesh_parity_with_tensor_parallel_params(self):
         """data x tensor mesh with megatron-sharded params: same tokens."""
         from jax.sharding import NamedSharding
@@ -176,6 +181,7 @@ class TestShardedGeneration:
         np.testing.assert_array_equal(np.asarray(sharded), np.asarray(single))
 
 
+@pytest.mark.slow
 def test_parallel_prefill_matches_serial_prompt_walk():
     """The chunked prefill (one batched forward over the common prompt
     prefix) must produce bit-identical greedy output to the all-serial loop

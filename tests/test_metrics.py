@@ -2,6 +2,7 @@
 (SURVEY.md §5: loss computed but never logged, unused SummaryWriter import at
 ``multigpu_profile.py:10``)."""
 
+import pytest
 import json
 
 from distributed_pytorch_tpu.metrics import MetricLogger
@@ -43,6 +44,7 @@ def test_scalars_coerced_to_float(capsys):
     }
 
 
+@pytest.mark.slow
 def test_tensorboard_scalars_written(tmp_path, capsys):
     import pytest
 

@@ -1,5 +1,6 @@
 """Model-tier tests: shapes, BatchNorm state plumbing, trainability."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -21,6 +22,7 @@ def test_toy_and_mlp_shapes():
         assert y.shape == (8, out)
 
 
+@pytest.mark.slow
 def test_resnet18_forward_and_param_count():
     model = ResNet18(num_classes=10)
     x = jnp.zeros((2, 32, 32, 3))
@@ -31,6 +33,7 @@ def test_resnet18_forward_and_param_count():
     assert "batch_stats" in updates
 
 
+@pytest.mark.slow
 def test_resnet50_param_count_matches_torchvision():
     """~25.5M params — sanity anchor against the reference's torchvision model
     (multigpu_profile.py:23)."""
@@ -40,6 +43,7 @@ def test_resnet50_param_count_matches_torchvision():
     assert 25.4e6 < n_params < 25.7e6, n_params
 
 
+@pytest.mark.slow
 def test_resnet_trains_with_batch_stats():
     """End-to-end step on a BN model: loss finite, batch_stats actually move."""
     model = ResNet18(num_classes=10)

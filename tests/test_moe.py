@@ -40,6 +40,7 @@ def make_batch(n_rows=4):
     return tokens[:, :-1], tokens[:, 1:]
 
 
+@pytest.mark.slow
 def test_moe_mlp_routes_all_tokens_with_ample_capacity():
     """With capacity_factor >= n_experts every token gets a slot, so the MoE
     layer output equals running each token through its argmax expert."""
@@ -83,6 +84,7 @@ def test_moe_capacity_drops_overflow_tokens():
     assert (norms > 1e-6).sum() == 1  # exactly one token served
 
 
+@pytest.mark.slow
 def test_moe_lm_trains_and_loss_decreases():
     model = moe_lm()
     inputs, targets = make_batch()
@@ -97,6 +99,7 @@ def test_moe_lm_trains_and_loss_decreases():
     assert float(loss) < first
 
 
+@pytest.mark.slow
 def test_ep_sharded_training_matches_replicated():
     """DP x EP training is numerically equivalent to replicated DP: expert
     sharding (and its all-to-all) changes placement only."""

@@ -98,6 +98,7 @@ def test_adam_moments_shard_like_params():
 
 
 @pytest.mark.parametrize("mode", ["tp", "fsdp"])
+@pytest.mark.slow
 def test_sharded_training_matches_replicated(mode):
     """DP+TP (and DP+FSDP) training must be numerically equivalent to pure-DP
     replicated training: shardings change placement, not math."""
@@ -179,6 +180,7 @@ def test_zero1_specs_shard_moments_not_params():
     )
 
 
+@pytest.mark.slow
 def test_zero1_training_matches_replicated_dp():
     """ZeRO-1 (sharded Adam moments, replicated params) is pure placement:
     the loss curve must match replicated DP, params must stay replicated on

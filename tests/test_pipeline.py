@@ -48,6 +48,7 @@ def test_pipeline_apply_matches_serial_chain():
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_apply_grads_match_serial():
     """Gradients flow back through the ppermute ring and match the serial
     chain's gradients."""
@@ -78,6 +79,7 @@ def test_pipeline_apply_grads_match_serial():
     np.testing.assert_allclose(np.asarray(g_piped), np.asarray(g_serial), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_pipelined_lm_matches_serial_fallback():
     """The same params give the same logits with the pipeline on a stage mesh
     vs the serial chain fallback (mesh=None)."""
@@ -99,6 +101,7 @@ def test_pipelined_lm_matches_serial_fallback():
     )
 
 
+@pytest.mark.slow
 def test_pp_training_loss_decreases_with_sharded_stages():
     """Full DP x PP train loop: stage params sharded P('stage'), loss falls."""
     mesh = make_mesh({"data": 2, "stage": 4})

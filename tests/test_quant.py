@@ -1,5 +1,6 @@
 """Weight-only int8 quantization: numerics, tree mapping, decode parity."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import jax.tree_util as jtu
@@ -90,6 +91,7 @@ class TestQuantizeInt8:
 
 
 class TestQuantizePytree:
+    @pytest.mark.slow
     def test_rules_match_matmul_kernels_only(self):
         params = lm_params()
         qtree = quantize_pytree(params, TRANSFORMER_QUANT_RULES)
@@ -109,6 +111,7 @@ class TestQuantizePytree:
         assert not any("bias" in p for p in quantized_paths)
         assert not any("ln_" in p for p in quantized_paths)
 
+    @pytest.mark.slow
     def test_dequantize_pytree_restores_structure_and_values(self):
         params = lm_params()
         qtree = quantize_pytree(params)
@@ -129,6 +132,7 @@ class TestQuantizePytree:
 
 
 class TestQuantizedDecodeParity:
+    @pytest.mark.slow
     def test_greedy_decode_matches_f32(self):
         """Weight-only int8 on a trained-ish model: greedy continuations must
         match the full-precision path token for token (quant noise ~0.3% RMS
@@ -155,6 +159,7 @@ class TestQuantizedDecodeParity:
         )
         np.testing.assert_array_equal(np.asarray(fresh), np.asarray(pre))
 
+    @pytest.mark.slow
     def test_quantized_tensor_parallel_decode_parity(self):
         """int8 decode composes with megatron TP shardings: the int8 kernels
         keep the kernel's placement, the per-channel scales drop the
@@ -191,6 +196,7 @@ class TestQuantizedDecodeParity:
 
 
 class TestQuantizedKVCache:
+    @pytest.mark.slow
     def test_int8_cache_greedy_parity(self):
         """Per-(token, head) int8 KV cache: greedy continuations on a trained
         model match the bf16-cache path token for token."""
@@ -217,6 +223,7 @@ class TestQuantizedKVCache:
         assert k.dtype == jnp.int8 and k.shape == (2, 12, 4, 8)
         assert s.dtype == jnp.float32 and s.shape == (2, 12, 4)
 
+    @pytest.mark.slow
     def test_composes_with_weight_quant_and_mesh(self):
         from distributed_pytorch_tpu.generation import generate
         from distributed_pytorch_tpu.parallel.mesh import make_mesh
@@ -266,6 +273,7 @@ class TestDecodeByteAccounting:
             analysis = analysis[0]
         return float(analysis["bytes accessed"])
 
+    @pytest.mark.slow
     def test_int8_cache_cuts_program_bytes(self):
         # The cache dominates this shape (tiny model, B=4, T=256 -> ~2 MB of
         # bf16 KV cache vs ~100 KB of weights).
@@ -359,6 +367,7 @@ class TestMoEQuantCoverage:
         tokens = jnp.zeros((2, 16), jnp.int32)
         return model.init(jax.random.PRNGKey(0), tokens)["params"]
 
+    @pytest.mark.slow
     def test_expert_kernels_quantized(self):
         from distributed_pytorch_tpu.ops.quant import quant_coverage
 

@@ -1,6 +1,7 @@
 """Trainer integration tests: end-to-end epochs, DP parity, snapshot resume
 (the reference's elasticity contract, ``multigpu_torchrun.py:30-40,57-65``)."""
 
+import pytest
 import jax
 import numpy as np
 import optax
@@ -118,6 +119,7 @@ def test_trainer_mesh_rejects_indivisible_batch(tmp_path):
                 mesh=mesh)
 
 
+@pytest.mark.slow
 def test_checkpoint_includes_model_state(tmp_path):
     """Plain checkpoints carry BatchNorm running stats (reference parity:
     state_dict includes them)."""
@@ -140,6 +142,7 @@ def test_checkpoint_includes_model_state(tmp_path):
     assert stats and any(not np.allclose(np.asarray(s), 0) for s in stats)
 
 
+@pytest.mark.slow
 def test_trainer_partition_specs_zero1_and_fsdp(tmp_path):
     """The sharding zoo through the flagship API: Trainer(partition_specs=)
     with ZeRO-1 (TrainState-shaped specs) and FSDP (params-shaped specs)

@@ -1,5 +1,6 @@
 """TransformerLM + ViT: shapes, causality, sequence-parallel parity, training."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -42,6 +43,7 @@ def test_lm_is_causal():
     )
 
 
+@pytest.mark.slow
 def test_lm_sequence_parallel_matches_dense():
     """The long-context contract: a TransformerLM running ring attention over a
     sequence-sharded mesh produces the same logits as the dense model."""
@@ -57,6 +59,7 @@ def test_lm_sequence_parallel_matches_dense():
     )
 
 
+@pytest.mark.slow
 def test_lm_trains_and_loss_decreases():
     model = TransformerLM(**TINY)
     opt = optax.adam(1e-3)
@@ -73,6 +76,7 @@ def test_lm_trains_and_loss_decreases():
     assert last < first * 0.8
 
 
+@pytest.mark.slow
 def test_lm_remat_matches_no_remat():
     tokens = _tokens()
     plain = TransformerLM(**TINY)
@@ -88,6 +92,7 @@ def test_lm_remat_matches_no_remat():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_vit_forward_and_train_step():
     model = ViT(
         patch_size=8, d_model=32, n_layers=2, n_heads=4, d_ff=64,
@@ -116,6 +121,7 @@ def test_vit_l32_param_count():
     assert 290e6 < n < 320e6, n
 
 
+@pytest.mark.slow
 def test_lm_dp_training_matches_serial():
     """DP mesh training parity for the transformer (same contract as the toy)."""
     mesh = make_mesh({"data": 8})
@@ -144,6 +150,7 @@ class TestRematPolicy:
     kernels un-recomputed; measured +18% step time for 'full' at T=8192 on
     v5e, BASELINE.md round 3)."""
 
+    @pytest.mark.slow
     def test_policies_match_no_remat(self):
         rng = np.random.default_rng(0)
         tokens = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)

@@ -1,10 +1,10 @@
 """Generate flash-attention block tables for a new device kind.
 
-The shipped ``DEFAULT_TABLE`` in ``ops/flash_autotune.py`` only covers chips
-someone has actually measured (round 4: TPU v5e). This tool is the measuring
-workflow for every other chip — run it ONCE on one host of the target kind:
+Thin, documented alias of ``python -m distributed_pytorch_tpu.ops
+.flash_autotune`` — ONE implementation lives there; this file only fixes the
+import path for direct invocation. Run ONCE on one host of the target kind:
 
-    python tools/flash_autotune_gen.py --export blocks_v5p.json
+    python tools/flash_autotune_gen.py --export blocks_v5p.json [--force]
 
 It sweeps the standard (seq_len, head_dim) grid with the real measured
 autotune (one compile + timed fwd+bwd per legal candidate) and emits:
@@ -20,71 +20,21 @@ autotune (one compile + timed fwd+bwd per legal candidate) and emits:
    identical blocks and trace identical programs — the live sweep stays
    disabled under multi-process SPMD on purpose).
 
+Only measured winners are emitted: ``--force`` re-sweeps past cached
+entries, and shapes where no candidate compiles are excluded loudly.
 Until a kind is measured, lookups fall back to the VMEM-reasoned
 ``analytic_default`` — legal everywhere, but measured tables have beaten
 analytic guesses by 6-10% on v5e, which is why this tool exists.
 """
 
-from __future__ import annotations
-
-import argparse
-import json
+import os
 import sys
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
-from distributed_pytorch_tpu.ops import flash_autotune as fa  # noqa: E402
-
-
-def main() -> None:
-    parser = argparse.ArgumentParser(
-        description="measure flash block tables for this host's device kind"
-    )
-    parser.add_argument("--seq_lens", default="2048,8192,16384")
-    parser.add_argument("--head_dims", default="64,128")
-    parser.add_argument("--bh", default=16, type=int, help="batch*heads")
-    parser.add_argument(
-        "--export", default="", help="also write a FLASH_BLOCKS_TABLE JSON"
-    )
-    args = parser.parse_args()
-
-    kind = fa._device_kind()
-    if kind == "unknown":
-        raise SystemExit(
-            "no JAX backend reachable — run on the target device"
-        )
-    print(f"device kind: {kind}", flush=True)
-
-    seq_lens = [int(x) for x in args.seq_lens.split(",")]
-    head_dims = [int(x) for x in args.head_dims.split(",")]
-
-    entries = {}  # (t, d) -> (bq, bk)
-    shipped = {}  # full key -> blocks, for --export
-    for t in seq_lens:
-        for d in head_dims:
-            analytic = fa.analytic_default(t, d)
-            blocks = fa.autotune(t, d, bh=args.bh, verbose=True)
-            marker = "" if blocks != analytic else "  (= analytic default)"
-            print(f"T={t:6d} d={d:4d} -> {blocks}{marker}", flush=True)
-            entries[(t, d)] = blocks
-            shipped[fa._key(kind, t, d, "bfloat16", True)] = blocks
-
-    print("\n# Paste into ops/flash_autotune.py DEFAULT_TABLE:")
-    print(f'    "{kind.lower()}": {{')
-    for (t, d), (bq, bk) in sorted(entries.items()):
-        print(f"        ({t}, {d}): ({bq}, {bk}),")
-    print("    },")
-
-    if args.export:
-        with open(args.export, "w") as f:
-            json.dump(
-                {json.dumps(list(k)): list(v) for k, v in shipped.items()}, f
-            )
-        print(
-            f"\nexported {len(shipped)} entries to {args.export} — deploy "
-            "with FLASH_BLOCKS_TABLE=<path> on every pod host"
-        )
-
+from distributed_pytorch_tpu.ops.flash_autotune import main  # noqa: E402
 
 if __name__ == "__main__":
     main()

@@ -433,12 +433,26 @@ class ElasticAgent:
                 try:
                     generation, members = self._rendezvous()
                 except WorldCompleted:
+                    if self._group is None:
+                        # Never spawned workers in this process: we are a
+                        # revived latecomer and the world finished without
+                        # us — a clean no-op exit.
+                        print(
+                            "[tpurun] rendezvous store gone — the world "
+                            "completed without this (revived) node; exiting",
+                            flush=True,
+                        )
+                        return 0
+                    # We WERE part of this world (workers ran and the job
+                    # is unfinished, or we'd have exited via the done
+                    # barrier): losing the store mid-run means node 0 died.
+                    # That is a failure, never silent success.
                     print(
-                        "[tpurun] rendezvous store gone — the world "
-                        "completed without this (revived) node; exiting",
-                        flush=True,
+                        "[tpurun] rendezvous store lost mid-run (node 0 "
+                        "dead?); aborting",
+                        file=sys.stderr,
                     )
-                    return 0
+                    return 1
                 if cfg.node_rank == 0:
                     print(
                         f"[tpurun] generation {generation}: {len(members)} "

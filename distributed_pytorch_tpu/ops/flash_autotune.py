@@ -69,6 +69,12 @@ DEFAULT_TABLE = {
 
 _FALLBACK = (512, 1024)
 _runtime_cache: dict = {}
+# Keys whose measured sweep failed outright (no candidate compiled) in THIS
+# process: memoized so the live FLASH_AUTOTUNE=1 path doesn't re-pay the
+# failing sweep per retrace, distinguishable so the table generator never
+# emits the fallback as a measured winner, and never written to disk so a
+# future process (new compiler, new driver) retries for real.
+_failed_sweeps: set = set()
 
 
 def _cache_path() -> str:
@@ -208,8 +214,10 @@ def autotune(
     generator uses it so a re-run after a compiler upgrade (or with a
     different ``bh``, which the cache key deliberately omits) re-measures
     instead of replaying stale winners. A sweep in which EVERY candidate
-    fails to compile returns the legacy fallback but does NOT cache it:
-    an unmeasured guess must never masquerade as a measured winner.
+    fails to compile returns the legacy fallback, memoized in-process only
+    (``_failed_sweeps`` marks it un-measured; the disk cache is never
+    written): the live path doesn't re-pay the failing sweep, the table
+    generator excludes the shape, and a future process retries for real.
     """
     import jax
     import jax.numpy as jnp
@@ -261,18 +269,22 @@ def autotune(
         if dt < best_dt:
             best, best_dt = (bq, bk), dt
     if best_dt == float("inf"):
-        # Nothing compiled: report the uncached fallback so callers (and the
-        # table generator, which checks the disk cache to tell measured from
-        # guessed) can see this shape was NOT measured.
+        # Nothing compiled. Memoize in-process only (the live path must not
+        # re-pay a failing sweep per retrace; a future process with a newer
+        # compiler should retry), mark the key failed so the table
+        # generator excludes it, and return the fallback.
         import warnings
 
         warnings.warn(
             f"flash autotune: no (block_q, block_k) candidate compiled for "
-            f"T={t} d={d} on {device_kind!r}; returning uncached fallback "
-            f"{_FALLBACK}"
+            f"T={t} d={d} on {device_kind!r}; using fallback {_FALLBACK} "
+            "(not persisted)"
         )
+        _runtime_cache[key] = _FALLBACK
+        _failed_sweeps.add(key)
         return _FALLBACK
     _runtime_cache[key] = best
+    _failed_sweeps.discard(key)
     disk = _load_disk_cache()
     disk[key] = best
     _save_disk_cache(disk)
@@ -314,7 +326,7 @@ def main(argv=None) -> None:
     kind = _device_kind()
     if kind == "unknown":
         raise SystemExit("no JAX backend reachable — run on the target device")
-    print(f"device: {kind}")
+    print(f"device: {kind}", flush=True)
     entries = {}  # (t, d) -> measured blocks
     shipped = {}  # full key -> blocks, for --export
     failed = []
@@ -322,13 +334,19 @@ def main(argv=None) -> None:
         for d in (int(x) for x in args.head_dims.split(",")):
             blocks = autotune(t, d, bh=args.bh, verbose=True, force=args.force)
             key = _key(kind, t, d, "bfloat16", True)
-            if key not in _load_disk_cache():
-                print(f"T={t:6d} d={d:4d} -> MEASUREMENT FAILED (excluded)")
+            # Measured-ness comes from the sweep itself (_failed_sweeps),
+            # not the disk cache — stale disk entries or a read-only home
+            # must not flip a shape between measured and failed.
+            if key in _failed_sweeps:
+                print(
+                    f"T={t:6d} d={d:4d} -> MEASUREMENT FAILED (excluded)",
+                    flush=True,
+                )
                 failed.append((t, d))
                 continue
             analytic = analytic_default(t, d)
             marker = "  (= analytic default)" if blocks == analytic else ""
-            print(f"T={t:6d} d={d:4d} -> {blocks}{marker}")
+            print(f"T={t:6d} d={d:4d} -> {blocks}{marker}", flush=True)
             entries[(t, d)] = blocks
             shipped[key] = blocks
 
@@ -337,9 +355,12 @@ def main(argv=None) -> None:
         print(f'    "{kind.lower()}": {{')
         for (t, d), (bq, bk) in sorted(entries.items()):
             print(f"        ({t}, {d}): ({bq}, {bk}),")
-        print("    },")
+        print("    },", flush=True)
     if failed:
-        print(f"\n# NOT measured (every candidate failed to compile): {failed}")
+        print(
+            f"\n# NOT measured (every candidate failed to compile): {failed}",
+            flush=True,
+        )
     if args.export:
         with open(args.export, "w") as f:
             json.dump(
@@ -347,7 +368,8 @@ def main(argv=None) -> None:
             )
         print(
             f"exported {len(shipped)} measured entries to {args.export} — "
-            "deploy with FLASH_BLOCKS_TABLE=<path> on every pod host"
+            "deploy with FLASH_BLOCKS_TABLE=<path> on every pod host",
+            flush=True,
         )
 
 

@@ -53,6 +53,18 @@ def main(args):
 
         oracle = synthetic_oracle_accuracy(arrays[2], arrays[3])
         print(f"[datasets] synthetic Bayes-oracle accuracy: {oracle:.4f}")
+        if args.augment:
+            # No silent caps: crop/flip assume translation/flip invariance,
+            # which the stand-in's pixel-aligned templates do not have —
+            # measured on this rig, augmentation pins eval accuracy at
+            # chance (BASELINE.md round 4). Real CIFAR-10 wants it; the
+            # synthetic stand-in does not.
+            print(
+                "[datasets] WARNING: --augment on the synthetic stand-in "
+                "destroys its pixel-aligned signal; expect chance-level "
+                "eval accuracy. Drop --augment for synthetic runs.",
+                flush=True,
+            )
     train_ds, test_ds = as_datasets(arrays)
     if args.augment:
         # Standard CIFAR recipe (pad-4 random crop + flip) — what a sane

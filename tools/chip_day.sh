@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Every queued on-chip measurement, one serialized run (BASELINE.md "Pending
+# on-chip measurements"). The axon tunnel wedges if a TPU process is killed
+# mid-compile and cannot handle two concurrent clients — so: run THIS SCRIPT
+# ALONE, never ctrl-C a step, and let each step finish. Usage:
+#
+#   bash tools/chip_day.sh 2>&1 | tee chip_day.log
+#
+# Steps (each is independently restartable; comment out what you have):
+set -u
+cd "$(dirname "$0")/.."
+
+run() {
+  echo "=== [$(date +%H:%M:%S)] $*" >&2
+  "$@"
+  echo "=== [$(date +%H:%M:%S)] rc=$? : $*" >&2
+}
+
+# 1. Headline (driver metric): ResNet-50 b32 steps/s + MFU.
+run python bench.py
+
+# 2. Full matrix -> BENCH_MATRIX.json (ViT + d_head=128 rows included).
+run python bench.py --matrix
+
+# 3. int8 decode A/B pairs (weights + KV cache) -> BASELINE.md rows;
+#    decides the quant_matmul wiring (see BASELINE.md round-3 queue).
+run python tools/decode_bench.py
+
+# 4. Real-data-rung curve on the hard synthetic stand-in (fast on chip;
+#    full 50k train set — the CPU runs only managed 3-4k subsets, where
+#    ResNet-18 overfits noise instead of pooling the template signal).
+#    NO --augment: crop/flip destroy the stand-in's pixel-aligned signal
+#    (BASELINE.md round 4); use --augment only with real CIFAR-10 data.
+run python examples/real_data.py --epochs 6 --batch_size 128 --lr 0.02
+
+# 5. Flash block-table sweep IF this chip kind is not already in
+#    DEFAULT_TABLE (prints a mergeable entry; skip on v5e).
+# run python tools/flash_autotune_gen.py --export blocks_$(date +%s).json
+
+echo "done — commit BENCH_MATRIX.json + BASELINE.md updates" >&2

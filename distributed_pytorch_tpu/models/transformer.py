@@ -24,7 +24,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from distributed_pytorch_tpu.models.moe import MoEMLP
-from distributed_pytorch_tpu.ops.attention import NEG_INF, ring_attention
+from distributed_pytorch_tpu.ops.attention import (
+    NEG_INF,
+    ring_attention,
+    ulysses_attention,
+)
 from distributed_pytorch_tpu.ops.flash_attention import flash_attention
 from distributed_pytorch_tpu.ops.fused_cross_entropy import (
     fused_linear_cross_entropy,
@@ -59,10 +63,12 @@ def apply_rope(
 class Attention(nn.Module):
     """Multi-head attention with RoPE and a pluggable core.
 
-    Core selection: ring attention when the mesh has a non-trivial sequence
-    axis (cross-chip long context); otherwise the Pallas flash-attention
-    kernel on TPU (which itself falls back to the dense XLA path on other
-    backends or non-tiling shapes).
+    Core selection: when the mesh has a non-trivial sequence axis
+    (cross-chip long context), ``sequence_mode`` picks the sequence-parallel
+    strategy — ``"ring"`` (K/V rotation, O(T/sp) memory) or ``"ulysses"``
+    (all-to-all seq->head redistribution, fully local full-T attention);
+    otherwise the Pallas flash-attention kernel on TPU (which itself falls
+    back to the dense XLA path on other backends or non-tiling shapes).
     """
 
     n_heads: int
@@ -71,6 +77,12 @@ class Attention(nn.Module):
     causal: bool = True
     mesh: Optional[Mesh] = None
     sequence_axis: Optional[str] = None
+    # How to parallelize attention over the sequence axis: "ring" (K/V
+    # rotate via ppermute; memory O(T/sp) per chip — for T beyond one
+    # chip's HBM) or "ulysses" (two all-to-alls redistribute seq->heads;
+    # attention is then fully local full-T flash — for T that fits per
+    # chip, needs (H/tp) % sp == 0). See ops/attention.py.
+    sequence_mode: str = "ring"
     decode: bool = False  # autoregressive KV-cache mode (see generation.py)
     # int8 KV cache: at long context the [B, T, H, D] caches — not the
     # params — dominate decode memory and HBM traffic; symmetric absmax
@@ -80,6 +92,14 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        # Validate unconditionally: a typo'd mode must fail on the first
+        # single-chip forward, not later when the job first meets an sp>1
+        # mesh mid-launch.
+        if self.sequence_mode not in ("ring", "ulysses"):
+            raise ValueError(
+                f"unknown sequence_mode {self.sequence_mode!r} "
+                "(expected 'ring' or 'ulysses')"
+            )
         head_dim = self.d_model // self.n_heads
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
             (self.n_heads, head_dim), dtype=self.dtype, name=name
@@ -119,10 +139,21 @@ class Attention(nn.Module):
             and self.mesh.shape.get(self.sequence_axis, 1) > 1
         )
         if use_ring:
-            out = ring_attention(
-                q, k, v, mesh=self.mesh, axis_name=self.sequence_axis,
-                causal=self.causal,
-            )
+            if self.sequence_mode == "ulysses":
+                out = ulysses_attention(
+                    q, k, v, mesh=self.mesh, axis_name=self.sequence_axis,
+                    causal=self.causal,
+                )
+            elif self.sequence_mode == "ring":
+                out = ring_attention(
+                    q, k, v, mesh=self.mesh, axis_name=self.sequence_axis,
+                    causal=self.causal,
+                )
+            else:
+                raise ValueError(
+                    f"unknown sequence_mode {self.sequence_mode!r} "
+                    "(expected 'ring' or 'ulysses')"
+                )
         else:
             out = flash_attention(
                 q, k, v, causal=self.causal, mesh=self.mesh
@@ -218,6 +249,7 @@ class TransformerBlock(nn.Module):
     causal: bool = True
     mesh: Optional[Mesh] = None
     sequence_axis: Optional[str] = None
+    sequence_mode: str = "ring"  # see Attention
     n_experts: int = 0  # >0 swaps the dense MLP for an expert-parallel MoEMLP
     decode: bool = False
     remat_mlp: bool = False  # rematerialize only the MLP branch (see TransformerLM)
@@ -227,7 +259,8 @@ class TransformerBlock(nn.Module):
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         x = x + Attention(
             self.n_heads, self.d_model, self.dtype, self.causal,
-            self.mesh, self.sequence_axis, self.decode,
+            self.mesh, self.sequence_axis,
+            sequence_mode=self.sequence_mode, decode=self.decode,
             quantized_cache=self.quantized_cache, name="attention",
         )(nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x))
         if self.n_experts > 0:
@@ -314,6 +347,7 @@ class TransformerLM(nn.Module):
     remat_policy: str = "full"  # "full" | "mlp"
     mesh: Optional[Mesh] = None
     sequence_axis: Optional[str] = None
+    sequence_mode: str = "ring"  # "ring" | "ulysses" (see Attention)
     n_experts: int = 0  # >0: MoE MLPs in every `moe_every`-th block
     moe_every: int = 2
     decode: bool = False  # KV-cache autoregressive mode (see generation.py)
@@ -341,8 +375,10 @@ class TransformerLM(nn.Module):
             moe = self.n_experts if (i + 1) % self.moe_every == 0 else 0
             x = block(
                 self.n_heads, self.d_model, self.d_ff, self.dtype,
-                True, self.mesh, self.sequence_axis, moe, self.decode,
-                remat_mlp, self.quantized_cache, name=f"block_{i}",
+                True, self.mesh, self.sequence_axis,
+                sequence_mode=self.sequence_mode, n_experts=moe,
+                decode=self.decode, remat_mlp=remat_mlp,
+                quantized_cache=self.quantized_cache, name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         if self.fused_head_chunk and self.vocab_size % self.fused_head_chunk:

@@ -4,6 +4,7 @@ and int8 weight/KV quantization."""
 from distributed_pytorch_tpu.ops.attention import (
     dot_product_attention,
     ring_attention,
+    ulysses_attention,
 )
 from distributed_pytorch_tpu.ops.flash_attention import flash_attention
 from distributed_pytorch_tpu.ops.fused_cross_entropy import (
@@ -27,4 +28,5 @@ __all__ = [
     "quantize_int8",
     "quantize_pytree",
     "ring_attention",
+    "ulysses_attention",
 ]

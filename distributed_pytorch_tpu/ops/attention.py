@@ -17,6 +17,15 @@ built for it from the start:
   RingAttention construction (Liu et al. 2023), expressed with
   ``shard_map`` + ``ppermute`` so XLA schedules the collective permutes
   onto the ICI ring.
+* :func:`ulysses_attention` — the all-to-all alternative (DeepSpeed-Ulysses
+  style): one ``all_to_all`` turns sequence-sharded activations into
+  head-sharded ones, attention runs fully local over the WHOLE sequence
+  (single-chip flash kernel at full efficiency), one inverse ``all_to_all``
+  restores the layout. Two collectives total instead of ``n`` hops; needs
+  ``(H / tp) % sp == 0`` and per-chip memory ``O(T)`` for the exchanged
+  activations — choose ring when T alone outgrows a chip, ulysses when it
+  fits and heads are plentiful. Both compose with DP and TP; the model
+  selects via ``TransformerLM(sequence_mode="ring" | "ulysses")``.
 
 Shapes follow the TPU-friendly convention ``[batch, seq, heads, head_dim]``.
 """
@@ -188,6 +197,136 @@ def _ring_attention_shard(
     return o_acc.astype(q.dtype)
 
 
+def _ulysses_shard(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    causal: bool,
+    flash_blocks=None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Per-device body (runs under shard_map): head-scatter / seq-gather
+    all-to-all, full-sequence attention on the local heads, inverse
+    all-to-all.
+
+    ``q,k,v``: [B, T_local, H_local, D] shards. The forward ``all_to_all``
+    splits the heads dim across the axis and concatenates the sequence dim
+    (tiled, source-device order = global sequence order), yielding
+    [B, T, H_local/sp, D]; attention then needs NO cross-device math at all
+    — the causal mask is the ordinary full-sequence one — and the inverse
+    exchange restores the sequence sharding.
+    """
+    def scatter_heads(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    if flash_blocks is not None:
+        from distributed_pytorch_tpu.ops.flash_attention import (
+            flash_attention_4d,
+        )
+
+        o = flash_attention_4d(
+            qh, kh, vh, causal=causal,
+            block_q=flash_blocks[0], block_k=flash_blocks[1],
+            interpret=interpret,
+        )
+    else:
+        o = dot_product_attention(qh, kh, vh, causal=causal)
+    return jax.lax.all_to_all(
+        o, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    axis_name: str = "sequence",
+    causal: bool = False,
+    batch_axis: Optional[str] = "data",
+    heads_axis: Optional[str] = "tensor",
+    use_flash: Optional[bool] = None,
+    interpret: bool = False,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+) -> jnp.ndarray:
+    """DeepSpeed-Ulysses-style sequence parallelism: all-to-all over the
+    ``axis_name`` mesh axis redistributes sequence-sharded activations into
+    head-sharded ones, each device runs ORDINARY full-sequence attention on
+    ``H/sp`` heads (the Pallas flash kernel when legal), and the inverse
+    all-to-all restores sequence sharding.
+
+    The complementary strategy to :func:`ring_attention` (no reference
+    analog — the reference has no attention at all; this implements the
+    "all-to-all sequence/context parallelism" alternative named in the
+    framework brief):
+
+    * **ring**: K/V rotate hop-by-hop (nearest-neighbor ICI), compute and
+      comm overlap across ``sp`` hops, per-device memory ``O(T/sp)`` — the
+      choice when T alone is too big for one chip's HBM.
+    * **ulysses**: two all-to-alls total (well-scheduled on ICI's all-to-all
+      bandwidth), then the attention itself is entirely local, so the
+      single-chip flash kernel runs at full efficiency over the WHOLE
+      sequence — the choice while ``T`` fits per-chip memory and the head
+      count is divisible; it caps ``sp`` at the (local) head count.
+
+    Composes with TP the same way ring does: heads arrive sharded along
+    ``heads_axis`` and stay sharded — Ulysses further splits the LOCAL
+    heads, so it needs ``(H / tp) % sp == 0``.
+    """
+    seq_size = mesh.shape.get(axis_name, 1)
+    if seq_size == 1:
+        return dot_product_attention(q, k, v, causal=causal)
+    b, t, h, d = q.shape
+    if t % seq_size != 0:
+        raise ValueError(
+            f"sequence length {t} not divisible by mesh axis "
+            f"{axis_name!r} ({seq_size})"
+        )
+    heads_spec = axis_if_divisible(mesh, heads_axis, h)
+    h_local = h // mesh.shape[heads_spec] if heads_spec else h
+    if h_local % seq_size != 0:
+        raise ValueError(
+            f"ulysses needs local head count {h_local} divisible by mesh "
+            f"axis {axis_name!r} ({seq_size}); use ring_attention for "
+            "head-starved configs"
+        )
+
+    from distributed_pytorch_tpu.ops.flash_attention import gate_flash_blocks
+
+    # Blocks are resolved for the FULL sequence: post-exchange attention is
+    # global-T on local heads.
+    use_flash, flash_blocks = gate_flash_blocks(
+        t, d, q.dtype, causal, interpret, block_q, block_k, use_flash
+    )
+    spec = P(
+        axis_if_divisible(mesh, batch_axis, b),
+        axis_name,
+        heads_spec,
+        None,
+    )
+    body = functools.partial(
+        _ulysses_shard,
+        axis_name=axis_name,
+        causal=causal,
+        flash_blocks=flash_blocks,
+        interpret=interpret,
+    )
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -230,30 +369,15 @@ def ring_attention(
             f"{axis_name!r} ({seq_size})"
         )
 
-    from distributed_pytorch_tpu.ops.flash_attention import (
-        _fit_block,
-        resolve_blocks,
-    )
+    from distributed_pytorch_tpu.ops.flash_attention import gate_flash_blocks
 
+    # Blocks are resolved for the LOCAL hop length: each ring hop attends
+    # one T/sp-long K/V block.
     t_local = q.shape[1] // seq_size
-    if use_flash is False:
-        fit_q = fit_k = None  # dense hops: never resolve/sweep block sizes
-    else:
-        block_q, block_k = resolve_blocks(
-            block_q, block_k, t_local, q.shape[-1], q.dtype, causal, interpret
-        )
-        fit_q = _fit_block(block_q, t_local)
-        fit_k = _fit_block(block_k, t_local)
-    blocks_fit = fit_q is not None and fit_k is not None
-    if blocks_fit and not interpret and (fit_k % 128 != 0):
-        blocks_fit = False  # lane alignment (see flash_attention)
-    if use_flash is None:
-        use_flash = (on_tpu() or interpret) and blocks_fit
-    elif use_flash and not blocks_fit:
-        raise ValueError(
-            f"use_flash=True but no legal flash tiling for local block "
-            f"T/{seq_size}={t_local}"
-        )
+    use_flash, hop_blocks = gate_flash_blocks(
+        t_local, q.shape[-1], q.dtype, causal, interpret,
+        block_q, block_k, use_flash,
+    )
     spec = P(
         axis_if_divisible(mesh, batch_axis, q.shape[0]),
         axis_name,
@@ -264,7 +388,7 @@ def ring_attention(
         _ring_attention_shard,
         axis_name=axis_name,
         causal=causal,
-        flash_blocks=(fit_q, fit_k) if use_flash else None,
+        flash_blocks=hop_blocks,
         interpret=interpret,
     )
     return jax.shard_map(

@@ -378,6 +378,57 @@ def _flash_lse_bwd(causal, block_q, block_k, interpret, residuals, cotangents):
 flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
+def flash_attention_4d(q, k, v, *, causal, block_q, block_k, interpret):
+    """``[B, T, H, D]`` through the 3-D Pallas kernel and back — THE layout
+    shim between the model convention and the kernel's ``[B*H, T, D]``.
+    Shared by :func:`flash_attention`'s local body and
+    ``attention.ulysses_attention`` so the convention can never diverge
+    between entry points."""
+    b, t, h, d = q.shape
+
+    def to3(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    out = _flash(to3(q), to3(k), to3(v), causal, block_q, block_k, interpret)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def gate_flash_blocks(
+    t: int,
+    d: int,
+    dtype,
+    causal: bool,
+    interpret: bool,
+    block_q: int | None,
+    block_k: int | None,
+    use_flash: bool | None,
+):
+    """The shared flash-eligibility gate for the sequence-parallel entry
+    points: resolve block sizes for a ``t``-long attention, fit them, apply
+    the hardware lane rule, and settle ``use_flash`` (None = auto). Returns
+    ``(use_flash, (fit_q, fit_k) or None)``; raises when the caller forced
+    ``use_flash=True`` on an untileable shape. One implementation so a new
+    Mosaic constraint (like the existing 128-lane check) lands everywhere
+    at once."""
+    if use_flash is False:
+        return False, None
+    block_q, block_k = resolve_blocks(
+        block_q, block_k, t, d, dtype, causal, interpret
+    )
+    fit_q = _fit_block(block_q, t)
+    fit_k = _fit_block(block_k, t)
+    blocks_fit = fit_q is not None and fit_k is not None
+    if blocks_fit and not interpret and (fit_k % 128 != 0):
+        blocks_fit = False  # lane alignment (see flash_attention)
+    if use_flash is None:
+        use_flash = (on_tpu() or interpret) and blocks_fit
+    elif not blocks_fit:  # use_flash is True here
+        raise ValueError(
+            f"use_flash=True but no legal flash tiling for T={t}"
+        )
+    return use_flash, ((fit_q, fit_k) if use_flash else None)
+
+
 def _fit_block(block: int, t: int) -> int | None:
     """Largest multiple of 8 that is <= ``block`` and divides ``t``
     (None when no such size exists — caller falls back to dense)."""
@@ -476,13 +527,10 @@ def flash_attention(
         return dot_product_attention(q, k, v, causal=causal)
 
     def run_local(ql, kl, vl):
-        bl, tl, hl, dl = ql.shape
-
-        def to3(x):
-            return x.transpose(0, 2, 1, 3).reshape(bl * hl, tl, dl)
-
-        out = _flash(to3(ql), to3(kl), to3(vl), causal, block_q, block_k, interpret)
-        return out.reshape(bl, hl, tl, dl).transpose(0, 2, 1, 3)
+        return flash_attention_4d(
+            ql, kl, vl, causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret,
+        )
 
     if mesh is None:
         return run_local(q, k, v)

@@ -75,6 +75,16 @@ class Attention(nn.Module):
     d_model: int
     dtype: Any = jnp.float32
     causal: bool = True
+    # Grouped-query attention (GQA; 0 = MHA): K/V project to n_kv_heads
+    # heads and each group of n_heads/n_kv_heads query heads shares one.
+    # The WIN is the decode KV cache: it stores (and HBM re-reads, every
+    # generated token) n_kv_heads instead of n_heads — at n_kv_heads=2,
+    # H=16 that is an 8x cache cut, multiplicative with quantized_cache's
+    # int8 halving. The query-side repeat happens compute-side after the
+    # cache read, so the bandwidth saving is real. n_kv_heads=1 is MQA.
+    # Under TP, the K/V kernels shard over n_kv_heads: needs
+    # n_kv_heads % tp == 0 (keep kv heads >= the tensor axis).
+    n_kv_heads: int = 0
     mesh: Optional[Mesh] = None
     sequence_axis: Optional[str] = None
     # How to parallelize attention over the sequence axis: "ring" (K/V
@@ -101,12 +111,18 @@ class Attention(nn.Module):
                 "(expected 'ring' or 'ulysses')"
             )
         head_dim = self.d_model // self.n_heads
-        dense = lambda name: nn.DenseGeneral(  # noqa: E731
-            (self.n_heads, head_dim), dtype=self.dtype, name=name
+        kv_heads = self.n_kv_heads or self.n_heads
+        if self.n_heads % kv_heads:
+            raise ValueError(
+                f"n_heads {self.n_heads} not divisible by n_kv_heads "
+                f"{kv_heads}"
+            )
+        dense = lambda heads, name: nn.DenseGeneral(  # noqa: E731
+            (heads, head_dim), dtype=self.dtype, name=name
         )
-        q_raw = dense("query")(x)
-        k_raw = dense("key")(x)
-        v = dense("value")(x)
+        q_raw = dense(self.n_heads, "query")(x)
+        k_raw = dense(kv_heads, "key")(x)
+        v = dense(kv_heads, "value")(x)
 
         if self.decode and self.has_variable("cache", "cached_key"):
             out = self._decode_step(q_raw, k_raw, v)
@@ -132,31 +148,41 @@ class Attention(nn.Module):
 
         q = apply_rope(q_raw)
         k = apply_rope(k_raw)
+        if kv_heads != self.n_heads:
+            # Compute-side broadcast for the cores that need full heads
+            # (flash, ulysses). Ring and decode take the UN-repeated k/v so
+            # their HBM/ICI traffic stays at the kv-head size — that is
+            # where GQA pays.
+            group = self.n_heads // kv_heads
+            kx = jnp.repeat(k, group, axis=2)
+            vx = jnp.repeat(v, group, axis=2)
+        else:
+            kx, vx = k, v
 
         use_ring = (
             self.mesh is not None
             and self.sequence_axis is not None
             and self.mesh.shape.get(self.sequence_axis, 1) > 1
         )
-        if use_ring:
-            if self.sequence_mode == "ulysses":
-                out = ulysses_attention(
-                    q, k, v, mesh=self.mesh, axis_name=self.sequence_axis,
-                    causal=self.causal,
-                )
-            elif self.sequence_mode == "ring":
-                out = ring_attention(
-                    q, k, v, mesh=self.mesh, axis_name=self.sequence_axis,
-                    causal=self.causal,
-                )
-            else:
-                raise ValueError(
-                    f"unknown sequence_mode {self.sequence_mode!r} "
-                    "(expected 'ring' or 'ulysses')"
-                )
+        if use_ring and self.sequence_mode == "ulysses":
+            # Pre-repeat is structural here: the all-to-all splits the
+            # (query) head dim across the axis, so K/V must carry the same
+            # head count. (validated mode at __call__ top)
+            out = ulysses_attention(
+                q, kx, vx, mesh=self.mesh, axis_name=self.sequence_axis,
+                causal=self.causal,
+            )
+        elif use_ring:
+            # Ring rotates K/V around the ICI ring every hop: hand it the
+            # UN-repeated kv-head blocks (kv_groups broadcasts per hop,
+            # compute-side) so GQA cuts the interconnect bytes too.
+            out = ring_attention(
+                q, k, v, mesh=self.mesh, axis_name=self.sequence_axis,
+                causal=self.causal, kv_groups=self.n_heads // kv_heads,
+            )
         else:
             out = flash_attention(
-                q, k, v, causal=self.causal, mesh=self.mesh
+                q, kx, vx, causal=self.causal, mesh=self.mesh
             )
         return nn.DenseGeneral(
             self.d_model, axis=(-2, -1), dtype=self.dtype, name="out"
@@ -191,11 +217,34 @@ class Attention(nn.Module):
             keys, values = cached_key.value, cached_value.value
         cache_index.value = index + t_step
         scale = q.shape[-1] ** -0.5
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, keys) * scale
         # Position k is visible to step-q q when k <= index + q.
         visible = (
             jnp.arange(max_len)[None, :] <= (index + jnp.arange(t_step))[:, None]
         )
+        kv_heads = keys.shape[2]
+        if kv_heads != q.shape[2]:
+            # GQA: GROUPED einsums against the small cache — the query is
+            # reshaped [B, t, G, Hkv, D] and contracted directly with the
+            # [B, T, Hkv, D] cache, so the n_heads-sized K/V tensors are
+            # never materialized (a jnp.repeat here would make XLA write
+            # and re-read group x the cache bytes the feature exists to
+            # avoid).
+            b, t_q, h, d = q.shape
+            group = h // kv_heads
+            # Head order must match the forward path's jnp.repeat (query
+            # head h shares kv head h // group), so the kv dim leads the
+            # group dim in the reshape.
+            qg = q.reshape(b, t_q, kv_heads, group, d)
+            logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, keys) * scale
+            logits = jnp.where(
+                visible[None, None, None], logits, NEG_INF
+            )
+            weights = jax.nn.softmax(
+                logits.astype(jnp.float32), axis=-1
+            ).astype(q.dtype)
+            out = jnp.einsum("bhgqk,bkhd->bqhgd", weights, values)
+            return out.reshape(b, t_q, h, d)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, keys) * scale
         logits = jnp.where(visible[None, None], logits, NEG_INF)
         weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", weights, values)
@@ -250,6 +299,7 @@ class TransformerBlock(nn.Module):
     mesh: Optional[Mesh] = None
     sequence_axis: Optional[str] = None
     sequence_mode: str = "ring"  # see Attention
+    n_kv_heads: int = 0  # GQA (see Attention); 0 = MHA
     n_experts: int = 0  # >0 swaps the dense MLP for an expert-parallel MoEMLP
     decode: bool = False
     remat_mlp: bool = False  # rematerialize only the MLP branch (see TransformerLM)
@@ -259,7 +309,8 @@ class TransformerBlock(nn.Module):
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         x = x + Attention(
             self.n_heads, self.d_model, self.dtype, self.causal,
-            self.mesh, self.sequence_axis,
+            n_kv_heads=self.n_kv_heads,
+            mesh=self.mesh, sequence_axis=self.sequence_axis,
             sequence_mode=self.sequence_mode, decode=self.decode,
             quantized_cache=self.quantized_cache, name="attention",
         )(nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x))
@@ -348,6 +399,7 @@ class TransformerLM(nn.Module):
     mesh: Optional[Mesh] = None
     sequence_axis: Optional[str] = None
     sequence_mode: str = "ring"  # "ring" | "ulysses" (see Attention)
+    n_kv_heads: int = 0  # grouped-query attention (see Attention); 0 = MHA
     n_experts: int = 0  # >0: MoE MLPs in every `moe_every`-th block
     moe_every: int = 2
     decode: bool = False  # KV-cache autoregressive mode (see generation.py)
@@ -376,7 +428,8 @@ class TransformerLM(nn.Module):
             x = block(
                 self.n_heads, self.d_model, self.d_ff, self.dtype,
                 True, self.mesh, self.sequence_axis,
-                sequence_mode=self.sequence_mode, n_experts=moe,
+                sequence_mode=self.sequence_mode,
+                n_kv_heads=self.n_kv_heads, n_experts=moe,
                 decode=self.decode, remat_mlp=remat_mlp,
                 quantized_cache=self.quantized_cache, name=f"block_{i}",
             )(x)

@@ -120,6 +120,7 @@ def _ring_attention_shard(
     causal: bool,
     flash_blocks=None,
     interpret: bool = False,
+    kv_groups: int = 1,
 ) -> jnp.ndarray:
     """Per-device body (runs under shard_map): per-hop local attention with
     online lse merging over rotating K/V blocks.
@@ -141,6 +142,12 @@ def _ring_attention_shard(
     t_local = q.shape[1]
 
     def hop(k_blk, v_blk, hop_causal, kv_index):
+        if kv_groups > 1:
+            # GQA: blocks ROTATE at kv-head size (the ICI saving); the
+            # broadcast to query heads is local per hop and fuses into the
+            # hop's attention math.
+            k_blk = jnp.repeat(k_blk, kv_groups, axis=2)
+            v_blk = jnp.repeat(v_blk, kv_groups, axis=2)
         if flash_blocks is not None:
             # hop_causal selects the kernel's own causal path for the
             # diagonal block (local positions align there: global offsets
@@ -341,8 +348,14 @@ def ring_attention(
     interpret: bool = False,
     block_q: Optional[int] = None,  # None: measured table (flash_autotune)
     block_k: Optional[int] = None,
+    kv_groups: int = 1,
 ) -> jnp.ndarray:
     """Sequence-parallel attention over globally-shaped arrays.
+
+    ``kv_groups > 1`` is grouped-query attention: ``k``/``v`` carry
+    ``H / kv_groups`` heads and ROTATE at that size (the ppermute bytes are
+    where sequence-parallel GQA saves); each hop broadcasts them to the
+    query heads locally.
 
     Inputs are global ``[B, T, H, D]`` arrays whose sequence dim is (to be)
     sharded along ``axis_name``; the shard_map splits them, runs the ring, and
@@ -384,17 +397,24 @@ def ring_attention(
         axis_if_divisible(mesh, heads_axis, q.shape[2]),
         None,
     )
+    kv_spec = P(
+        axis_if_divisible(mesh, batch_axis, k.shape[0]),
+        axis_name,
+        axis_if_divisible(mesh, heads_axis, k.shape[2]),
+        None,
+    )
     body = functools.partial(
         _ring_attention_shard,
         axis_name=axis_name,
         causal=causal,
         flash_blocks=hop_blocks,
         interpret=interpret,
+        kv_groups=kv_groups,
     )
     return jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, kv_spec, kv_spec),
         out_specs=spec,
         check_vma=False,
     )(q, k, v)

@@ -511,20 +511,15 @@ def flash_attention(
             # far faster than the Pallas interpreter — use it.
             return dot_product_attention(q, k, v, causal=causal)
         interpret = False
-    block_q, block_k = resolve_blocks(
-        block_q, block_k, t, d, q.dtype, causal, interpret
+    # The shared gate (resolve + fit + the Mosaic 128-lane rule): with
+    # use_flash=None it settles to False for untileable shapes -> dense
+    # fallback, exactly the old inline behavior.
+    use_flash, blocks = gate_flash_blocks(
+        t, d, q.dtype, causal, interpret, block_q, block_k, None
     )
-    block_q = _fit_block(block_q, t)
-    block_k = _fit_block(block_k, t)
-    if block_q is None or block_k is None:
+    if not use_flash:
         return dot_product_attention(q, k, v, causal=causal)
-    # block_k is the lane dimension of the [block_q, block_k] score tile; on
-    # real hardware Mosaic wants lanes in multiples of 128 (interpret mode
-    # doesn't care). Sequence lengths whose only divisors are smaller than
-    # that (e.g. T=40) take the dense path instead of risking a lowering
-    # failure or a badly tiled kernel.
-    if not interpret and block_k % 128 != 0:
-        return dot_product_attention(q, k, v, causal=causal)
+    block_q, block_k = blocks
 
     def run_local(ql, kl, vl):
         return flash_attention_4d(

@@ -32,6 +32,7 @@ def main(args):
         d_model=args.d_model,
         n_layers=args.n_layers,
         n_heads=args.n_heads,
+        n_kv_heads=args.n_kv_heads,
         d_ff=4 * args.d_model,
         dtype=jnp.float32 if args.f32 else jnp.bfloat16,
     )
@@ -92,6 +93,11 @@ if __name__ == "__main__":
     parser.add_argument("--d_model", type=int, default=128)
     parser.add_argument("--n_layers", type=int, default=4)
     parser.add_argument("--n_heads", type=int, default=4)
+    parser.add_argument(
+        "--n_kv_heads", type=int, default=0,
+        help="grouped-query attention: K/V heads (0 = n_heads/MHA, 1 = "
+        "MQA); the decode cache stores only these",
+    )
     parser.add_argument("--batch", type=int, default=4)
     parser.add_argument("--prompt_len", type=int, default=8)
     parser.add_argument("--new_tokens", type=int, default=32)

@@ -1,5 +1,5 @@
 """Rung 6 — beyond the reference ladder: long-context LM training with
-sequence parallelism (ring attention).
+sequence parallelism (ring attention, or ulysses all-to-all via --sp_mode).
 
 The reference has no attention code at all (SURVEY.md §5: "sequence length is
 not a concept in this codebase"); this rung exercises the framework machinery
@@ -10,6 +10,8 @@ so per-chip attention memory stays O(T / n_sequence_chips).
 
 Run:  python examples/longcontext_lm.py --steps 20 --seq_len 2048 \
           --data_parallel 2 --sequence_parallel 4 --fake_devices 8
+      # the all-to-all strategy (needs n_heads divisible by SP size):
+      python examples/longcontext_lm.py --sp_mode ulysses ...
 """
 
 import argparse
@@ -45,6 +47,7 @@ def main(args):
         remat_policy="full" if args.remat == "none" else args.remat,
         mesh=mesh,
         sequence_axis="sequence",
+        sequence_mode=args.sp_mode,
         fused_head_chunk=args.fused_head_chunk,
     )
     optimizer = optax.adamw(3e-4)
@@ -99,6 +102,11 @@ if __name__ == "__main__":
     parser.add_argument("--n_heads", default=4, type=int)
     parser.add_argument("--data_parallel", default=2, type=int)
     parser.add_argument("--sequence_parallel", default=4, type=int)
+    parser.add_argument(
+        "--sp_mode", default="ring", choices=["ring", "ulysses"],
+        help="sequence-parallel strategy: ring (K/V rotation, O(T/sp) "
+        "memory) or ulysses (all-to-all seq->heads, local full-T flash)",
+    )
     parser.add_argument(
         "--remat", default="none", choices=["none", "full", "mlp"],
         help="rematerialization: none (flash keeps activations linear in T — "

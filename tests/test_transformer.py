@@ -217,3 +217,108 @@ class TestRematPolicy:
         )
         with pytest.raises(ValueError, match="remat_policy"):
             model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+class TestGroupedQueryAttention:
+    """GQA (n_kv_heads < n_heads): K/V project to fewer heads, the decode
+    cache stores only those, and query groups share them — the standard
+    KV-cache cut, multiplicative with the int8 cache."""
+
+    GQA = dict(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        n_kv_heads=2,
+    )
+
+    def _tokens(self, b=2, t=16, seed=0):
+        rng = np.random.default_rng(seed)
+        return jnp.asarray(rng.integers(0, 64, (b, t)), jnp.int32)
+
+    def test_param_and_cache_shapes_shrink(self):
+        model = TransformerLM(**self.GQA)
+        tokens = self._tokens()
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        attn = params["block_0"]["attention"]
+        assert attn["query"]["kernel"].shape == (32, 4, 8)
+        assert attn["key"]["kernel"].shape == (32, 2, 8)
+        assert attn["value"]["kernel"].shape == (32, 2, 8)
+        cache = model.clone(decode=True).init(
+            jax.random.PRNGKey(0), tokens
+        )["cache"]
+        # The decode cache holds n_kv_heads — HALF the MHA bytes here.
+        assert cache["block_0"]["attention"]["cached_key"].shape == (
+            2, 16, 2, 8,
+        )
+
+    def test_decode_matches_full_forward(self):
+        """The incremental GQA decode path (small cache + post-read head
+        broadcast) must reproduce the full-context forward logits."""
+        model = TransformerLM(**self.GQA)
+        tokens = self._tokens()
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        full = model.apply({"params": params}, tokens)
+        dec = model.clone(decode=True)
+        cache = dec.init(jax.random.PRNGKey(0), tokens)["cache"]
+        steps = []
+        for t in range(tokens.shape[1]):
+            logits, updated = dec.apply(
+                {"params": params, "cache": cache},
+                tokens[:, t : t + 1],
+                mutable=["cache"],
+            )
+            cache = updated["cache"]
+            steps.append(logits[:, 0])
+        np.testing.assert_allclose(
+            np.asarray(jnp.stack(steps, axis=1)), np.asarray(full),
+            rtol=1e-4, atol=1e-4,
+        )
+
+    def test_nkv_equal_heads_is_exactly_mha(self):
+        mha = TransformerLM(**{**self.GQA, "n_kv_heads": 0})
+        gqa_full = TransformerLM(**{**self.GQA, "n_kv_heads": 4})
+        tokens = self._tokens()
+        params = mha.init(jax.random.PRNGKey(0), tokens)["params"]
+        out_a = mha.apply({"params": params}, tokens)
+        out_b = gqa_full.apply({"params": params}, tokens)  # same tree
+        np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
+
+    def test_rejects_indivisible_heads(self):
+        model = TransformerLM(**{**self.GQA, "n_kv_heads": 3})
+        with pytest.raises(ValueError, match="n_kv_heads"):
+            model.init(jax.random.PRNGKey(0), self._tokens())
+
+    def test_int8_cache_composes(self):
+        from distributed_pytorch_tpu.generation import generate
+
+        model = TransformerLM(**self.GQA)
+        tokens = self._tokens()
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        dec = model.clone(decode=True, quantized_cache=True)
+        cache = dec.init(jax.random.PRNGKey(0), tokens)["cache"]
+        entry = cache["block_0"]["attention"]
+        assert entry["cached_key"].dtype == jnp.int8
+        assert entry["cached_key"].shape == (2, 16, 2, 8)
+        assert entry["key_scale"].shape == (2, 16, 2)
+        out = generate(
+            model, params, tokens[:, :8], 5, quantized_cache=True
+        )
+        assert out.shape == (2, 13)
+
+    def test_sequence_parallel_modes_match_dense(self):
+        """GQA broadcast happens before the SP cores, so ring and ulysses
+        must both reproduce the dense GQA logits. n_heads=4 = sp size after
+        broadcast; kv stays at 2."""
+        mesh = make_mesh({"data": 2, "sequence": 4})
+        dense = TransformerLM(**self.GQA)
+        tokens = self._tokens(t=32)
+        variables = dense.init(jax.random.PRNGKey(0), tokens)
+        ref = dense.apply(variables, tokens)
+        for mode in ("ring", "ulysses"):
+            sp = TransformerLM(
+                **self.GQA, mesh=mesh, sequence_axis="sequence",
+                sequence_mode=mode,
+            )
+            out = sp.apply(variables, tokens)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4,
+                err_msg=mode,
+            )

@@ -221,33 +221,25 @@ class Attention(nn.Module):
         visible = (
             jnp.arange(max_len)[None, :] <= (index + jnp.arange(t_step))[:, None]
         )
+        # ONE attention path for MHA and GQA: grouped einsums against the
+        # (small) cache — the query is reshaped [B, t, Hkv, G, D] and
+        # contracted directly with the [B, T, Hkv, D] cache, so the
+        # n_heads-sized K/V tensors are never materialized (a jnp.repeat
+        # here would make XLA write and re-read group x the cache bytes GQA
+        # exists to avoid). MHA is simply group=1 (the reshape is a no-op
+        # expand). Head order matches the forward path's jnp.repeat: query
+        # head h shares kv head h // group, so kv leads group.
         kv_heads = keys.shape[2]
-        if kv_heads != q.shape[2]:
-            # GQA: GROUPED einsums against the small cache — the query is
-            # reshaped [B, t, G, Hkv, D] and contracted directly with the
-            # [B, T, Hkv, D] cache, so the n_heads-sized K/V tensors are
-            # never materialized (a jnp.repeat here would make XLA write
-            # and re-read group x the cache bytes the feature exists to
-            # avoid).
-            b, t_q, h, d = q.shape
-            group = h // kv_heads
-            # Head order must match the forward path's jnp.repeat (query
-            # head h shares kv head h // group), so the kv dim leads the
-            # group dim in the reshape.
-            qg = q.reshape(b, t_q, kv_heads, group, d)
-            logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, keys) * scale
-            logits = jnp.where(
-                visible[None, None, None], logits, NEG_INF
-            )
-            weights = jax.nn.softmax(
-                logits.astype(jnp.float32), axis=-1
-            ).astype(q.dtype)
-            out = jnp.einsum("bhgqk,bkhd->bqhgd", weights, values)
-            return out.reshape(b, t_q, h, d)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, keys) * scale
-        logits = jnp.where(visible[None, None], logits, NEG_INF)
-        weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", weights, values)
+        b, t_q, h, d = q.shape
+        group = h // kv_heads
+        qg = q.reshape(b, t_q, kv_heads, group, d)
+        logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, keys) * scale
+        logits = jnp.where(visible[None, None, None], logits, NEG_INF)
+        weights = jax.nn.softmax(
+            logits.astype(jnp.float32), axis=-1
+        ).astype(q.dtype)
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", weights, values)
+        return out.reshape(b, t_q, h, d)
 
     def _update_quantized_cache(self, cached_key, cached_value, k, v, index):
         """Write this step's k/v as int8 + per-(token, head) float32 scales,

@@ -391,16 +391,27 @@ def ring_attention(
         t_local, q.shape[-1], q.dtype, causal, interpret,
         block_q, block_k, use_flash,
     )
+    q_heads_spec = axis_if_divisible(mesh, heads_axis, q.shape[2])
+    kv_heads_spec = axis_if_divisible(mesh, heads_axis, k.shape[2])
+    if q_heads_spec is not None and kv_heads_spec is None and kv_groups > 1:
+        # Launch-time guard: sharded query heads with replicated kv heads
+        # would mismatch per-device head counts only deep inside shard_map.
+        raise ValueError(
+            f"GQA ring attention under tensor parallelism needs the kv "
+            f"head count ({k.shape[2]}) divisible by mesh axis "
+            f"{heads_axis!r} ({mesh.shape[heads_axis]}); keep "
+            "n_kv_heads % tp == 0"
+        )
     spec = P(
         axis_if_divisible(mesh, batch_axis, q.shape[0]),
         axis_name,
-        axis_if_divisible(mesh, heads_axis, q.shape[2]),
+        q_heads_spec,
         None,
     )
     kv_spec = P(
         axis_if_divisible(mesh, batch_axis, k.shape[0]),
         axis_name,
-        axis_if_divisible(mesh, heads_axis, k.shape[2]),
+        kv_heads_spec,
         None,
     )
     body = functools.partial(

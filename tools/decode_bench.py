@@ -33,6 +33,10 @@ def main():
     p.add_argument("--d_model", type=int, default=1024)
     p.add_argument("--n_layers", type=int, default=12)
     p.add_argument("--n_heads", type=int, default=8)
+    p.add_argument("--n_kv_heads", type=int, default=0,
+                   help="grouped-query attention (0 = MHA): the long-decode "
+                   "cache pair then measures the GQA cache cut on top of "
+                   "int8 — run once with 0 and once with e.g. 2 to A/B")
     p.add_argument("--d_ff", type=int, default=4096)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--prompt_len", type=int, default=16)
@@ -62,6 +66,7 @@ def main():
         d_model=args.d_model,
         n_layers=args.n_layers,
         n_heads=args.n_heads,
+        n_kv_heads=args.n_kv_heads,
         d_ff=args.d_ff,
         dtype=jnp.bfloat16,
     )
@@ -144,7 +149,8 @@ def main():
             {
                 "config": (
                     f"d_model={args.d_model} L={args.n_layers} "
-                    f"heads={args.n_heads} d_ff={args.d_ff} "
+                    f"heads={args.n_heads} kv_heads={args.n_kv_heads or args.n_heads} "
+                    f"d_ff={args.d_ff} "
                     f"vocab={args.vocab} B={args.batch} "
                     f"new_tokens={args.new_tokens}"
                 ),

@@ -85,6 +85,12 @@ class Attention(nn.Module):
     # Under TP, the K/V kernels shard over n_kv_heads: needs
     # n_kv_heads % tp == 0 (keep kv heads >= the tensor axis).
     n_kv_heads: int = 0
+    # Sliding-window (Mistral-style local) attention: position q attends
+    # keys in (q - window, q]. 0 = full causal. Compute per layer drops
+    # toward O(T * window) — the flash kernel skips out-of-band tiles —
+    # and in decode the visibility mask bounds reads the same way. Not yet
+    # composed with sequence parallelism (explicit error, no silent cap).
+    window: int = 0
     mesh: Optional[Mesh] = None
     sequence_axis: Optional[str] = None
     # How to parallelize attention over the sequence axis: "ring" (K/V
@@ -110,6 +116,10 @@ class Attention(nn.Module):
                 f"unknown sequence_mode {self.sequence_mode!r} "
                 "(expected 'ring' or 'ulysses')"
             )
+        if self.window < 0:
+            raise ValueError(f"window must be >= 0, got {self.window}")
+        if self.window and not self.causal:
+            raise ValueError("window requires causal attention")
         head_dim = self.d_model // self.n_heads
         kv_heads = self.n_kv_heads or self.n_heads
         if self.n_heads % kv_heads:
@@ -164,6 +174,11 @@ class Attention(nn.Module):
             and self.sequence_axis is not None
             and self.mesh.shape.get(self.sequence_axis, 1) > 1
         )
+        if use_ring and self.window:
+            raise ValueError(
+                "sliding-window attention is not composed with sequence "
+                "parallelism yet; drop window= or the sequence axis"
+            )
         if use_ring and self.sequence_mode == "ulysses":
             # Pre-repeat is structural here: the all-to-all splits the
             # (query) head dim across the axis, so K/V must carry the same
@@ -182,7 +197,8 @@ class Attention(nn.Module):
             )
         else:
             out = flash_attention(
-                q, kx, vx, causal=self.causal, mesh=self.mesh
+                q, kx, vx, causal=self.causal, window=self.window,
+                mesh=self.mesh,
             )
         return nn.DenseGeneral(
             self.d_model, axis=(-2, -1), dtype=self.dtype, name="out"
@@ -217,10 +233,13 @@ class Attention(nn.Module):
             keys, values = cached_key.value, cached_value.value
         cache_index.value = index + t_step
         scale = q.shape[-1] ** -0.5
-        # Position k is visible to step-q q when k <= index + q.
-        visible = (
-            jnp.arange(max_len)[None, :] <= (index + jnp.arange(t_step))[:, None]
-        )
+        # Position k is visible to step-q q when k <= index + q (and, with
+        # a sliding window, within the last `window` positions).
+        q_abs = (index + jnp.arange(t_step))[:, None]
+        k_abs = jnp.arange(max_len)[None, :]
+        visible = k_abs <= q_abs
+        if self.window:
+            visible = visible & (q_abs - k_abs < self.window)
         # ONE attention path for MHA and GQA: grouped einsums against the
         # (small) cache — the query is reshaped [B, t, Hkv, G, D] and
         # contracted directly with the [B, T, Hkv, D] cache, so the
@@ -292,6 +311,7 @@ class TransformerBlock(nn.Module):
     sequence_axis: Optional[str] = None
     sequence_mode: str = "ring"  # see Attention
     n_kv_heads: int = 0  # GQA (see Attention); 0 = MHA
+    window: int = 0  # sliding-window attention (see Attention); 0 = full
     n_experts: int = 0  # >0 swaps the dense MLP for an expert-parallel MoEMLP
     decode: bool = False
     remat_mlp: bool = False  # rematerialize only the MLP branch (see TransformerLM)
@@ -301,7 +321,7 @@ class TransformerBlock(nn.Module):
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         x = x + Attention(
             self.n_heads, self.d_model, self.dtype, self.causal,
-            n_kv_heads=self.n_kv_heads,
+            n_kv_heads=self.n_kv_heads, window=self.window,
             mesh=self.mesh, sequence_axis=self.sequence_axis,
             sequence_mode=self.sequence_mode, decode=self.decode,
             quantized_cache=self.quantized_cache, name="attention",
@@ -392,6 +412,7 @@ class TransformerLM(nn.Module):
     sequence_axis: Optional[str] = None
     sequence_mode: str = "ring"  # "ring" | "ulysses" (see Attention)
     n_kv_heads: int = 0  # grouped-query attention (see Attention); 0 = MHA
+    attention_window: int = 0  # sliding-window attention; 0 = full causal
     n_experts: int = 0  # >0: MoE MLPs in every `moe_every`-th block
     moe_every: int = 2
     decode: bool = False  # KV-cache autoregressive mode (see generation.py)
@@ -421,7 +442,8 @@ class TransformerLM(nn.Module):
                 self.n_heads, self.d_model, self.d_ff, self.dtype,
                 True, self.mesh, self.sequence_axis,
                 sequence_mode=self.sequence_mode,
-                n_kv_heads=self.n_kv_heads, n_experts=moe,
+                n_kv_heads=self.n_kv_heads, window=self.attention_window,
+                n_experts=moe,
                 decode=self.decode, remat_mlp=remat_mlp,
                 quantized_cache=self.quantized_cache, name=f"block_{i}",
             )(x)

@@ -59,14 +59,24 @@ def dot_product_attention(
     v: jnp.ndarray,
     *,
     causal: bool = False,
+    window: int = 0,
 ) -> jnp.ndarray:
-    """Reference attention. ``q``: [B, Tq, H, D]; ``k``/``v``: [B, Tk, H, D]."""
+    """Reference attention. ``q``: [B, Tq, H, D]; ``k``/``v``: [B, Tk, H, D].
+    ``window > 0`` (causal only): position q sees keys in ``(q-window, q]``
+    — the sliding-window (local) attention reference."""
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("window requires causal=True")
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         q_pos = jnp.arange(q.shape[1])[:, None]
         kv_pos = jnp.arange(k.shape[1])[None, :]
-        logits = jnp.where(q_pos >= kv_pos, logits, NEG_INF)
+        ok = q_pos >= kv_pos
+        if window:
+            ok = ok & (q_pos - kv_pos < window)
+        logits = jnp.where(ok, logits, NEG_INF)
     weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 

@@ -48,15 +48,35 @@ from distributed_pytorch_tpu.ops.attention import (
 )
 
 
-def _causal_mask(s, q_start, k_start):
+def _causal_mask(s, q_start, k_start, window=0):
+    """Causal mask, optionally banded: with ``window > 0`` position q sees
+    only keys in ``(q - window, q]`` — sliding-window (Mistral-style local)
+    attention."""
     bq, bk = s.shape
     q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+    ok = q_pos >= k_pos
+    if window:
+        ok = ok & (q_pos - k_pos < window)
+    return jnp.where(ok, s, NEG_INF)
+
+
+def _tile_live(q_start, block_q, k_start, block_k, causal, window):
+    """Whether any (q, k) pair in this tile is unmasked: the causal upper
+    bound plus (when windowed) the band's lower bound. Static Python bools
+    when causal=False; traced predicates otherwise — both fine for
+    ``pl.when``."""
+    if not causal:
+        return True
+    live = k_start <= q_start + block_q - 1
+    if window:
+        live = live & (q_start - (k_start + block_k - 1) <= window - 1)
+    return live
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, scale, causal
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, scale, causal, window=0,
 ):
     block_q, d = q_ref.shape[1:]
     block_k = k_ref.shape[1]
@@ -70,9 +90,10 @@ def _fwd_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # Causal: tiles fully above the diagonal contribute nothing — skip the
-    # MXU work (the tile DMA still happens; the grid is static).
-    live = True if not causal else k_start <= q_start + block_q - 1
+    # Causal: tiles fully above the diagonal contribute nothing; windowed:
+    # neither do tiles fully below the band — skip the MXU work for both
+    # (the tile DMA still happens; the grid is static).
+    live = _tile_live(q_start, block_q, k_start, block_k, causal, window)
 
     @pl.when(live)
     def _step():
@@ -90,7 +111,7 @@ def _fwd_kernel(
             * scale
         )  # [block_q, block_k] f32
         if causal:
-            s = _causal_mask(s, q_start, k_start)
+            s = _causal_mask(s, q_start, k_start, window)
         m_prev = m_scr[:, :1]  # [block_q, 1]
         l_prev = l_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -114,7 +135,7 @@ def _fwd_kernel(
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, scale, causal,
+    *, scale, causal, window=0,
 ):
     block_q, d = q_ref.shape[1:]
     block_k = k_ref.shape[1]
@@ -126,7 +147,7 @@ def _bwd_dq_kernel(
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    live = True if not causal else k_start <= q_start + block_q - 1
+    live = _tile_live(q_start, block_q, k_start, block_k, causal, window)
 
     @pl.when(live)
     def _step():
@@ -144,7 +165,7 @@ def _bwd_dq_kernel(
             * scale
         )
         if causal:
-            s = _causal_mask(s, q_start, k_start)
+            s = _causal_mask(s, q_start, k_start, window)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
@@ -163,7 +184,7 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr, *, scale, causal,
+    dk_scr, dv_scr, *, scale, causal, window=0,
 ):
     block_k, d = k_ref.shape[1:]
     block_q = q_ref.shape[1]
@@ -176,7 +197,7 @@ def _bwd_dkv_kernel(
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    live = True if not causal else q_start + block_q - 1 >= k_start
+    live = _tile_live(q_start, block_q, k_start, block_k, causal, window)
 
     @pl.when(live)
     def _step():
@@ -194,7 +215,7 @@ def _bwd_dkv_kernel(
             * scale
         )  # [block_q, block_k] f32
         if causal:
-            s = _causal_mask(s, q_start, k_start)
+            s = _causal_mask(s, q_start, k_start, window)
         p = jnp.exp(s - lse_blk)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
@@ -248,16 +269,18 @@ def _swap_q(spec_fn, block, *rest):
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, interpret, window=0):
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret, window)
     return out
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, window=0):
     bh, seq, d = q.shape
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=d**-0.5, causal=causal),
+        functools.partial(
+            _fwd_kernel, scale=d**-0.5, causal=causal, window=window
+        ),
         grid=(bh, seq // block_q, seq // block_k),
         in_specs=[
             _q_spec(block_q, d),
@@ -279,22 +302,27 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+def _flash_bwd(causal, block_q, block_k, interpret, window, residuals, g):
     q, k, v, out, lse = residuals
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )[:, :, None]
     return _flash_bwd_impl(
-        causal, block_q, block_k, interpret, q, k, v, lse, g, delta
+        causal, block_q, block_k, interpret, q, k, v, lse, g, delta,
+        window=window,
     )
 
 
-def _flash_bwd_impl(causal, block_q, block_k, interpret, q, k, v, lse, g, delta):
+def _flash_bwd_impl(
+    causal, block_q, block_k, interpret, q, k, v, lse, g, delta, window=0
+):
     bh, seq, d = q.shape
     scale = d**-0.5
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal),
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, window=window
+        ),
         grid=(bh, seq // block_q, seq // block_k),
         in_specs=[
             _q_spec(block_q, d),
@@ -311,7 +339,9 @@ def _flash_bwd_impl(causal, block_q, block_k, interpret, q, k, v, lse, g, delta)
     )(q, k, v, g, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal),
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, window=window
+        ),
         grid=(bh, seq // block_k, seq // block_q),
         in_specs=[
             _swap_q(_q_spec, block_q, d),
@@ -378,7 +408,9 @@ def _flash_lse_bwd(causal, block_q, block_k, interpret, residuals, cotangents):
 flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
-def flash_attention_4d(q, k, v, *, causal, block_q, block_k, interpret):
+def flash_attention_4d(
+    q, k, v, *, causal, block_q, block_k, interpret, window=0
+):
     """``[B, T, H, D]`` through the 3-D Pallas kernel and back — THE layout
     shim between the model convention and the kernel's ``[B*H, T, D]``.
     Shared by :func:`flash_attention`'s local body and
@@ -389,7 +421,9 @@ def flash_attention_4d(q, k, v, *, causal, block_q, block_k, interpret):
     def to3(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
 
-    out = _flash(to3(q), to3(k), to3(v), causal, block_q, block_k, interpret)
+    out = _flash(
+        to3(q), to3(k), to3(v), causal, block_q, block_k, interpret, window
+    )
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
@@ -483,6 +517,7 @@ def flash_attention(
     v: jnp.ndarray,
     *,
     causal: bool = False,
+    window: int = 0,
     block_q: int | None = None,  # None: measured table / FLASH_AUTOTUNE sweep
     block_k: int | None = None,
     interpret: bool | None = None,
@@ -498,18 +533,30 @@ def flash_attention(
     sequence length, or when running on a non-TPU backend without
     ``interpret``.
 
+    ``window > 0`` (causal only) is sliding-window attention: position q
+    attends keys in ``(q - window, q]``. Tiles fully outside the band are
+    SKIPPED (their DMA happens; their MXU work does not), so compute per
+    step drops from O(T^2) toward O(T * window) as T grows past the window
+    — the Mistral-style long-context compute lever.
+
     GSPMD cannot partition a ``pallas_call``, so under a sharded jit the bare
     kernel would make XLA all-gather the global batch onto every chip. Pass
     ``mesh`` (as the :class:`Attention` module does) to run the kernel under
     ``shard_map`` instead: each device computes only its ``batch_axis`` /
     ``heads_axis`` shard, preserving data/tensor parallelism.
     """
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("window requires causal=True")
     b, t, h, d = q.shape
     if interpret is None:
         if not on_tpu():
             # No TPU and no explicit interpret request: the dense XLA path is
             # far faster than the Pallas interpreter — use it.
-            return dot_product_attention(q, k, v, causal=causal)
+            return dot_product_attention(
+                q, k, v, causal=causal, window=window
+            )
         interpret = False
     # The shared gate (resolve + fit + the Mosaic 128-lane rule): with
     # use_flash=None it settles to False for untileable shapes -> dense
@@ -518,13 +565,13 @@ def flash_attention(
         t, d, q.dtype, causal, interpret, block_q, block_k, None
     )
     if not use_flash:
-        return dot_product_attention(q, k, v, causal=causal)
+        return dot_product_attention(q, k, v, causal=causal, window=window)
     block_q, block_k = blocks
 
     def run_local(ql, kl, vl):
         return flash_attention_4d(
             ql, kl, vl, causal=causal, block_q=block_q, block_k=block_k,
-            interpret=interpret,
+            interpret=interpret, window=window,
         )
 
     if mesh is None:

@@ -33,6 +33,7 @@ def main(args):
         n_layers=args.n_layers,
         n_heads=args.n_heads,
         n_kv_heads=args.n_kv_heads,
+        attention_window=args.window,
         d_ff=4 * args.d_model,
         dtype=jnp.float32 if args.f32 else jnp.bfloat16,
     )
@@ -97,6 +98,11 @@ if __name__ == "__main__":
         "--n_kv_heads", type=int, default=0,
         help="grouped-query attention: K/V heads (0 = n_heads/MHA, 1 = "
         "MQA); the decode cache stores only these",
+    )
+    parser.add_argument(
+        "--window", type=int, default=0,
+        help="sliding-window attention: each position attends the last W "
+        "tokens only (0 = full causal)",
     )
     parser.add_argument("--batch", type=int, default=4)
     parser.add_argument("--prompt_len", type=int, default=8)

@@ -100,3 +100,82 @@ def test_cpu_backend_defaults_to_dense():
     out = flash_attention(q, k, v, causal=False)
     ref = dot_product_attention(q, k, v, causal=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=0)
+
+
+class TestSlidingWindow:
+    """window > 0: banded causal attention — kernel must match the dense
+    banded reference exactly, including tiles straddling the band edges and
+    degenerate windows (1 = self-only, > T = plain causal)."""
+
+    def _qkv(self, b=2, t=64, h=2, d=16, seed=3):
+        rng = np.random.default_rng(seed)
+        return tuple(
+            jnp.asarray(rng.standard_normal((b, t, h, d)) * 0.5, jnp.float32)
+            for _ in range(3)
+        )
+
+    @pytest.mark.parametrize("window", [1, 5, 16, 40, 200])
+    def test_forward_matches_dense_band(self, window):
+        q, k, v = self._qkv()
+        ref = dot_product_attention(q, k, v, causal=True, window=window)
+        out = flash_attention(
+            q, k, v, causal=True, window=window, interpret=True,
+            block_q=16, block_k=16,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("window", [5, 16, 40])
+    def test_gradients_match_dense_band(self, window):
+        q, k, v = self._qkv()
+
+        def dense_loss(q, k, v):
+            return jnp.sum(
+                dot_product_attention(q, k, v, causal=True, window=window)
+                ** 2
+            )
+
+        def flash_loss(q, k, v):
+            return jnp.sum(
+                flash_attention(
+                    q, k, v, causal=True, window=window, interpret=True,
+                    block_q=16, block_k=16,
+                )
+                ** 2
+            )
+
+        ref = jax.grad(dense_loss, (0, 1, 2))(q, k, v)
+        got = jax.grad(flash_loss, (0, 1, 2))(q, k, v)
+        for a, b in zip(got, ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            )
+
+    def test_window_equals_full_causal_when_large(self):
+        q, k, v = self._qkv(t=32)
+        full = flash_attention(
+            q, k, v, causal=True, interpret=True, block_q=16, block_k=16
+        )
+        banded = flash_attention(
+            q, k, v, causal=True, window=32, interpret=True,
+            block_q=16, block_k=16,
+        )
+        np.testing.assert_allclose(
+            np.asarray(banded), np.asarray(full), rtol=1e-6
+        )
+
+    def test_window_requires_causal(self):
+        q, k, v = self._qkv(t=16)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, causal=False, window=4)
+        with pytest.raises(ValueError, match="causal"):
+            dot_product_attention(q, k, v, causal=False, window=4)
+
+    def test_negative_window_rejected(self):
+        q, k, v = self._qkv(t=16)
+        with pytest.raises(ValueError, match=">= 0"):
+            flash_attention(q, k, v, causal=True, window=-1)
+        with pytest.raises(ValueError, match=">= 0"):
+            dot_product_attention(q, k, v, causal=True, window=-1)

@@ -266,6 +266,122 @@ def test_cross_process_ring_attention_parity(tmp_path):
     assert all("RING_PARITY_OK" in out for out in outs)
 
 
+ULYSSES_WORKER = '''
+"""2-process x 4-device ulysses parity worker: the seq<->head all_to_all
+crosses PROCESS boundaries (a real pod's configuration), each process
+holding 2 of the 4 sequence shards; heads redistribute across both."""
+import numpy as np
+
+from distributed_pytorch_tpu.utils.platform import use_fake_cpu_devices
+
+use_fake_cpu_devices(2)
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_pytorch_tpu import setup_distributed, shutdown_distributed
+from distributed_pytorch_tpu.ops.attention import (
+    dot_product_attention,
+    ulysses_attention,
+)
+
+setup_distributed()
+assert jax.device_count() == 4 and jax.process_count() == 2
+from jax.sharding import Mesh
+
+mesh = Mesh(np.asarray(jax.devices()).reshape(4), ("sequence",))
+
+rng = np.random.default_rng(0)
+b, t, h, d = 2, 64, 4, 16
+full = [rng.standard_normal((b, t, h, d)).astype(np.float32) for _ in range(3)]
+sharding = NamedSharding(mesh, P(None, "sequence"))
+q, k, v = (
+    jax.make_array_from_callback((b, t, h, d), sharding, lambda idx, a=a: a[idx])
+    for a in full
+)
+
+
+def uly_loss(q, k, v):
+    out = ulysses_attention(q, k, v, mesh=mesh, causal=True)
+    return jnp.sum(out.astype(jnp.float32) ** 2), out
+
+
+(loss, out), dq = jax.jit(
+    jax.value_and_grad(uly_loss, has_aux=True),
+    in_shardings=(sharding,) * 3,
+    out_shardings=((None, sharding), sharding),
+)(q, k, v)
+
+from jax.experimental import multihost_utils
+
+out_full = np.asarray(multihost_utils.process_allgather(out, tiled=True))
+dq_full = np.asarray(multihost_utils.process_allgather(dq, tiled=True))
+
+ref = dot_product_attention(*map(jnp.asarray, full), causal=True)
+
+
+def ref_loss(q):
+    return jnp.sum(
+        dot_product_attention(q, jnp.asarray(full[1]), jnp.asarray(full[2]),
+                              causal=True) ** 2
+    )
+
+
+ref_dq = jax.grad(ref_loss)(jnp.asarray(full[0]))
+np.testing.assert_allclose(out_full, np.asarray(ref), rtol=1e-4, atol=1e-4)
+np.testing.assert_allclose(dq_full, np.asarray(ref_dq), rtol=1e-3, atol=1e-3)
+print("ULYSSES_PARITY_OK", flush=True)
+shutdown_distributed()
+'''
+
+
+@pytest.mark.slow
+def test_cross_process_ulysses_parity(tmp_path):
+    """The ulysses all_to_all crosses process boundaries: 2 processes x 2
+    fake devices, sequence axis of 4 spanning both; output AND dq must match
+    the dense single-host reference on the same arrays."""
+    import textwrap
+
+    worker = tmp_path / "ulysses_worker.py"
+    worker.write_text(textwrap.dedent(ULYSSES_WORKER))
+    port = free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(
+            os.environ,
+            COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            NUM_PROCESSES="2",
+            PROCESS_ID=str(pid),
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=REPO,
+        )
+        env.pop("XLA_FLAGS", None)  # the worker sets device count itself
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(worker)],
+                cwd=tmp_path,
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"ulysses worker failed:\n{out}"
+    assert all("ULYSSES_PARITY_OK" in out for out in outs)
+
+
 ZERO1_WORKER = '''
 """2-process x 2-device ZeRO-1 worker: Trainer(partition_specs=) with Adam
 moments sharded over a data axis that SPANS PROCESS BOUNDARIES — each

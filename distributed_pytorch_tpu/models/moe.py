@@ -4,11 +4,13 @@ axis.
 No reference analog (SURVEY.md §2b: EP absent from the reference) — this is a
 beyond-parity capability, built the TPU way:
 
-* **Routing** is Switch-Transformer-style deterministic top-1: a per-token
-  router picks one expert; each expert processes at most
-  ``capacity = ceil(tokens_per_group * capacity_factor / n_experts)`` tokens
-  per group (group = one batch row); overflow tokens fall through the residual
-  connection (their MoE output is zero).
+* **Routing** is deterministic top-1 (Switch-Transformer) or top-2
+  (GShard-style, ``router_top_k=2``): the router picks the k best experts
+  per token, gates renormalized over the kept choices; each expert
+  processes at most ``capacity = ceil(k * tokens_per_group *
+  capacity_factor / n_experts)`` tokens per group (group = one batch row),
+  secondary assignments queue behind primaries for slots; overflow tokens
+  fall through the residual connection (their MoE output is zero).
 * **Dispatch/combine are einsums** against a one-hot ``[B, T, E, C]`` tensor —
   dense, static-shaped, MXU-friendly; no gather/scatter, no dynamic shapes,
   exactly what XLA tiles well.
@@ -51,14 +53,19 @@ MOE_EP_RULES: Rules = (
 class MoEMLP(nn.Module):
     """Drop-in replacement for the dense transformer MLP block.
 
-    ``[B, T, d_model] -> [B, T, d_model]`` with top-1 routing over
-    ``n_experts`` expert MLPs of width ``d_ff``.
+    ``[B, T, d_model] -> [B, T, d_model]`` with top-1 (Switch) or top-2
+    (GShard-style, ``router_top_k=2``) routing over ``n_experts`` expert
+    MLPs of width ``d_ff``.
     """
 
     n_experts: int
     d_ff: int
     d_model: int
     dtype: Any = jnp.float32
+    # 1 = Switch top-1; 2 = GShard-style deterministic top-2 (gates
+    # renormalized over the two chosen experts, primary assignments take
+    # capacity slots before secondaries).
+    router_top_k: int = 1
     capacity_factor: float = 1.25
     aux_weight: float = 1e-2
     mesh: Optional[Mesh] = None
@@ -81,35 +88,71 @@ class MoEMLP(nn.Module):
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         n_batch, n_tokens, d_model = x.shape
         n_exp = self.n_experts
-        capacity = max(1, math.ceil(n_tokens * self.capacity_factor / n_exp))
+        k = self.router_top_k
+        if k not in (1, 2):
+            raise ValueError(f"router_top_k must be 1 or 2, got {k}")
+        if k > n_exp:
+            # With the primary masked out, a second choice doesn't exist:
+            # argmax over all-zero probs would silently re-pick the primary
+            # at half weight.
+            raise ValueError(
+                f"router_top_k={k} needs at least {k} experts, got {n_exp}"
+            )
+        capacity = max(
+            1, math.ceil(k * n_tokens * self.capacity_factor / n_exp)
+        )
 
-        # --- route: deterministic top-1 per token ------------------------
+        # --- route: deterministic top-k per token ------------------------
         router_logits = nn.Dense(n_exp, dtype=jnp.float32, name="router")(
             x.astype(jnp.float32)
         )
         probs = jax.nn.softmax(router_logits, axis=-1)  # [B, T, E]
-        expert_index = jnp.argmax(probs, axis=-1)  # [B, T]
-        onehot = jax.nn.one_hot(expert_index, n_exp, dtype=jnp.float32)
+        idx1 = jnp.argmax(probs, axis=-1)  # [B, T]
+        oh1 = jax.nn.one_hot(idx1, n_exp, dtype=jnp.float32)
 
-        # Load-balance aux loss (Switch eq. 4): E * mean_load . mean_prob.
-        load = jnp.mean(onehot, axis=(0, 1))  # fraction routed per expert
+        # Load-balance aux loss (Switch eq. 4 over the PRIMARY assignment):
+        # E * mean_load . mean_prob.
+        load = jnp.mean(oh1, axis=(0, 1))  # fraction routed per expert
         importance = jnp.mean(probs, axis=(0, 1))  # mean router prob
         aux = n_exp * jnp.sum(load * importance)
         self.sow("losses", "moe_aux", self.aux_weight * aux)
 
-        # Position of each token within its expert's capacity (1-based).
-        position = jnp.cumsum(onehot, axis=1) * onehot  # [B, T, E]
-        keep = (position > 0) & (position <= capacity)
-        dispatch = jnp.where(keep, 1.0, 0.0)  # [B, T, E]
-        # [B, T, E, C] one-hot over capacity slots.
-        # position is 0 for unrouted (token, expert) pairs -> index -1 -> all-
-        # zero one-hot row, which is exactly the "no slot" encoding we want.
-        slot = jax.nn.one_hot(
-            position.astype(jnp.int32) - 1, capacity, dtype=jnp.float32
-        )
-        dispatch_t = slot * dispatch[..., None]
-        gate = jnp.sum(probs * dispatch, axis=-1, keepdims=True)  # chosen prob
-        combine_t = dispatch_t * gate[..., None]
+        def slots(position, keep):
+            # [B, T, E, C] one-hot over capacity slots; position is 0 for
+            # unrouted (token, expert) pairs -> index -1 -> all-zero row,
+            # exactly the "no slot" encoding we want.
+            return jax.nn.one_hot(
+                position.astype(jnp.int32) - 1, capacity, dtype=jnp.float32
+            ) * jnp.where(keep, 1.0, 0.0)[..., None]
+
+        # Primary choice: position within each expert's capacity (1-based).
+        pos1 = jnp.cumsum(oh1, axis=1) * oh1  # [B, T, E]
+        keep1 = (pos1 > 0) & (pos1 <= capacity)
+        disp1 = slots(pos1, keep1)
+        gate1 = jnp.sum(probs * oh1, axis=-1)  # [B, T]
+
+        if k == 2:
+            # Secondary = best expert with the primary masked out; its
+            # tokens queue BEHIND every primary assignment of that expert
+            # (GShard priority), sharing one capacity budget.
+            probs2 = probs * (1.0 - oh1)
+            idx2 = jnp.argmax(probs2, axis=-1)
+            oh2 = jax.nn.one_hot(idx2, n_exp, dtype=jnp.float32)
+            count1 = jnp.sum(oh1, axis=1, keepdims=True)  # [B, 1, E]
+            pos2 = (jnp.cumsum(oh2, axis=1) + count1) * oh2
+            keep2 = (pos2 > 0) & (pos2 <= capacity)
+            disp2 = slots(pos2, keep2)
+            gate2 = jnp.sum(probs * oh2, axis=-1)
+            # Renormalize over the two chosen experts, then zero dropped
+            # assignments (kept one keeps its renormalized share).
+            denom = gate1 + gate2 + 1e-9
+            g1 = gate1 / denom
+            g2 = gate2 / denom
+            dispatch_t = disp1 + disp2  # disjoint slots by construction
+            combine_t = disp1 * g1[..., None, None] + disp2 * g2[..., None, None]
+        else:
+            dispatch_t = disp1
+            combine_t = disp1 * gate1[..., None, None]
 
         # --- dispatch -> experts -> combine ------------------------------
         w_up = self.param(

@@ -313,6 +313,7 @@ class TransformerBlock(nn.Module):
     n_kv_heads: int = 0  # GQA (see Attention); 0 = MHA
     window: int = 0  # sliding-window attention (see Attention); 0 = full
     n_experts: int = 0  # >0 swaps the dense MLP for an expert-parallel MoEMLP
+    moe_top_k: int = 1  # router choices per token (see models/moe.py)
     decode: bool = False
     remat_mlp: bool = False  # rematerialize only the MLP branch (see TransformerLM)
     quantized_cache: bool = False  # int8 KV cache in decode (see Attention)
@@ -330,7 +331,7 @@ class TransformerBlock(nn.Module):
             cls = nn.remat(MoEMLP) if self.remat_mlp else MoEMLP
             mlp = cls(
                 self.n_experts, self.d_ff, self.d_model, self.dtype,
-                mesh=self.mesh, name="moe",
+                router_top_k=self.moe_top_k, mesh=self.mesh, name="moe",
             )
         else:
             cls = nn.remat(MLPBlock) if self.remat_mlp else MLPBlock
@@ -414,6 +415,7 @@ class TransformerLM(nn.Module):
     n_kv_heads: int = 0  # grouped-query attention (see Attention); 0 = MHA
     attention_window: int = 0  # sliding-window attention; 0 = full causal
     n_experts: int = 0  # >0: MoE MLPs in every `moe_every`-th block
+    moe_top_k: int = 1  # MoE router choices per token (1=Switch, 2=GShard)
     moe_every: int = 2
     decode: bool = False  # KV-cache autoregressive mode (see generation.py)
     quantized_cache: bool = False  # int8 KV cache in decode (see Attention)
@@ -443,7 +445,7 @@ class TransformerLM(nn.Module):
                 True, self.mesh, self.sequence_axis,
                 sequence_mode=self.sequence_mode,
                 n_kv_heads=self.n_kv_heads, window=self.attention_window,
-                n_experts=moe,
+                n_experts=moe, moe_top_k=self.moe_top_k,
                 decode=self.decode, remat_mlp=remat_mlp,
                 quantized_cache=self.quantized_cache, name=f"block_{i}",
             )(x)

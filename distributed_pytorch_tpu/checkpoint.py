@@ -177,19 +177,26 @@ def load_checkpoint(path: str, template: Any) -> Tuple[Any, Dict]:
     return tree, meta
 
 
-def _align_to_template(mapping, template: Any, *, source: str) -> Any:
+def _align_to_template(
+    mapping, template: Any, *, source: str, materialize: bool = True
+) -> Any:
     """Rebuild ``template``'s structure from ``mapping`` (any object with
     ``key in mapping`` / ``mapping[key]``, keyed by "/"-joined leaf paths):
     missing keys raise, shapes are validated, values cast to the template
     leaf's dtype. The single leaf-restoration contract, shared by
-    :func:`load_checkpoint` (npz) and :func:`import_orbax`."""
+    :func:`load_checkpoint` (npz) and :func:`import_orbax`.
+
+    ``materialize=False`` keeps each value AS-IS apart from the dtype cast
+    (``.astype`` on a sharded ``jax.Array`` preserves its placement) — the
+    sharded-restore path, where ``np.asarray`` would gather the very
+    shards sharded restore exists to avoid."""
     paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
     for p, tmpl in paths_and_leaves:
         key = _path_str(p)
         if key not in mapping:
             raise KeyError(f"{source} missing leaf {key!r}")
-        value = np.asarray(mapping[key])
+        value = mapping[key] if not materialize else np.asarray(mapping[key])
         # Shape/dtype only — NEVER materialize the template leaf: a sharded
         # TrainState template (Trainer(partition_specs=)) spans
         # non-addressable devices and cannot be fetched.
@@ -198,12 +205,14 @@ def _align_to_template(mapping, template: Any, *, source: str) -> Any:
         else:
             tmpl_arr = np.asarray(tmpl)
             tmpl_shape, tmpl_dtype = tmpl_arr.shape, tmpl_arr.dtype
-        if value.shape != tmpl_shape:
+        if tuple(value.shape) != tmpl_shape:
             raise ValueError(
-                f"{source} leaf {key!r} shape {value.shape} != template "
-                f"{tmpl_shape}"
+                f"{source} leaf {key!r} shape {tuple(value.shape)} != "
+                f"template {tmpl_shape}"
             )
-        leaves.append(value.astype(tmpl_dtype))
+        leaves.append(
+            value if value.dtype == tmpl_dtype else value.astype(tmpl_dtype)
+        )
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
@@ -270,25 +279,90 @@ def export_orbax(path: str, state: Any, *, epochs_run: int = 0) -> None:
     barrier("orbax_export")
 
 
-def import_orbax(path: str, template: Any) -> Tuple[Any, int]:
+def import_orbax(
+    path: str, template: Any, *, shardings: Any = None
+) -> Tuple[Any, int]:
     """Load an Orbax checkpoint directory into ``template``'s structure;
     returns ``(tree, epochs_run)`` (0 when no sidecar metadata exists —
-    e.g. a checkpoint produced by another framework)."""
+    e.g. a checkpoint produced by another framework).
+
+    ``shardings`` (a template-shaped pytree of ``NamedSharding`` leaves,
+    e.g. from ``parallel.partitioning.make_state_shardings``) switches to
+    the SHARDED-NATIVE restore: orbax's tensorstore backend reads each
+    host's addressable shards directly into correctly-placed ``jax.Array``
+    leaves — the restore-side mirror of :func:`export_orbax`'s sharded
+    write, and the difference between "loads" and "OOMs" for models big
+    enough to need sharded checkpointing. Without it, leaves restore to
+    host numpy (fine for small states, replicated placement downstream).
+    """
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     checkpointer = ocp.PyTreeCheckpointer()
-    restored = checkpointer.restore(path)
-    # Orbax restores a nested dict whose leaf ORDER (alphabetical keys) need
-    # not match the template's dataclass field order — align by path string,
-    # not position.
-    by_path = {
-        _path_str(p): leaf
-        for p, leaf in jax.tree_util.tree_flatten_with_path(restored)[0]
-    }
-    tree = _align_to_template(
-        by_path, template, source=f"orbax checkpoint {path}"
-    )
+    source = f"orbax checkpoint {path}"
+    if shardings is None:
+        restored = checkpointer.restore(path)
+        # Orbax restores a nested dict whose leaf ORDER (alphabetical keys)
+        # need not match the template's dataclass field order — align by
+        # path string, not position.
+        by_path = {
+            _path_str(p): leaf
+            for p, leaf in jax.tree_util.tree_flatten_with_path(restored)[0]
+        }
+        tree = _align_to_template(by_path, template, source=source)
+    else:
+        shard_by_path = {
+            _path_str(p): s
+            for p, s in jax.tree_util.tree_flatten_with_path(
+                shardings,
+                is_leaf=lambda x: hasattr(x, "spec"),  # NamedSharding leaves
+            )[0]
+        }
+        # restore_args must mirror the SAVED tree's structure, which the
+        # checkpoint's own metadata provides; shardings are matched to it
+        # by path string (same alignment rule as the host path above).
+        meta_tree = checkpointer.metadata(path)
+        # Newer orbax wraps the item tree in StepMetadata.item_metadata.
+        item_meta = getattr(meta_tree, "item_metadata", None)
+        if item_meta is not None:
+            meta_tree = getattr(item_meta, "tree", item_meta)
+
+        def make_arg(p, _meta_leaf):
+            sharding = shard_by_path.get(_path_str(p))
+            if sharding is None:
+                return ocp.RestoreArgs()  # host numpy for unlisted leaves
+            return ocp.ArrayRestoreArgs(sharding=sharding)
+
+        consumed = set()
+
+        def make_arg_consuming(p, meta_leaf):
+            arg = make_arg(p, meta_leaf)
+            if isinstance(arg, ocp.ArrayRestoreArgs):
+                consumed.add(_path_str(p))
+            return arg
+
+        restore_args = jax.tree_util.tree_map_with_path(
+            make_arg_consuming, meta_tree
+        )
+        unmatched = set(shard_by_path) - consumed
+        if unmatched:
+            # Loud, not silent: a shardings tree that misses the saved
+            # paths would quietly restore everything to host numpy — the
+            # OOM this path exists to prevent.
+            raise ValueError(
+                f"{source}: shardings provided for leaves the checkpoint "
+                f"does not contain: {sorted(unmatched)[:5]}"
+                f"{'...' if len(unmatched) > 5 else ''} — check the "
+                "shardings tree matches the saved state's structure"
+            )
+        restored = checkpointer.restore(path, restore_args=restore_args)
+        by_path = {
+            _path_str(p): leaf
+            for p, leaf in jax.tree_util.tree_flatten_with_path(restored)[0]
+        }
+        tree = _align_to_template(
+            by_path, template, source=source, materialize=False
+        )
     epochs = 0
     meta_path = path + ".meta.json"
     if os.path.exists(meta_path):

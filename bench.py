@@ -263,6 +263,7 @@ def bench_lm(
     n_layers: int = 6,
     n_heads: int = 8,
     d_ff: int = 2048,
+    window: int = 0,
 ):
     """TransformerLM bf16 train: vocab 32k, 6 layers, d_model 512. The fused
     LM head (``fused_head_chunk``) is the measured variable: at vocab 32k the
@@ -305,6 +306,7 @@ def bench_lm(
     model = TransformerLM(
         vocab_size=vocab, d_model=d_model, n_layers=n_layers, n_heads=n_heads,
         d_ff=d_ff, dtype=jnp.bfloat16, remat=False,
+        attention_window=window,
         fused_head_chunk=8192 if fused else 0,
     )
     optimizer = optax.adam(1e-4)
@@ -335,14 +337,20 @@ def bench_lm(
     embed_params = vocab * d_model  # lookup, not a matmul
     tokens = batch * seq_len
     head_dim = d_model // n_heads
-    attn_fwd = n_layers * 4 * batch * n_heads * (seq_len**2 / 2) * head_dim
+    if window:
+        # Banded attention: each query sees min(window, its prefix) keys.
+        per_q = np.minimum(np.arange(seq_len) + 1, window).sum()
+        attn_fwd = n_layers * 4 * batch * n_heads * per_q * head_dim
+    else:
+        attn_fwd = n_layers * 4 * batch * n_heads * (seq_len**2 / 2) * head_dim
     flops = 3.0 * (2.0 * (n_params - embed_params) * tokens + attn_fwd)
     _, elapsed = timed_steps(step, state, list(loader), n_steps, warmup=3)
     tag = "fused" if fused else "dense"
     default_dims = (d_model, n_layers, n_heads, d_ff) == (512, 6, 8, 2048)
     size = "" if default_dims else f"_{round(n_params / 1e6)}M_dhead{head_dim}"
+    win = f"_win{window}" if window else ""
     return {
-        "workload": f"transformer_lm{size}_t{seq_len}_{tag}_head",
+        "workload": f"transformer_lm{size}_t{seq_len}{win}_{tag}_head",
         "steps_per_sec": n_steps / elapsed,
         "tokens_per_sec": n_steps * batch * seq_len / elapsed,
         "flops_per_step": flops,
@@ -662,6 +670,12 @@ def run_benches(args, dev, peak):
             bench_lm(8192, True, d_model=2048, n_layers=6, n_heads=16,
                      d_ff=8192), peak
         ))
+        # Sliding-window row: default dims, T=8192, 1024 band — its
+        # full-causal twin is the transformer_lm_t8192_fused_head row from
+        # the seq loop above (SAME dims; not the d_head=128 scale-ups just
+        # before this line); the step-time delta between those two rows is
+        # the kernel's tile-skipping payoff (round-4 feature, BASELINE.md).
+        matrix.append(attach_mfu(bench_lm(8192, True, window=1024), peak))
         out = {
             "device_kind": dev.device_kind,
             "peak_bf16_tflops": peak / 1e12,

@@ -327,23 +327,17 @@ def import_orbax(
         if item_meta is not None:
             meta_tree = getattr(item_meta, "tree", item_meta)
 
-        def make_arg(p, _meta_leaf):
-            sharding = shard_by_path.get(_path_str(p))
-            if sharding is None:
-                return ocp.RestoreArgs()  # host numpy for unlisted leaves
-            return ocp.ArrayRestoreArgs(sharding=sharding)
-
         consumed = set()
 
-        def make_arg_consuming(p, meta_leaf):
-            arg = make_arg(p, meta_leaf)
-            if isinstance(arg, ocp.ArrayRestoreArgs):
-                consumed.add(_path_str(p))
-            return arg
+        def make_arg(p, _meta_leaf):
+            key = _path_str(p)
+            sharding = shard_by_path.get(key)
+            if sharding is None:
+                return ocp.RestoreArgs()  # host numpy for unlisted leaves
+            consumed.add(key)
+            return ocp.ArrayRestoreArgs(sharding=sharding)
 
-        restore_args = jax.tree_util.tree_map_with_path(
-            make_arg_consuming, meta_tree
-        )
+        restore_args = jax.tree_util.tree_map_with_path(make_arg, meta_tree)
         unmatched = set(shard_by_path) - consumed
         if unmatched:
             # Loud, not silent: a shardings tree that misses the saved

@@ -40,17 +40,28 @@ def apply_rope(
     *,
     theta: float = 10000.0,
     positions: Optional[jnp.ndarray] = None,
+    scale: float = 1.0,
 ) -> jnp.ndarray:
     """Rotary position embedding over [B, T, H, D].
 
     ``positions`` ([T] int/float) defaults to global positions 0..T-1; the
     decode path passes the cache offset so a single-token step rotates by its
-    absolute position."""
+    absolute position.
+
+    Context extension knobs for running PAST the training length:
+    ``scale > 1`` is linear position interpolation (positions divided by
+    ``scale``, squeezing a longer context into the trained angle range);
+    raising ``theta`` is the NTK-aware alternative (slower frequency decay).
+    Both are plain parameterizations here — which to use, and any
+    finetuning, is the caller's policy."""
     d_half = x.shape[-1] // 2
     freqs = theta ** (-jnp.arange(0, d_half, dtype=jnp.float32) / d_half)
     if positions is None:
         positions = jnp.arange(x.shape[1], dtype=jnp.float32)
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, D/2]
+    positions = positions.astype(jnp.float32)
+    if scale != 1.0:
+        positions = positions / scale
+    angles = positions[:, None] * freqs[None, :]  # [T, D/2]
     cos = jnp.cos(angles)[None, :, None, :]
     sin = jnp.sin(angles)[None, :, None, :]
     x1, x2 = x[..., :d_half], x[..., d_half:]
@@ -91,6 +102,10 @@ class Attention(nn.Module):
     # and in decode the visibility mask bounds reads the same way. Not yet
     # composed with sequence parallelism (explicit error, no silent cap).
     window: int = 0
+    # RoPE context-extension knobs (see apply_rope): linear position
+    # interpolation factor and frequency base.
+    rope_scale: float = 1.0
+    rope_theta: float = 10000.0
     mesh: Optional[Mesh] = None
     sequence_axis: Optional[str] = None
     # How to parallelize attention over the sequence axis: "ring" (K/V
@@ -156,8 +171,11 @@ class Attention(nn.Module):
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
             )
 
-        q = apply_rope(q_raw)
-        k = apply_rope(k_raw)
+        rope = lambda x, **kw: apply_rope(  # noqa: E731
+            x, theta=self.rope_theta, scale=self.rope_scale, **kw
+        )
+        q = rope(q_raw)
+        k = rope(k_raw)
         if kv_heads != self.n_heads:
             # Compute-side broadcast for the cores that need full heads
             # (flash, ulysses). Ring and decode take the UN-repeated k/v so
@@ -216,8 +234,14 @@ class Attention(nn.Module):
         max_len = cached_key.value.shape[1]
 
         positions = index + jnp.arange(t_step)
-        q = apply_rope(q_raw, positions=positions)
-        k = apply_rope(k_raw, positions=positions)
+        q = apply_rope(
+            q_raw, positions=positions, theta=self.rope_theta,
+            scale=self.rope_scale,
+        )
+        k = apply_rope(
+            k_raw, positions=positions, theta=self.rope_theta,
+            scale=self.rope_scale,
+        )
 
         if self.quantized_cache:
             keys, values = self._update_quantized_cache(
@@ -312,6 +336,8 @@ class TransformerBlock(nn.Module):
     sequence_mode: str = "ring"  # see Attention
     n_kv_heads: int = 0  # GQA (see Attention); 0 = MHA
     window: int = 0  # sliding-window attention (see Attention); 0 = full
+    rope_scale: float = 1.0  # RoPE linear interpolation (see apply_rope)
+    rope_theta: float = 10000.0
     n_experts: int = 0  # >0 swaps the dense MLP for an expert-parallel MoEMLP
     moe_top_k: int = 1  # router choices per token (see models/moe.py)
     decode: bool = False
@@ -323,6 +349,7 @@ class TransformerBlock(nn.Module):
         x = x + Attention(
             self.n_heads, self.d_model, self.dtype, self.causal,
             n_kv_heads=self.n_kv_heads, window=self.window,
+            rope_scale=self.rope_scale, rope_theta=self.rope_theta,
             mesh=self.mesh, sequence_axis=self.sequence_axis,
             sequence_mode=self.sequence_mode, decode=self.decode,
             quantized_cache=self.quantized_cache, name="attention",
@@ -414,6 +441,8 @@ class TransformerLM(nn.Module):
     sequence_mode: str = "ring"  # "ring" | "ulysses" (see Attention)
     n_kv_heads: int = 0  # grouped-query attention (see Attention); 0 = MHA
     attention_window: int = 0  # sliding-window attention; 0 = full causal
+    rope_scale: float = 1.0  # RoPE linear position interpolation factor
+    rope_theta: float = 10000.0  # RoPE frequency base (NTK-aware extension)
     n_experts: int = 0  # >0: MoE MLPs in every `moe_every`-th block
     moe_top_k: int = 1  # MoE router choices per token (1=Switch, 2=GShard)
     moe_every: int = 2
@@ -445,6 +474,7 @@ class TransformerLM(nn.Module):
                 True, self.mesh, self.sequence_axis,
                 sequence_mode=self.sequence_mode,
                 n_kv_heads=self.n_kv_heads, window=self.attention_window,
+                rope_scale=self.rope_scale, rope_theta=self.rope_theta,
                 n_experts=moe, moe_top_k=self.moe_top_k,
                 decode=self.decode, remat_mlp=remat_mlp,
                 quantized_cache=self.quantized_cache, name=f"block_{i}",

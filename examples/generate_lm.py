@@ -34,6 +34,8 @@ def main(args):
         n_heads=args.n_heads,
         n_kv_heads=args.n_kv_heads,
         attention_window=args.window,
+        rope_scale=args.rope_scale,
+        rope_theta=args.rope_theta,
         d_ff=4 * args.d_model,
         dtype=jnp.float32 if args.f32 else jnp.bfloat16,
     )
@@ -103,6 +105,15 @@ if __name__ == "__main__":
         "--window", type=int, default=0,
         help="sliding-window attention: each position attends the last W "
         "tokens only (0 = full causal)",
+    )
+    parser.add_argument(
+        "--rope_scale", type=float, default=1.0,
+        help="RoPE linear position interpolation (context extension): "
+        "positions divided by this factor",
+    )
+    parser.add_argument(
+        "--rope_theta", type=float, default=10000.0,
+        help="RoPE frequency base (raise for NTK-style context extension)",
     )
     parser.add_argument("--batch", type=int, default=4)
     parser.add_argument("--prompt_len", type=int, default=8)

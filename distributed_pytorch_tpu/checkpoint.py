@@ -363,3 +363,173 @@ def import_orbax(
         with open(meta_path) as f:
             epochs = int(json.load(f).get("epochs_run", 0))
     return tree, epochs
+
+
+# -------------------------------------------------------- rotation manager
+
+class CheckpointManager:
+    """Rotating checkpoint directory: keep the last ``keep`` snapshots plus
+    (optionally) the best-by-metric one, pruning the rest.
+
+    The reference overwrites one ``checkpoint.pt`` forever (``multigpu.py:
+    53-56``); real training wants bounded history and a protected best —
+    the torch-ecosystem habit (lightning/accelerate save_top_k) expressed
+    over this module's atomic npz snapshots:
+
+    * ``save(state, step=..., metric=...)`` writes ``ckpt_<step>.npz``
+      through :func:`save_checkpoint` (atomic, process-0 writer, cross-host
+      barrier) and then prunes: newest ``keep`` stay, the best-metric
+      checkpoint (lowest with ``mode="min"``, highest with ``"max"``) is
+      never pruned while it holds the record.
+    * ``latest_path()`` / ``best_path()`` / ``restore(template)`` / 
+      ``restore_best(template)`` read back; the metric ledger rides in each
+      file's own metadata, so the directory is self-describing (a fresh
+      process can resume the rotation).
+    """
+
+    PREFIX = "ckpt_"
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        keep: int = 3,
+        mode: str = "min",
+    ):
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        self.directory = os.path.abspath(directory)
+        self.keep = keep
+        self.mode = mode
+
+    # ------------------------------------------------------------- paths
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"{self.PREFIX}{step:010d}.npz")
+
+    def _steps(self):
+        if not os.path.isdir(self.directory):
+            return []
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith(self.PREFIX) and name.endswith(".npz"):
+                try:
+                    out.append(int(name[len(self.PREFIX):-4]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def _recent(self):
+        """The newest ``keep`` steps by WRITE TIME (mtime, step-number tie
+        break), not by step number: after ``restore_best`` rolls training
+        back, new saves carry lower step numbers than the abandoned run's
+        files — recency-by-step would delete the fresh checkpoint on the
+        very save that created it and keep serving the stale run."""
+        entries = []
+        for step in self._steps():
+            try:
+                entries.append((os.path.getmtime(self._path(step)), step))
+            except OSError:
+                continue  # pruned concurrently
+        entries.sort()
+        return [step for _, step in entries[-self.keep:]]
+
+    def _metric_of(self, step: int):
+        """``(readable, metric_or_None)`` — a file that EXISTS but cannot
+        be read (transient FS error, concurrent truncated read) is
+        distinguished from one saved without a metric: pruning must treat
+        the former as protected, or a glitch while re-reading the best
+        checkpoint would delete it."""
+        try:
+            with np.load(self._path(step)) as data:
+                meta = json.loads(
+                    bytes(data[_META_KEY].tobytes()).decode("utf-8")
+                )
+            return True, meta.get("metric")
+        except Exception:
+            return False, None
+
+    def latest_path(self) -> Optional[str]:
+        recent = self._recent()
+        return self._path(recent[-1]) if recent else None
+
+    def best_path(self) -> Optional[str]:
+        best_step, _ = self._best()
+        return self._path(best_step) if best_step is not None else None
+
+    def _best(self):
+        import math
+
+        best_step, best_val = None, None
+        sign = 1.0 if self.mode == "min" else -1.0
+        for step in self._steps():
+            ok, val = self._metric_of(step)
+            # Non-finite metrics (a diverged eval) never become "best" — a
+            # NaN record would win every strict comparison forever.
+            if not ok or val is None or not math.isfinite(val):
+                continue
+            if best_val is None or sign * val < sign * best_val:
+                best_step, best_val = step, val
+        return best_step, best_val
+
+    # -------------------------------------------------------------- save
+    def save(
+        self,
+        state: Any,
+        *,
+        step: int,
+        metric: Optional[float] = None,
+        epochs_run: int = 0,
+    ) -> str:
+        """Write ``ckpt_<step>.npz`` and prune. ``metric`` (e.g. eval loss)
+        enters the file's metadata and drives best-retention; without it
+        only recency is kept. Call from EVERY process (the write itself is
+        process-0-gated with a barrier inside save_checkpoint)."""
+        path = self._path(step)
+        meta: Dict = _snapshot_meta(epochs_run)
+        if metric is not None:
+            meta["metric"] = float(metric)
+        save_checkpoint(path, state, metadata=meta)
+        if is_main_process():
+            self._prune()
+        barrier("checkpoint_manager_prune")
+        return path
+
+    def _prune(self) -> None:
+        steps = self._steps()
+        keepers = set(self._recent())
+        best_step, _ = self._best()
+        if best_step is not None:
+            keepers.add(best_step)
+        for step in steps:
+            if step in keepers:
+                continue
+            ok, _ = self._metric_of(step)
+            if not ok:
+                continue  # unreadable right now: protect, retry next save
+            try:
+                os.unlink(self._path(step))
+            except OSError:
+                pass  # already gone (a concurrent manager pruned it)
+
+    # ----------------------------------------------------------- restore
+    def restore(self, template: Any) -> Tuple[Any, Dict]:
+        """Latest checkpoint -> ``(tree, metadata)``; raises if none."""
+        path = self.latest_path()
+        if path is None:
+            raise FileNotFoundError(
+                f"no {self.PREFIX}*.npz under {self.directory}"
+            )
+        return load_checkpoint(path, template)
+
+    def restore_best(self, template: Any) -> Tuple[Any, Dict]:
+        """Best-metric checkpoint -> ``(tree, metadata)``; raises if no
+        checkpoint carries a metric."""
+        path = self.best_path()
+        if path is None:
+            raise FileNotFoundError(
+                f"no metric-carrying {self.PREFIX}*.npz under "
+                f"{self.directory}"
+            )
+        return load_checkpoint(path, template)

@@ -206,3 +206,142 @@ class TestOrbaxShardedRestore:
         template = {"w": jax.ShapeDtypeStruct((9,), jnp.float32)}
         with pytest.raises(ValueError, match="shape"):
             import_orbax(path, template, shardings={"w": rep})
+
+
+class TestCheckpointManager:
+    """Rotation: newest `keep` survive, the best-metric file is protected,
+    the directory is self-describing across manager instances."""
+
+    def _state(self, seed):
+        return {"w": jnp.full((4,), float(seed))}
+
+    def test_keeps_last_k_and_best(self, tmp_path):
+        from distributed_pytorch_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=2, mode="min")
+        # Step 1 has the BEST (lowest) metric; later steps are worse.
+        for step, metric in [(1, 0.1), (2, 0.5), (3, 0.4), (4, 0.3)]:
+            mgr.save(self._state(step), step=step, metric=metric)
+        names = sorted(
+            p.name for p in (tmp_path / "ckpts").glob("ckpt_*.npz")
+        )
+        # keep=2 -> steps 3, 4; step 1 survives as best; step 2 pruned.
+        assert names == [
+            "ckpt_0000000001.npz",
+            "ckpt_0000000003.npz",
+            "ckpt_0000000004.npz",
+        ]
+
+    def test_restore_latest_and_best(self, tmp_path):
+        from distributed_pytorch_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path / "c"), keep=2)
+        for step, metric in [(1, 0.9), (2, 0.2), (3, 0.7)]:
+            mgr.save(self._state(step), step=step, metric=metric)
+        template = {"w": jnp.zeros((4,))}
+        latest, meta = mgr.restore(template)
+        assert float(latest["w"][0]) == 3.0
+        best, best_meta = mgr.restore_best(template)
+        assert float(best["w"][0]) == 2.0
+        assert best_meta["metric"] == 0.2
+
+    def test_fresh_instance_resumes_rotation(self, tmp_path):
+        from distributed_pytorch_tpu.checkpoint import CheckpointManager
+
+        d = str(tmp_path / "c")
+        CheckpointManager(d, keep=2).save(
+            self._state(1), step=1, metric=0.1
+        )
+        # A NEW process (fresh manager) continues pruning correctly from
+        # what is on disk.
+        mgr2 = CheckpointManager(d, keep=2)
+        for step, metric in [(2, 0.5), (3, 0.6), (4, 0.7)]:
+            mgr2.save(self._state(step), step=step, metric=metric)
+        steps = sorted(
+            int(p.name[5:-4]) for p in (tmp_path / "c").glob("ckpt_*.npz")
+        )
+        assert steps == [1, 3, 4]  # 1 = best, 3/4 = newest two
+
+    def test_no_metric_keeps_recency_only(self, tmp_path):
+        from distributed_pytorch_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path / "c"), keep=2)
+        for step in (1, 2, 3):
+            mgr.save(self._state(step), step=step)
+        steps = sorted(
+            int(p.name[5:-4]) for p in (tmp_path / "c").glob("ckpt_*.npz")
+        )
+        assert steps == [2, 3]
+        with pytest.raises(FileNotFoundError):
+            mgr.restore_best({"w": jnp.zeros((4,))})
+
+    def test_mode_max_protects_highest(self, tmp_path):
+        from distributed_pytorch_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path / "c"), keep=1, mode="max")
+        for step, metric in [(1, 0.9), (2, 0.1), (3, 0.2)]:
+            mgr.save(self._state(step), step=step, metric=metric)
+        steps = sorted(
+            int(p.name[5:-4]) for p in (tmp_path / "c").glob("ckpt_*.npz")
+        )
+        assert steps == [1, 3]  # 1 = best accuracy, 3 = newest
+
+    def test_rejects_bad_config(self, tmp_path):
+        from distributed_pytorch_tpu.checkpoint import CheckpointManager
+
+        with pytest.raises(ValueError, match="keep"):
+            CheckpointManager(str(tmp_path), keep=0)
+        with pytest.raises(ValueError, match="mode"):
+            CheckpointManager(str(tmp_path), mode="median")
+
+
+class TestCheckpointManagerEdgeCases:
+    """Review-hardened behaviors: rollback resume, NaN metrics, unreadable
+    files."""
+
+    def _state(self, seed):
+        return {"w": jnp.full((4,), float(seed))}
+
+    def test_rollback_resume_keeps_fresh_low_step_saves(self, tmp_path):
+        import time as _time
+
+        from distributed_pytorch_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path / "c"), keep=2)
+        for step, metric in [(1, 0.1), (3, 0.5), (4, 0.6)]:
+            mgr.save(self._state(step), step=step, metric=metric)
+            _time.sleep(0.02)
+        # Roll back to best (step 1) and resume: the resumed run's step-2
+        # save must SURVIVE its own prune and become the latest.
+        mgr.save(self._state(2), step=2, metric=0.4)
+        assert os.path.exists(tmp_path / "c" / "ckpt_0000000002.npz")
+        assert mgr.latest_path().endswith("ckpt_0000000002.npz")
+
+    def test_nan_metric_never_becomes_best(self, tmp_path):
+        from distributed_pytorch_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path / "c"), keep=5)
+        mgr.save(self._state(1), step=1, metric=float("nan"))
+        mgr.save(self._state(2), step=2, metric=0.7)
+        best, meta = mgr.restore_best({"w": jnp.zeros((4,))})
+        assert meta["metric"] == 0.7
+        assert float(best["w"][0]) == 2.0
+
+    def test_unreadable_file_is_protected_not_pruned(self, tmp_path):
+        import time as _time
+
+        from distributed_pytorch_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path / "c"), keep=1)
+        mgr.save(self._state(1), step=1, metric=0.1)
+        # Corrupt step 1 (simulates a transient/partial read): it can no
+        # longer prove it's the best, but pruning must NOT delete what it
+        # cannot read.
+        (tmp_path / "c" / "ckpt_0000000001.npz").write_bytes(b"garbage")
+        _time.sleep(0.02)
+        mgr.save(self._state(2), step=2, metric=0.5)
+        names = sorted(p.name for p in (tmp_path / "c").glob("ckpt_*.npz"))
+        assert names == [
+            "ckpt_0000000001.npz",
+            "ckpt_0000000002.npz",
+        ]

@@ -403,6 +403,11 @@ class CheckpointManager:
         self.directory = os.path.abspath(directory)
         self.keep = keep
         self.mode = mode
+        # step -> consecutive failed reads (this process): one glitch must
+        # not delete a file, but a PERMANENTLY corrupt one must not be
+        # protected forever (it would accumulate and slow every save).
+        self._read_failures: Dict[int, int] = {}
+        self._max_read_failures = 3
 
     # ------------------------------------------------------------- paths
     def _path(self, step: int) -> str:
@@ -440,14 +445,18 @@ class CheckpointManager:
         be read (transient FS error, concurrent truncated read) is
         distinguished from one saved without a metric: pruning must treat
         the former as protected, or a glitch while re-reading the best
-        checkpoint would delete it."""
+        checkpoint would delete it. Consecutive failures are counted so a
+        permanently corrupt file stops being protected after
+        ``_max_read_failures`` reads."""
         try:
             with np.load(self._path(step)) as data:
                 meta = json.loads(
                     bytes(data[_META_KEY].tobytes()).decode("utf-8")
                 )
+            self._read_failures.pop(step, None)
             return True, meta.get("metric")
         except Exception:
+            self._read_failures[step] = self._read_failures.get(step, 0) + 1
             return False, None
 
     def latest_path(self) -> Optional[str]:
@@ -458,13 +467,18 @@ class CheckpointManager:
         best_step, _ = self._best()
         return self._path(best_step) if best_step is not None else None
 
-    def _best(self):
+    def _best(self, info=None):
+        """``info``: optional pre-read ``{step: (ok, metric)}`` so one save
+        does not open every file twice (once here, once in _prune)."""
         import math
 
         best_step, best_val = None, None
         sign = 1.0 if self.mode == "min" else -1.0
         for step in self._steps():
-            ok, val = self._metric_of(step)
+            ok, val = (
+                info[step] if info is not None and step in info
+                else self._metric_of(step)
+            )
             # Non-finite metrics (a diverged eval) never become "best" — a
             # NaN record would win every strict comparison forever.
             if not ok or val is None or not math.isfinite(val):
@@ -481,13 +495,17 @@ class CheckpointManager:
         step: int,
         metric: Optional[float] = None,
         epochs_run: int = 0,
+        extra_metadata: Optional[Dict] = None,
     ) -> str:
         """Write ``ckpt_<step>.npz`` and prune. ``metric`` (e.g. eval loss)
         enters the file's metadata and drives best-retention; without it
-        only recency is kept. Call from EVERY process (the write itself is
-        process-0-gated with a barrier inside save_checkpoint)."""
+        only recency is kept. ``extra_metadata`` merges into the file's
+        metadata (schema compatibility with non-rotated consumers). Call
+        from EVERY process (the write itself is process-0-gated with a
+        barrier inside save_checkpoint)."""
         path = self._path(step)
-        meta: Dict = _snapshot_meta(epochs_run)
+        meta: Dict = dict(extra_metadata or {})
+        meta.update(_snapshot_meta(epochs_run))
         if metric is not None:
             meta["metric"] = float(metric)
         save_checkpoint(path, state, metadata=meta)
@@ -498,16 +516,20 @@ class CheckpointManager:
 
     def _prune(self) -> None:
         steps = self._steps()
+        # One metadata read per file per prune, shared with best selection.
+        info = {step: self._metric_of(step) for step in steps}
         keepers = set(self._recent())
-        best_step, _ = self._best()
+        best_step, _ = self._best(info)
         if best_step is not None:
             keepers.add(best_step)
         for step in steps:
             if step in keepers:
                 continue
-            ok, _ = self._metric_of(step)
-            if not ok:
-                continue  # unreadable right now: protect, retry next save
+            ok, _ = info[step]
+            if not ok and (
+                self._read_failures.get(step, 0) < self._max_read_failures
+            ):
+                continue  # maybe-transient read failure: protect for now
             try:
                 os.unlink(self._path(step))
             except OSError:

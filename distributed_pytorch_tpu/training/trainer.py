@@ -81,6 +81,7 @@ class Trainer:
         paranoid: bool = False,
         loss_scale=None,
         partition_specs=None,
+        keep_checkpoints: int = 0,
     ):
         self.model = model
         self.train_data = train_data
@@ -103,6 +104,27 @@ class Trainer:
 
             self.checkpointer = AsyncCheckpointer()
         self.paranoid = paranoid
+        # keep_checkpoints > 0: rotate instead of overwriting —
+        # checkpoint_path becomes a DIRECTORY managed by CheckpointManager
+        # (newest K by write time + the best-by-epoch-loss protected);
+        # 0 keeps the reference's single-file overwrite semantics.
+        self.manager = None
+        if keep_checkpoints > 0:
+            if snapshot_path is not None:
+                # train() routes periodic saves to the snapshot when
+                # snapshot_path is set; a manager built here would silently
+                # never run — refuse the combination instead.
+                raise ValueError(
+                    "keep_checkpoints rotates checkpoint_path saves, but "
+                    "snapshot_path is also set (snapshots are single-file "
+                    "by design — the elastic resume contract); use one or "
+                    "the other"
+                )
+            from distributed_pytorch_tpu.checkpoint import CheckpointManager
+
+            self.manager = CheckpointManager(
+                checkpoint_path, keep=keep_checkpoints, mode="min"
+            )
         self.epochs_run = 0
 
         if mesh is not None:
@@ -221,19 +243,32 @@ class Trainer:
             )
         self._touch_heartbeat()
 
-    def _save_checkpoint(self, epoch: int) -> None:
+    def _save_checkpoint(self, epoch: int, metric=None) -> None:
         # Params AND non-trainable model state (BatchNorm running stats):
         # the reference's state_dict includes both (multigpu.py:54). Beat
         # around the synchronous save, same as _save_snapshot.
         self._touch_heartbeat()
-        save_checkpoint(
-            self.checkpoint_path,
-            {"params": self.state.params, "model_state": self.state.model_state},
-            metadata={"epoch": epoch},
-        )
+        tree = {
+            "params": self.state.params,
+            "model_state": self.state.model_state,
+        }
+        # ONE metadata schema for both modes: {"epoch": N (0-based, the
+        # reference's convention), "epochs_run": N+1}; rotated files add
+        # "metric".
+        if self.manager is not None:
+            where = self.manager.save(
+                tree, step=epoch + 1, metric=metric, epochs_run=epoch + 1,
+                extra_metadata={"epoch": epoch},
+            )
+        else:
+            where = self.checkpoint_path
+            save_checkpoint(
+                where, tree,
+                metadata={"epoch": epoch, "epochs_run": epoch + 1},
+            )
         if is_main_process():
             print(
-                f"Epoch {epoch} | Training checkpoint saved at {self.checkpoint_path}",
+                f"Epoch {epoch} | Training checkpoint saved at {where}",
                 flush=True,
             )
         self._touch_heartbeat()
@@ -427,13 +462,13 @@ class Trainer:
             self.profiler.start()
         try:
             for epoch in range(self.epochs_run, max_epochs):
-                self._run_epoch(epoch)
+                epoch_loss = self._run_epoch(epoch)
                 self.epochs_run = epoch + 1
                 if self.save_every and (epoch + 1) % self.save_every == 0:
                     if self.snapshot_path is not None:
                         self._save_snapshot(epoch)
                     else:
-                        self._save_checkpoint(epoch)
+                        self._save_checkpoint(epoch, metric=epoch_loss)
         finally:
             try:
                 if self.checkpointer is not None:

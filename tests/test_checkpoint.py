@@ -345,3 +345,43 @@ class TestCheckpointManagerEdgeCases:
             "ckpt_0000000001.npz",
             "ckpt_0000000002.npz",
         ]
+
+
+def test_manager_permanently_corrupt_file_eventually_pruned(tmp_path):
+    """A transient glitch protects a file; a PERMANENTLY corrupt one stops
+    being protected after a few failed reads (no unbounded accumulation)."""
+    import time as _time
+
+    from distributed_pytorch_tpu.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "c"), keep=1)
+    mgr.save({"w": jnp.zeros((2,))}, step=1, metric=0.1)
+    (tmp_path / "c" / "ckpt_0000000001.npz").write_bytes(b"garbage")
+    for step in (2, 3, 4, 5):
+        _time.sleep(0.01)
+        mgr.save({"w": jnp.zeros((2,))}, step=step, metric=0.5)
+    names = sorted(p.name for p in (tmp_path / "c").glob("ckpt_*.npz"))
+    assert "ckpt_0000000001.npz" not in names  # pruned after repeated fails
+    assert names[-1] == "ckpt_0000000005.npz"
+
+
+def test_trainer_rejects_snapshot_plus_rotation(tmp_path):
+    import optax
+
+    from distributed_pytorch_tpu.models.toy import ToyRegressor
+    from distributed_pytorch_tpu.training.trainer import Trainer
+    from distributed_pytorch_tpu.utils.data import (
+        MaterializedDataset,
+        ShardedLoader,
+    )
+
+    with pytest.raises(ValueError, match="keep_checkpoints"):
+        Trainer(
+            ToyRegressor(),
+            ShardedLoader(MaterializedDataset(32), 16),
+            optax.sgd(1e-2),
+            save_every=1,
+            snapshot_path=str(tmp_path / "s.npz"),
+            checkpoint_path=str(tmp_path / "c"),
+            keep_checkpoints=2,
+        )

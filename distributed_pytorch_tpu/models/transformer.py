@@ -374,7 +374,10 @@ class LMHead(nn.Module):
     names, shapes, and initializers ``nn.Dense(name="lm_head")`` would create,
     so the param tree — and pinned-seed initialization — is byte-identical
     whether or not the fused path is enabled, and checkpoints move freely
-    between the two.
+    between the two. EXCEPT under weight tying: with ``tied_kernel`` passed
+    (``TransformerLM(tie_embeddings=True)``) no params are declared at all
+    and the ``lm_head`` scope is absent from the tree — tied and untied
+    checkpoints are different layouts by design.
 
     * ``targets is None`` (or ``fused_chunk == 0``): returns float32 logits
       ``[..., vocab]`` — the standard path, used by generation and eval.
@@ -389,17 +392,31 @@ class LMHead(nn.Module):
 
     @nn.compact
     def __call__(
-        self, x: jnp.ndarray, targets: Optional[jnp.ndarray] = None
+        self,
+        x: jnp.ndarray,
+        targets: Optional[jnp.ndarray] = None,
+        tied_kernel: Optional[jnp.ndarray] = None,
     ) -> jnp.ndarray:
-        kernel = self.param(
-            "kernel",
-            nn.initializers.lecun_normal(),
-            (x.shape[-1], self.vocab_size),
-            jnp.float32,
-        )
-        bias = self.param(
-            "bias", nn.initializers.zeros_init(), (self.vocab_size,), jnp.float32
-        )
+        if tied_kernel is not None:
+            # Weight tying (GPT-2 style): the head IS the transposed token
+            # embedding — no kernel or bias params are declared here, so
+            # the lm_head scope vanishes from the param tree and gradients
+            # flow to the embedding from both its uses. bias stays None:
+            # the fused path then skips the bias add AND its dead gradient
+            # accumulator in the backward scan.
+            kernel = tied_kernel.astype(jnp.float32)
+            bias = None
+        else:
+            kernel = self.param(
+                "kernel",
+                nn.initializers.lecun_normal(),
+                (x.shape[-1], self.vocab_size),
+                jnp.float32,
+            )
+            bias = self.param(
+                "bias", nn.initializers.zeros_init(), (self.vocab_size,),
+                jnp.float32,
+            )
         if self.fused_chunk and targets is not None:
             return fused_linear_cross_entropy(
                 x.reshape(-1, x.shape[-1]),
@@ -409,7 +426,8 @@ class LMHead(nn.Module):
                 self.fused_chunk,
             )
         # Logits in float32 for a numerically stable softmax-cross-entropy.
-        return x.astype(jnp.float32) @ kernel + bias
+        logits = x.astype(jnp.float32) @ kernel
+        return logits if bias is None else logits + bias
 
 
 class TransformerLM(nn.Module):
@@ -446,6 +464,18 @@ class TransformerLM(nn.Module):
     n_experts: int = 0  # >0: MoE MLPs in every `moe_every`-th block
     moe_top_k: int = 1  # MoE router choices per token (1=Switch, 2=GShard)
     moe_every: int = 2
+    # GPT-2-style weight tying: the LM head reuses the token embedding
+    # (transposed, no bias) — vocab*d_model fewer params, gradients reach
+    # the embedding from both ends. The lm_head scope then holds no params
+    # (TP/quant rules for it simply don't match; the embedding stays a
+    # gather + full-precision head reads under quantize=True). TP caveat:
+    # TRANSFORMER_TP_RULES shard the embedding over d_model, so the tied
+    # head contracts over the SHARDED axis — GSPMD inserts an all-reduce
+    # and the [N, vocab] logits land replicated, where the untied
+    # lm_head/kernel kept them vocab-sharded with no collective. For
+    # vocab-sharded-head TP training at scale, prefer untied (or use the
+    # fused CE head, which never materializes the logits at all).
+    tie_embeddings: bool = False
     decode: bool = False  # KV-cache autoregressive mode (see generation.py)
     quantized_cache: bool = False  # int8 KV cache in decode (see Attention)
     fused_head_chunk: int = 0  # >0: vocab chunk size for the fused CE head
@@ -454,9 +484,10 @@ class TransformerLM(nn.Module):
     def __call__(
         self, tokens: jnp.ndarray, targets: Optional[jnp.ndarray] = None
     ) -> jnp.ndarray:
-        x = nn.Embed(
+        embed = nn.Embed(
             self.vocab_size, self.d_model, dtype=self.dtype, name="embed"
-        )(tokens)
+        )
+        x = embed(tokens)
         block = TransformerBlock
         remat_mlp = False
         if self.remat:
@@ -490,4 +521,8 @@ class TransformerLM(nn.Module):
             )
         return LMHead(
             self.vocab_size, self.fused_head_chunk, name="lm_head"
-        )(x, targets)
+        )(
+            x,
+            targets,
+            tied_kernel=embed.embedding.T if self.tie_embeddings else None,
+        )

@@ -466,3 +466,84 @@ class TestRopeScaling:
         a = plain.apply({"params": params}, tokens)
         b = scaled.apply({"params": params}, tokens)
         assert float(jnp.abs(a - b).max()) > 1e-4
+
+
+class TestTiedEmbeddings:
+    """tie_embeddings=True: the LM head is the transposed token embedding —
+    vocab*d_model + vocab fewer params, gradients reach the embedding from
+    both ends, and every head path (dense logits, fused CE, decode) uses
+    the same tied matrix."""
+
+    KW = dict(vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+
+    def _tokens(self, b=4, t=17, seed=0):
+        rng = np.random.default_rng(seed)
+        return jnp.asarray(rng.integers(0, 64, (b, t)), jnp.int32)
+
+    def test_param_tree_drops_lm_head(self):
+        tokens = self._tokens()
+        tied = TransformerLM(**self.KW, tie_embeddings=True)
+        untied = TransformerLM(**self.KW)
+        pt = tied.init(jax.random.PRNGKey(0), tokens)["params"]
+        pu = untied.init(jax.random.PRNGKey(0), tokens)["params"]
+        assert "lm_head" not in pt and "lm_head" in pu
+        nt = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(pt))
+        nu = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(pu))
+        assert nu - nt == 64 * 32 + 64  # kernel + bias gone
+
+    def test_logits_use_embedding_transpose(self):
+        tokens = self._tokens(b=1, t=8)
+        tied = TransformerLM(**self.KW, tie_embeddings=True)
+        variables = tied.init(jax.random.PRNGKey(0), tokens)
+        logits = tied.apply(variables, tokens)
+        # Reconstruct by hand: trunk output @ embedding.T.
+        emb = variables["params"]["embed"]["embedding"]
+        # Perturb the embedding with NOISE (a constant shift would cancel
+        # through the final LayerNorm's zero-mean output): logits must
+        # move, because the head IS the embedding.
+        noise = jax.random.normal(jax.random.PRNGKey(7), emb.shape) * 0.01
+        v2 = jax.tree_util.tree_map(lambda x: x, variables)
+        v2["params"]["embed"]["embedding"] = emb + noise
+        logits2 = tied.apply(v2, tokens)
+        assert float(jnp.abs(logits - logits2).max()) > 1e-3
+
+    def test_trains_and_fused_head_matches_dense(self):
+        import optax
+
+        tokens = self._tokens()
+        tied = TransformerLM(**self.KW, tie_embeddings=True)
+        params = tied.init(jax.random.PRNGKey(0), tokens[:, :-1])["params"]
+        tied_fused = TransformerLM(
+            **self.KW, tie_embeddings=True, fused_head_chunk=32
+        )
+        dense_logits = tied.apply({"params": params}, tokens[:, :-1])
+        fused_loss = tied_fused.apply(
+            {"params": params}, tokens[:, :-1], tokens[:, 1:]
+        )
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            dense_logits, tokens[:, 1:]
+        ).mean()
+        np.testing.assert_allclose(
+            float(fused_loss), float(ce), rtol=1e-5
+        )
+
+    def test_tied_decode_matches_full_forward(self):
+        model = TransformerLM(**self.KW, tie_embeddings=True)
+        tokens = self._tokens(b=2, t=12)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        full = model.apply({"params": params}, tokens)
+        dec = model.clone(decode=True)
+        cache = dec.init(jax.random.PRNGKey(0), tokens)["cache"]
+        steps = []
+        for t in range(tokens.shape[1]):
+            logits, updated = dec.apply(
+                {"params": params, "cache": cache},
+                tokens[:, t : t + 1],
+                mutable=["cache"],
+            )
+            cache = updated["cache"]
+            steps.append(logits[:, 0])
+        np.testing.assert_allclose(
+            np.asarray(jnp.stack(steps, axis=1)), np.asarray(full),
+            rtol=1e-4, atol=1e-4,
+        )

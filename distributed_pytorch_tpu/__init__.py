@@ -43,6 +43,7 @@ from distributed_pytorch_tpu.parallel.mesh import make_mesh
 from distributed_pytorch_tpu.profiling import StepProfiler
 from distributed_pytorch_tpu.training.losses import (
     mse_loss,
+    smoothed_cross_entropy_loss,
     softmax_cross_entropy_loss,
 )
 from distributed_pytorch_tpu.training.train_step import TrainState, make_train_step
@@ -80,5 +81,6 @@ __all__ = [
     "save_snapshot",
     "setup_distributed",
     "shutdown_distributed",
+    "smoothed_cross_entropy_loss",
     "softmax_cross_entropy_loss",
 ]

@@ -104,8 +104,13 @@ def _bwd(chunk_size, residuals, g):
     h32 = hidden.astype(jnp.float32)
     scale = g / n  # d(mean)/d(per-row)
 
+    use_bias = bias is not None
+
     def body(carry, c):
-        dh, dw_chunks, db_chunks = carry
+        if use_bias:
+            dh, dw_chunks, db_chunks = carry
+        else:
+            dh, dw_chunks = carry
         w_c, b_c = _chunk(weight, bias, c, chunk_size)
         logits = h32 @ w_c.astype(jnp.float32)
         if b_c is not None:
@@ -120,21 +125,33 @@ def _bwd(chunk_size, residuals, g):
         dlogits = (p - onehot) * scale  # [N, chunk]
         dh = dh + dlogits @ w_c.astype(jnp.float32).T
         dw_c = h32.T @ dlogits  # [D, chunk]
+        if not use_bias:
+            # bias=None (e.g. a tied LM head): no db carry AT TRACE LEVEL —
+            # the [n_chunks, chunk_size] accumulator and its per-chunk
+            # reduction never exist, rather than relying on XLA to
+            # dead-code them out of the scan.
+            return (dh, dw_chunks.at[c].set(dw_c)), None
         db_c = jnp.sum(dlogits, axis=0)
         return (dh, dw_chunks.at[c].set(dw_c), db_chunks.at[c].set(db_c)), None
 
     dh0 = jnp.zeros((n, d), jnp.float32)
     dw0 = jnp.zeros((n_chunks, d, chunk_size), jnp.float32)
-    db0 = jnp.zeros((n_chunks, chunk_size), jnp.float32)
-    (dh, dw_chunks, db_chunks), _ = jax.lax.scan(
-        body, (dh0, dw0, db0), jnp.arange(n_chunks)
-    )
+    if use_bias:
+        db0 = jnp.zeros((n_chunks, chunk_size), jnp.float32)
+        (dh, dw_chunks, db_chunks), _ = jax.lax.scan(
+            body, (dh0, dw0, db0), jnp.arange(n_chunks)
+        )
+        db = db_chunks.reshape(vocab).astype(bias.dtype)
+    else:
+        (dh, dw_chunks), _ = jax.lax.scan(
+            body, (dh0, dw0), jnp.arange(n_chunks)
+        )
+        db = None
     dw = jnp.transpose(dw_chunks, (1, 0, 2)).reshape(d, vocab)
-    db = db_chunks.reshape(vocab) if bias is not None else None
     return (
         dh.astype(hidden.dtype),
         dw.astype(weight.dtype),
-        db if bias is None else db.astype(bias.dtype),
+        db,
         None,  # integer targets
     )
 

@@ -1,5 +1,6 @@
 from distributed_pytorch_tpu.training.losses import (
     mse_loss,
+    smoothed_cross_entropy_loss,
     softmax_cross_entropy_loss,
 )
 from distributed_pytorch_tpu.training.mixed_precision import (
@@ -31,5 +32,6 @@ __all__ = [
     "make_eval_step",
     "make_train_step",
     "mse_loss",
+    "smoothed_cross_entropy_loss",
     "softmax_cross_entropy_loss",
 ]

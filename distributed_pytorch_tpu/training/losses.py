@@ -8,6 +8,7 @@ loss is constant 0) and ``MSELoss`` only in the multinode rung
 regression task and real softmax cross-entropy for classification models.
 """
 
+import jax
 import jax.numpy as jnp
 import optax
 
@@ -24,6 +25,38 @@ def softmax_cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp
     else:
         per_example = optax.softmax_cross_entropy(logits, targets)
     return jnp.mean(per_example)
+
+
+def smoothed_cross_entropy_loss(smoothing: float):
+    """Factory: cross entropy with label smoothing ``smoothing`` (the
+    standard ViT/Inception regularizer — each target distributes
+    ``smoothing`` mass uniformly over the other classes). Returns a
+    ``(logits, int_targets) -> scalar`` with the same signature as
+    :func:`softmax_cross_entropy_loss`, so it drops into ``Trainer`` /
+    ``make_train_step`` unchanged. ``smoothing=0`` reduces exactly to the
+    sparse loss."""
+    if not 0.0 <= smoothing < 1.0:
+        raise ValueError(f"smoothing must be in [0, 1), got {smoothing}")
+
+    def loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+        n_classes = logits.shape[-1]
+        soft = optax.smooth_labels(
+            jax.nn.one_hot(targets, n_classes, dtype=logits.dtype), smoothing
+        )
+        return jnp.mean(optax.softmax_cross_entropy(logits, soft))
+
+    def per_sample(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+        n_classes = logits.shape[-1]
+        soft = optax.smooth_labels(
+            jax.nn.one_hot(targets, n_classes, dtype=logits.dtype), smoothing
+        )
+        return optax.softmax_cross_entropy(logits, soft)
+
+    # Register the exact-eval twin so Trainer.evaluate keeps its unbiased
+    # wrap-pad-corrected path for this loss too (same mechanism as the
+    # stock losses below).
+    PER_SAMPLE_TWINS[loss] = per_sample
+    return loss
 
 
 # -- per-sample twins (exact evaluation) -------------------------------------

@@ -196,3 +196,87 @@ def test_consistency_check_skips_sharded_leaves():
     }
     assert_replicas_consistent(tree, name="mixed")  # must not raise
     assert len(tree_checksum(tree)) == 1  # only the replicated leaf counted
+
+
+class TestLabelSmoothing:
+    def test_zero_smoothing_equals_sparse_loss(self):
+        from distributed_pytorch_tpu.training.losses import (
+            smoothed_cross_entropy_loss,
+            softmax_cross_entropy_loss,
+        )
+
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.standard_normal((8, 10)), jnp.float32)
+        targets = jnp.asarray(rng.integers(0, 10, (8,)), jnp.int32)
+        a = smoothed_cross_entropy_loss(0.0)(logits, targets)
+        b = softmax_cross_entropy_loss(logits, targets)
+        np.testing.assert_allclose(float(a), float(b), rtol=1e-6)
+
+    def test_smoothing_matches_manual_mixture(self):
+        from distributed_pytorch_tpu.training.losses import (
+            smoothed_cross_entropy_loss,
+        )
+
+        rng = np.random.default_rng(1)
+        logits = jnp.asarray(rng.standard_normal((4, 6)), jnp.float32)
+        targets = jnp.asarray([0, 2, 5, 1], jnp.int32)
+        eps, k = 0.1, 6
+        soft = jax.nn.one_hot(targets, k) * (1 - eps) + eps / k
+        import optax as _optax
+
+        ref = jnp.mean(_optax.softmax_cross_entropy(logits, soft))
+        got = smoothed_cross_entropy_loss(eps)(logits, targets)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
+
+    def test_rejects_bad_smoothing(self):
+        from distributed_pytorch_tpu.training.losses import (
+            smoothed_cross_entropy_loss,
+        )
+
+        for bad in (-0.1, 1.0, 1.5):
+            with pytest.raises(ValueError, match="smoothing"):
+                smoothed_cross_entropy_loss(bad)
+
+    def test_drops_into_train_step(self):
+        import optax
+
+        from distributed_pytorch_tpu.training.losses import (
+            smoothed_cross_entropy_loss,
+        )
+        from distributed_pytorch_tpu.training.train_step import (
+            create_train_state,
+            make_train_step,
+        )
+
+        model = MLP(hidden=(32,), features=4)
+        rng = np.random.default_rng(0)
+        xs = jnp.asarray(rng.standard_normal((32, 20)), jnp.float32)
+        ys = jnp.asarray(rng.integers(0, 4, (32,)), jnp.int32)
+        opt = optax.adam(1e-2)
+        state = create_train_state(model, opt, xs)
+        step = make_train_step(
+            model.apply, opt, smoothed_cross_entropy_loss(0.1)
+        )
+        first = last = None
+        for _ in range(15):
+            state, loss = step(state, (xs, ys))
+            first = first if first is not None else float(loss)
+            last = float(loss)
+        assert last < first
+
+    def test_registers_exact_eval_twin(self):
+        from distributed_pytorch_tpu.training.losses import (
+            PER_SAMPLE_TWINS,
+            smoothed_cross_entropy_loss,
+        )
+
+        loss_fn = smoothed_cross_entropy_loss(0.1)
+        assert loss_fn in PER_SAMPLE_TWINS
+        rng = np.random.default_rng(2)
+        logits = jnp.asarray(rng.standard_normal((6, 5)), jnp.float32)
+        targets = jnp.asarray(rng.integers(0, 5, (6,)), jnp.int32)
+        per = PER_SAMPLE_TWINS[loss_fn](logits, targets)
+        assert per.shape == (6,)
+        np.testing.assert_allclose(
+            float(jnp.mean(per)), float(loss_fn(logits, targets)), rtol=1e-6
+        )

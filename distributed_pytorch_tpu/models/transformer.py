@@ -338,6 +338,7 @@ class TransformerBlock(nn.Module):
     window: int = 0  # sliding-window attention (see Attention); 0 = full
     rope_scale: float = 1.0  # RoPE linear interpolation (see apply_rope)
     rope_theta: float = 10000.0
+    dropout_rate: float = 0.0  # residual-branch dropout (see TransformerLM)
     n_experts: int = 0  # >0 swaps the dense MLP for an expert-parallel MoEMLP
     moe_top_k: int = 1  # router choices per token (see models/moe.py)
     decode: bool = False
@@ -346,14 +347,24 @@ class TransformerBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        x = x + Attention(
+        def drop(y):
+            # Active only when a "dropout" rng is supplied (the train step
+            # with TrainState.rng armed); eval/decode never pass one, so
+            # they are deterministic with no flags to thread.
+            if self.dropout_rate == 0.0:
+                return y
+            return nn.Dropout(self.dropout_rate)(
+                y, deterministic=not self.has_rng("dropout")
+            )
+
+        x = x + drop(Attention(
             self.n_heads, self.d_model, self.dtype, self.causal,
             n_kv_heads=self.n_kv_heads, window=self.window,
             rope_scale=self.rope_scale, rope_theta=self.rope_theta,
             mesh=self.mesh, sequence_axis=self.sequence_axis,
             sequence_mode=self.sequence_mode, decode=self.decode,
             quantized_cache=self.quantized_cache, name="attention",
-        )(nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x))
+        )(nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)))
         if self.n_experts > 0:
             cls = nn.remat(MoEMLP) if self.remat_mlp else MoEMLP
             mlp = cls(
@@ -363,7 +374,7 @@ class TransformerBlock(nn.Module):
         else:
             cls = nn.remat(MLPBlock) if self.remat_mlp else MLPBlock
             mlp = cls(self.d_ff, self.d_model, self.dtype, name="mlp")
-        x = x + mlp(nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x))
+        x = x + drop(mlp(nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)))
         return x
 
 
@@ -461,6 +472,12 @@ class TransformerLM(nn.Module):
     attention_window: int = 0  # sliding-window attention; 0 = full causal
     rope_scale: float = 1.0  # RoPE linear position interpolation factor
     rope_theta: float = 10000.0  # RoPE frequency base (NTK-aware extension)
+    # Residual-branch + embedding dropout (GPT-2 placement). Active ONLY
+    # when the apply carries a "dropout" rng — the train step does so when
+    # TrainState.rng is armed (create_train_state(dropout_rng=...) /
+    # Trainer(dropout_seed=...)); eval and generation never do, so they
+    # stay deterministic with no train/eval flag plumbing.
+    dropout_rate: float = 0.0
     n_experts: int = 0  # >0: MoE MLPs in every `moe_every`-th block
     moe_top_k: int = 1  # MoE router choices per token (1=Switch, 2=GShard)
     moe_every: int = 2
@@ -488,6 +505,10 @@ class TransformerLM(nn.Module):
             self.vocab_size, self.d_model, dtype=self.dtype, name="embed"
         )
         x = embed(tokens)
+        if self.dropout_rate > 0.0:
+            x = nn.Dropout(self.dropout_rate)(
+                x, deterministic=not self.has_rng("dropout")
+            )
         block = TransformerBlock
         remat_mlp = False
         if self.remat:
@@ -506,6 +527,7 @@ class TransformerLM(nn.Module):
                 sequence_mode=self.sequence_mode,
                 n_kv_heads=self.n_kv_heads, window=self.attention_window,
                 rope_scale=self.rope_scale, rope_theta=self.rope_theta,
+                dropout_rate=self.dropout_rate,
                 n_experts=moe, moe_top_k=self.moe_top_k,
                 decode=self.decode, remat_mlp=remat_mlp,
                 quantized_cache=self.quantized_cache, name=f"block_{i}",

@@ -155,6 +155,7 @@ def make_state_specs(state, param_specs):
         opt_state=_opt_state_specs(state.opt_state, state.params, param_specs),
         step=P(),
         loss_scale=_replicated_like(state.loss_scale),
+        rng=_replicated_like(state.rng),
     )
 
 
@@ -181,6 +182,7 @@ def make_zero1_state_specs(state, *, mesh: Mesh, axis: str = "data"):
         opt_state=_opt_state_specs(state.opt_state, state.params, moment_specs),
         step=P(),
         loss_scale=_replicated_like(state.loss_scale),
+        rng=_replicated_like(state.rng),
     )
 
 

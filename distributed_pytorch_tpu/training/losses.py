@@ -8,6 +8,8 @@ loss is constant 0) and ``MSELoss`` only in the multinode rung
 regression task and real softmax cross-entropy for classification models.
 """
 
+import weakref
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -38,23 +40,26 @@ def smoothed_cross_entropy_loss(smoothing: float):
     if not 0.0 <= smoothing < 1.0:
         raise ValueError(f"smoothing must be in [0, 1), got {smoothing}")
 
-    def loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
-        n_classes = logits.shape[-1]
-        soft = optax.smooth_labels(
-            jax.nn.one_hot(targets, n_classes, dtype=logits.dtype), smoothing
-        )
-        return jnp.mean(optax.softmax_cross_entropy(logits, soft))
-
     def per_sample(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
         n_classes = logits.shape[-1]
         soft = optax.smooth_labels(
             jax.nn.one_hot(targets, n_classes, dtype=logits.dtype), smoothing
         )
-        return optax.softmax_cross_entropy(logits, soft)
+        per = optax.softmax_cross_entropy(logits, soft)
+        # Sequence models produce per-TOKEN values [B, S]; the per-sample
+        # contract is [batch] (mean over everything else), exactly like
+        # per_sample_cross_entropy below.
+        return per.reshape(per.shape[0], -1).mean(axis=-1)
+
+    def loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+        # The batch loss IS the twin's mean — one body, contract by
+        # construction.
+        return jnp.mean(per_sample(logits, targets))
 
     # Register the exact-eval twin so Trainer.evaluate keeps its unbiased
     # wrap-pad-corrected path for this loss too (same mechanism as the
-    # stock losses below).
+    # stock losses below; the registry holds weak keys, so losses built in
+    # a sweep loop don't accumulate forever).
     PER_SAMPLE_TWINS[loss] = per_sample
     return loss
 
@@ -92,7 +97,12 @@ def per_sample_accuracy(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarra
     return correct.reshape(correct.shape[0], -1).mean(axis=-1).astype(jnp.float32)
 
 
-PER_SAMPLE_TWINS = {
-    mse_loss: per_sample_mse,
-    softmax_cross_entropy_loss: per_sample_cross_entropy,
-}
+# Weak keys: factory-built losses (smoothed_cross_entropy_loss) register
+# here too, and a sweep that builds many must not pin them all in memory.
+# The module-level stock losses live for the process anyway.
+PER_SAMPLE_TWINS = weakref.WeakKeyDictionary(
+    {
+        mse_loss: per_sample_mse,
+        softmax_cross_entropy_loss: per_sample_cross_entropy,
+    }
+)

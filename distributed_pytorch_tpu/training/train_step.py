@@ -27,6 +27,7 @@ from typing import Any, Callable, Optional, Tuple
 import flax.struct
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -59,6 +60,12 @@ class TrainState:
     opt_state: Any
     step: jnp.ndarray
     loss_scale: Any = None
+    # Base PRNG key for stochastic layers (dropout): None (the default
+    # structure) means no rngs are threaded and the step compiles exactly
+    # as before. Per-step keys are DERIVED as fold_in(rng, step) — the base
+    # never advances, so resuming from a snapshot replays the identical
+    # mask sequence (reproducibility survives restarts for free).
+    rng: Any = None
 
 
 def create_train_state(
@@ -68,8 +75,13 @@ def create_train_state(
     *,
     rng_seed: int = 0,
     loss_scale: Any = None,
+    dropout_rng: Any = None,
 ) -> TrainState:
-    """Initialize params + optimizer state from a sample input batch."""
+    """Initialize params + optimizer state from a sample input batch.
+
+    ``dropout_rng`` (an int seed or a PRNG key) arms the step's stochastic
+    path: the model is applied with ``rngs={"dropout": fold_in(rng, step)}``
+    each step. Leave None for deterministic models/training."""
     rng = jax.random.PRNGKey(rng_seed)
     # Params are batch-size independent: init from a single row, under jit, so
     # startup cost doesn't scale with the global batch (matters for ResNet-50
@@ -82,12 +94,17 @@ def create_train_state(
     # sow would append to it every step and change the pytree structure.
     variables.pop("losses", None)
     opt_state = optimizer.init(params)
+    if isinstance(dropout_rng, (int, np.integer)):
+        # Positive classification: plain/numpy integer seeds become keys;
+        # anything else (a typed or raw PRNG key array) passes through.
+        dropout_rng = jax.random.PRNGKey(int(dropout_rng))
     return TrainState(
         params=params,
         model_state=variables,
         opt_state=opt_state,
         step=jnp.zeros((), jnp.int32),
         loss_scale=loss_scale,
+        rng=dropout_rng,
     )
 
 
@@ -154,7 +171,14 @@ def make_train_step(
         # a build without mixed_precision.
         loss_scale = state.loss_scale
 
-        def micro_loss(params, model_state, mb_inputs, mb_targets):
+        # Per-step dropout key: derived, never advanced (see TrainState.rng).
+        step_rng = (
+            jax.random.fold_in(state.rng, state.step)
+            if state.rng is not None
+            else None
+        )
+
+        def micro_loss(params, model_state, mb_inputs, mb_targets, mb_rng=None):
             variables = {"params": params, **model_state}
             # "losses" is always mutable so sown penalty terms surface here;
             # it is popped before the aux state re-enters TrainState (it is
@@ -162,8 +186,11 @@ def make_train_step(
             apply_args = (
                 (mb_inputs, mb_targets) if apply_takes_targets else (mb_inputs,)
             )
+            apply_kw = {"mutable": mutable + ["losses"]}
+            if mb_rng is not None:
+                apply_kw["rngs"] = {"dropout": mb_rng}
             predictions, new_model_state = apply_fn(
-                variables, *apply_args, mutable=mutable + ["losses"]
+                variables, *apply_args, **apply_kw
             )
             new_model_state = dict(new_model_state)
             loss = loss_fn(predictions, mb_targets)
@@ -179,7 +206,7 @@ def make_train_step(
 
         if grad_accum == 1:
             grads, (loss, new_model_state) = grad_fn(
-                state.params, state.model_state, inputs, targets
+                state.params, state.model_state, inputs, targets, step_rng
             )
         else:
             if inputs.shape[0] % grad_accum != 0:
@@ -221,10 +248,19 @@ def make_train_step(
             zeros = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params
             )
+            micro_xs = (micro_in, micro_tgt)
+            if step_rng is not None:
+                # Distinct dropout mask per microbatch, all derived from the
+                # per-step key.
+                micro_xs = micro_xs + (
+                    jax.vmap(lambda i: jax.random.fold_in(step_rng, i))(
+                        jnp.arange(grad_accum)
+                    ),
+                )
             (new_model_state, grad_sum, loss_sum), _ = jax.lax.scan(
                 body,
                 (state.model_state, zeros, jnp.zeros((), jnp.float32)),
-                (micro_in, micro_tgt),
+                micro_xs,
             )
             grads = jax.tree_util.tree_map(
                 lambda g, p: (g / grad_accum).astype(p.dtype),
@@ -258,6 +294,7 @@ def make_train_step(
             opt_state=new_opt_state,
             step=state.step + 1,
             loss_scale=new_loss_scale,
+            rng=state.rng,
         )
         return new_state, loss
 

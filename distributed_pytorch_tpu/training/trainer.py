@@ -82,6 +82,7 @@ class Trainer:
         loss_scale=None,
         partition_specs=None,
         keep_checkpoints: int = 0,
+        dropout_seed: Optional[int] = None,
     ):
         self.model = model
         self.train_data = train_data
@@ -152,8 +153,12 @@ class Trainer:
         sample_x, _ = next(iter(train_data))
         # loss_scale: a mixed_precision.{Static,Dynamic}LossScale for fp16
         # compute policies; rides in TrainState (see train_step.TrainState).
+        # dropout_seed arms the step's stochastic path (the model must set
+        # dropout_rate > 0 for it to have any effect; eval/decode stay
+        # deterministic either way — see train_step.TrainState.rng).
         self.state: TrainState = create_train_state(
-            model, optimizer, sample_x, rng_seed=rng_seed, loss_scale=loss_scale
+            model, optimizer, sample_x, rng_seed=rng_seed,
+            loss_scale=loss_scale, dropout_rng=dropout_seed,
         )
         # partition_specs opens the sharding zoo through the flagship API:
         # either a params-shaped PartitionSpec tree (TP/FSDP rule output —

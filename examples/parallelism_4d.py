@@ -93,6 +93,21 @@ def main(steps: int):
         batch_spec=P("data", "sequence"),
     )
 
+    # --- DP x SP(ulysses) x TP: the all-to-all SP strategy ---------------
+    # SAME mesh and SAME tokens as the ring block above, only
+    # sequence_mode="ulysses" (two all-to-alls redistribute seq->heads;
+    # needs (n_heads / tp) % sp == 0 — here 4/2 = 2 local heads over
+    # sp=2), so the two strategies' printed losses are directly
+    # comparable.
+    uly = TransformerLM(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+        mesh=mesh, sequence_axis="sequence", sequence_mode="ulysses",
+    )
+    run_config(
+        "dp x sp(ulysses) x tp", uly, mesh, TRANSFORMER_TP_RULES, tokens,
+        steps, batch_spec=P("data", "sequence"),
+    )
+
     # --- DP x EP: mixture-of-experts over the expert axis -----------------
     mesh = make_mesh({"data": dp, "expert": 4})
     moe = TransformerLM(

@@ -860,6 +860,10 @@ class TestScaleDownLiveTraining:
             while not (tmp_path / "epoch.1.1.w2").exists():
                 assert time.time() < deadline, "node 1 never reached epoch 1"
                 assert agent0.poll() is None, agent0.communicate()[1]
+                # agent1 must be ALIVE until the deliberate kill — an early
+                # crash should fail fast with its stderr, not burn the
+                # deadline.
+                assert agent1.poll() is None, agent1.communicate()[1]
                 time.sleep(0.2)
             os.killpg(os.getpgid(agent1.pid), signal.SIGKILL)
 

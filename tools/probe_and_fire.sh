@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Tunnel-recovery automation (VERDICT r04 item 1): probe the axon TPU tunnel
+# on a fixed cadence, log every attempt, and fire tools/chip_day.sh the
+# moment a probe succeeds — so no chip-minute is wasted waiting on a human.
+#
+#   bash tools/probe_and_fire.sh &        # logs to chip_probe.log
+#
+# Design constraints (BASELINE.md round-3/4 outage notes):
+#  * The probe is a plain `jax.devices()` dial — no compile in flight, so
+#    timing it out cannot wedge the relay (killing a mid-flight COMPILE can).
+#  * Only ONE axon client at a time: the probe and chip_day.sh never overlap
+#    (the fire happens in the same serialized loop iteration).
+#  * PYTHONPATH must include /root/.axon_site for the plugin (memory note).
+set -u
+cd "$(dirname "$0")/.."
+
+LOG=${PROBE_LOG:-chip_probe.log}
+INTERVAL=${PROBE_INTERVAL:-1200}   # seconds between probes
+PROBE_TIMEOUT=${PROBE_TIMEOUT:-150}
+
+say() { echo "[$(date -u +%FT%TZ)] $*" | tee -a "$LOG" >&2; }
+
+say "probe loop start (interval=${INTERVAL}s, timeout=${PROBE_TIMEOUT}s)"
+while :; do
+  if timeout "$PROBE_TIMEOUT" env PYTHONPATH=/root/.axon_site python - <<'EOF' >>"$LOG" 2>&1
+import jax
+ds = jax.devices()
+assert ds and ds[0].platform != "cpu", ds
+print("TUNNEL UP:", ds)
+EOF
+  then
+    say "tunnel recovered — firing chip_day.sh (serialized, do not interrupt)"
+    bash tools/chip_day.sh >chip_day.log 2>&1
+    say "chip_day.sh finished rc=$? — see chip_day.log; probe loop exiting"
+    exit 0
+  else
+    say "probe failed (tunnel still wedged); next attempt in ${INTERVAL}s"
+  fi
+  sleep "$INTERVAL"
+done

@@ -192,26 +192,26 @@ class Attention(nn.Module):
             and self.sequence_axis is not None
             and self.mesh.shape.get(self.sequence_axis, 1) > 1
         )
-        if use_ring and self.window:
-            raise ValueError(
-                "sliding-window attention is not composed with sequence "
-                "parallelism yet; drop window= or the sequence axis"
-            )
         if use_ring and self.sequence_mode == "ulysses":
             # Pre-repeat is structural here: the all-to-all splits the
             # (query) head dim across the axis, so K/V must carry the same
-            # head count. (validated mode at __call__ top)
+            # head count. (validated mode at __call__ top) Sliding-window
+            # composes trivially: post-exchange attention is full-sequence
+            # local, the band is just a mask.
             out = ulysses_attention(
                 q, kx, vx, mesh=self.mesh, axis_name=self.sequence_axis,
-                causal=self.causal,
+                causal=self.causal, window=self.window,
             )
         elif use_ring:
             # Ring rotates K/V around the ICI ring every hop: hand it the
             # UN-repeated kv-head blocks (kv_groups broadcasts per hop,
-            # compute-side) so GQA cuts the interconnect bytes too.
+            # compute-side) so GQA cuts the interconnect bytes too. With
+            # window > 0, hops wholly behind the band are never rotated
+            # (ring_live_hops): ICI traffic and compute are O(window).
             out = ring_attention(
                 q, k, v, mesh=self.mesh, axis_name=self.sequence_axis,
                 causal=self.causal, kv_groups=self.n_heads // kv_heads,
+                window=self.window,
             )
         else:
             out = flash_attention(

@@ -81,18 +81,24 @@ def dot_product_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
-def _dense_hop(q32, k_blk, v_blk, *, causal_mask_offset=None):
+def _dense_hop(q32, k_blk, v_blk, *, positions=None, window=0):
     """One ring hop's local attention with its logsumexp, dense XLA math.
     ``q32``: [B, Tq, H, D] fp32; ``k_blk``/``v_blk``: [B, Tk, H, D].
-    ``causal_mask_offset``: (q_pos, kv_pos) arrays for the diagonal hop, None
-    for a fully-visible hop. Returns ``(o [B,Tq,H,D] f32, lse [B,H,Tq] f32)``."""
+    ``positions``: (q_pos, kv_pos) GLOBAL position arrays for a masked hop
+    (the causal diagonal, or any hop of banded attention), None for a
+    fully-visible hop; ``window > 0`` adds the band's lower bound.
+    Returns ``(o [B,Tq,H,D] f32, lse [B,H,Tq] f32)``. Fully-masked rows
+    come out with lse ~ NEG_INF, so the online merge weighs them to zero."""
     scale = q32.shape[-1] ** -0.5
     logits = (
         jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
     )
-    if causal_mask_offset is not None:
-        q_pos, kv_pos = causal_mask_offset
-        logits = jnp.where(q_pos[:, None] >= kv_pos[None, :], logits, NEG_INF)
+    if positions is not None:
+        q_pos, kv_pos = positions
+        ok = q_pos[:, None] >= kv_pos[None, :]
+        if window:
+            ok = ok & (q_pos[:, None] - kv_pos[None, :] < window)
+        logits = jnp.where(ok, logits, NEG_INF)
     m = jnp.max(logits, axis=-1)  # [B,H,Tq]
     p = jnp.exp(logits - m[..., None])
     l = jnp.sum(p, axis=-1)
@@ -101,9 +107,15 @@ def _dense_hop(q32, k_blk, v_blk, *, causal_mask_offset=None):
     return o, m + jnp.log(l)
 
 
-def _flash_hop(q, k_blk, v_blk, *, causal, block_q, block_k, interpret):
+def _flash_hop(
+    q, k_blk, v_blk, *, causal, block_q, block_k, interpret,
+    window=0, q_offset=0,
+):
     """One ring hop through the Pallas flash kernel (``[B,T,H,D]`` in/out,
-    ``lse`` reshaped to the merge layout ``[B,H,Tq]``)."""
+    ``lse`` reshaped to the merge layout ``[B,H,Tq]``). ``window``/
+    ``q_offset`` (static) select the kernel's banded path: masking sees the
+    queries at ``local + q_offset`` global rows, so off-diagonal hops of
+    sliding-window attention skip their out-of-band tiles in-kernel."""
     from distributed_pytorch_tpu.ops.flash_attention import (
         flash_attention_with_lse,
     )
@@ -114,11 +126,34 @@ def _flash_hop(q, k_blk, v_blk, *, causal, block_q, block_k, interpret):
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
 
     o3, lse3 = flash_attention_with_lse(
-        to3(q), to3(k_blk), to3(v_blk), causal, block_q, block_k, interpret
+        to3(q), to3(k_blk), to3(v_blk), causal, block_q, block_k, interpret,
+        window, q_offset,
     )
     o = o3.reshape(b, h, t, d).transpose(0, 2, 1, 3).astype(jnp.float32)
     lse = lse3[..., 0].reshape(b, h, t)
     return o, lse
+
+
+def _merge_hops(o_acc, lse_acc, o_hop, lse_hop):
+    """Online-softmax merge of two partial attention results (flash-style
+    running rescale, shared by the fori and the unrolled windowed loops)."""
+    lse_new = jnp.logaddexp(lse_acc, lse_hop)
+    w_acc = jnp.exp(lse_acc - lse_new).transpose(0, 2, 1)[..., None]
+    w_hop = jnp.exp(lse_hop - lse_new).transpose(0, 2, 1)[..., None]
+    return o_acc * w_acc + o_hop * w_hop, lse_new
+
+
+def ring_live_hops(axis_size: int, t_local: int, window: int) -> int:
+    """How many off-diagonal ring hops of banded (sliding-window) attention
+    carry ANY live (q, k) pair. Hop ``s`` holds keys whose newest position
+    trails this device's oldest query by ``(s-1)*t_local + 1`` rows — live
+    only while that gap is ``< window``. The q/k offset is uniform around
+    the ring, so the bound holds for every device and dead hops need not
+    even rotate: ring cost drops from ``axis_size - 1`` hops to
+    ``O(window / t_local)``."""
+    if window <= 1:  # each query sees only itself
+        return 0
+    return min(axis_size - 1, (window - 2) // t_local + 1)
 
 
 def _ring_attention_shard(
@@ -131,6 +166,7 @@ def _ring_attention_shard(
     flash_blocks=None,
     interpret: bool = False,
     kv_groups: int = 1,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Per-device body (runs under shard_map): per-hop local attention with
     online lse merging over rotating K/V blocks.
@@ -142,6 +178,14 @@ def _ring_attention_shard(
     are skipped entirely via ``lax.cond`` — no score FLOPs, no exp, only the
     ring rotation they must forward anyway.
 
+    ``window > 0`` (sliding-window attention, causal only) switches the hop
+    loop from ``fori_loop`` to a PYTHON-unrolled loop over the statically
+    known live hops (:func:`ring_live_hops`): hops wholly behind the band
+    are never rotated at all — the ring's ppermute count drops from
+    ``axis_size - 1`` to ``O(window / t_local)`` — and each live hop masks
+    with global coordinates (static ``q_offset`` into the flash kernel, so
+    its out-of-band tiles skip their MXU work too).
+
     Hop structure: block at step ``s`` is the K/V shard originally owned by
     device ``(my_index - s) % axis_size``. Step 0 is this device's own block
     — the causal *diagonal* — so the accumulator starts finite and the merge
@@ -151,7 +195,7 @@ def _ring_attention_shard(
     my_index = jax.lax.axis_index(axis_name)
     t_local = q.shape[1]
 
-    def hop(k_blk, v_blk, hop_causal, kv_index):
+    def hop(k_blk, v_blk, hop_causal, kv_index, q_offset=0):
         if kv_groups > 1:
             # GQA: blocks ROTATE at kv-head size (the ICI saving); the
             # broadcast to query heads is local per hop and fuses into the
@@ -159,13 +203,14 @@ def _ring_attention_shard(
             k_blk = jnp.repeat(k_blk, kv_groups, axis=2)
             v_blk = jnp.repeat(v_blk, kv_groups, axis=2)
         if flash_blocks is not None:
-            # hop_causal selects the kernel's own causal path for the
-            # diagonal block (local positions align there: global offsets
-            # are equal), unmasked otherwise.
+            # hop_causal selects the kernel's masked path: the diagonal
+            # block (local positions align; q_offset 0) or, under window,
+            # any live hop with its static global offset. Unmasked
+            # otherwise.
             return _flash_hop(
                 q, k_blk, v_blk, causal=hop_causal,
                 block_q=flash_blocks[0], block_k=flash_blocks[1],
-                interpret=interpret,
+                interpret=interpret, window=window, q_offset=q_offset,
             )
         offsets = None
         if hop_causal:
@@ -173,13 +218,35 @@ def _ring_attention_shard(
             kv_pos = kv_index * t_local + jnp.arange(t_local)
             offsets = (q_pos, kv_pos)
         return _dense_hop(
-            q.astype(jnp.float32), k_blk, v_blk, causal_mask_offset=offsets
+            q.astype(jnp.float32), k_blk, v_blk, positions=offsets,
+            window=window,
         )
 
     # Step 0: own block (the diagonal when causal).
     o_acc, lse_acc = hop(k, v, causal, my_index)
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    if causal and window:
+        # Banded: unroll over the statically-live hops only. Dead hops are
+        # dead for EVERY device (the q/k offset is uniform around the
+        # ring), so their rotations vanish from the program entirely.
+        k_blk, v_blk = k, v
+        for step in range(1, ring_live_hops(axis_size, t_local, window) + 1):
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            kv_index = (my_index - step) % axis_size
+
+            def live(args, _k=k_blk, _v=v_blk, _kv=kv_index, _s=step):
+                o_hop, lse_hop = hop(_k, _v, True, _kv, _s * t_local)
+                return _merge_hops(*args, o_hop, lse_hop)
+
+            # Wraparound hops (step > my_index) hold future keys: fully
+            # masked causally, skip all compute.
+            o_acc, lse_acc = jax.lax.cond(
+                step <= my_index, live, lambda args: args, (o_acc, lse_acc)
+            )
+        return o_acc.astype(q.dtype)
 
     def body(step, carry):
         o_acc, lse_acc, k_blk, v_blk = carry
@@ -192,10 +259,7 @@ def _ring_attention_shard(
         def live(args):
             o_acc, lse_acc = args
             o_hop, lse_hop = hop(k_blk, v_blk, False, kv_index)
-            lse_new = jnp.logaddexp(lse_acc, lse_hop)
-            w_acc = jnp.exp(lse_acc - lse_new).transpose(0, 2, 1)[..., None]
-            w_hop = jnp.exp(lse_hop - lse_new).transpose(0, 2, 1)[..., None]
-            return o_acc * w_acc + o_hop * w_hop, lse_new
+            return _merge_hops(o_acc, lse_acc, o_hop, lse_hop)
 
         if causal:
             # Hop blocks are fully visible iff the block's owner precedes
@@ -223,6 +287,7 @@ def _ulysses_shard(
     causal: bool,
     flash_blocks=None,
     interpret: bool = False,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Per-device body (runs under shard_map): head-scatter / seq-gather
     all-to-all, full-sequence attention on the local heads, inverse
@@ -232,8 +297,9 @@ def _ulysses_shard(
     splits the heads dim across the axis and concatenates the sequence dim
     (tiled, source-device order = global sequence order), yielding
     [B, T, H_local/sp, D]; attention then needs NO cross-device math at all
-    — the causal mask is the ordinary full-sequence one — and the inverse
-    exchange restores the sequence sharding.
+    — the causal mask is the ordinary full-sequence one (optionally banded:
+    ``window`` composes trivially here) — and the inverse exchange restores
+    the sequence sharding.
     """
     def scatter_heads(x):
         return jax.lax.all_to_all(
@@ -249,10 +315,10 @@ def _ulysses_shard(
         o = flash_attention_4d(
             qh, kh, vh, causal=causal,
             block_q=flash_blocks[0], block_k=flash_blocks[1],
-            interpret=interpret,
+            interpret=interpret, window=window,
         )
     else:
-        o = dot_product_attention(qh, kh, vh, causal=causal)
+        o = dot_product_attention(qh, kh, vh, causal=causal, window=window)
     return jax.lax.all_to_all(
         o, axis_name, split_axis=1, concat_axis=2, tiled=True
     )
@@ -272,6 +338,7 @@ def ulysses_attention(
     interpret: bool = False,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
+    window: int = 0,
 ) -> jnp.ndarray:
     """DeepSpeed-Ulysses-style sequence parallelism: all-to-all over the
     ``axis_name`` mesh axis redistributes sequence-sharded activations into
@@ -297,9 +364,13 @@ def ulysses_attention(
     ``heads_axis`` and stay sharded — Ulysses further splits the LOCAL
     heads, so it needs ``(H / tp) % sp == 0``.
     """
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("window requires causal=True")
     seq_size = mesh.shape.get(axis_name, 1)
     if seq_size == 1:
-        return dot_product_attention(q, k, v, causal=causal)
+        return dot_product_attention(q, k, v, causal=causal, window=window)
     b, t, h, d = q.shape
     if t % seq_size != 0:
         raise ValueError(
@@ -334,6 +405,7 @@ def ulysses_attention(
         causal=causal,
         flash_blocks=flash_blocks,
         interpret=interpret,
+        window=window,
     )
     return jax.shard_map(
         body,
@@ -359,8 +431,15 @@ def ring_attention(
     block_q: Optional[int] = None,  # None: measured table (flash_autotune)
     block_k: Optional[int] = None,
     kv_groups: int = 1,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Sequence-parallel attention over globally-shaped arrays.
+
+    ``window > 0`` (causal only) composes sliding-window attention with the
+    ring: live hops mask to the band (in-kernel tile skipping included) and
+    hops wholly outside the band are NEVER ROTATED — the hop loop unrolls
+    to the static :func:`ring_live_hops` bound, so both compute AND ICI
+    traffic drop from O(T) to O(window) per query block.
 
     ``kv_groups > 1`` is grouped-query attention: ``k``/``v`` carry
     ``H / kv_groups`` heads and ROTATE at that size (the ppermute bytes are
@@ -382,10 +461,14 @@ def ring_attention(
     ``heads_axis``; the shard_map keeps it sharded (heads are independent in
     attention), so SP x TP composes without gathering activations.
     """
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("window requires causal=True")
     seq_size = mesh.shape.get(axis_name, 1)
     if seq_size == 1:
         # Mesh has no (or a trivial) sequence axis: plain dense attention.
-        return dot_product_attention(q, k, v, causal=causal)
+        return dot_product_attention(q, k, v, causal=causal, window=window)
     if q.shape[1] % seq_size != 0:
         raise ValueError(
             f"sequence length {q.shape[1]} not divisible by mesh axis "
@@ -431,6 +514,7 @@ def ring_attention(
         flash_blocks=hop_blocks,
         interpret=interpret,
         kv_groups=kv_groups,
+        window=window,
     )
     return jax.shard_map(
         body,

@@ -76,11 +76,14 @@ def _tile_live(q_start, block_q, k_start, block_k, causal, window):
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, scale, causal, window=0,
+    *, scale, causal, window=0, q_offset=0,
 ):
     block_q, d = q_ref.shape[1:]
     block_k = k_ref.shape[1]
-    q_start = pl.program_id(1) * block_q
+    # q_offset shifts query positions for masking only (ring hops: this
+    # device's queries sit q_offset rows below the visiting K/V block's
+    # origin in the GLOBAL sequence, while both arrays index locally).
+    q_start = pl.program_id(1) * block_q + q_offset
     k_idx = pl.program_id(2)
     k_start = k_idx * block_k
 
@@ -129,17 +132,24 @@ def _fwd_kernel(
     @pl.when(k_idx == pl.num_programs(2) - 1)
     def _finish():
         l = l_scr[:, :1]
-        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0, :, :] = m_scr[:, :1] + jnp.log(l)
+        # A query row whose every tile was skipped (possible only in a
+        # banded off-diagonal ring hop: the whole row sits outside the
+        # window) leaves l = 0 — emit 0 output and a NEG_INF lse so the
+        # ring merge weighs the row to exactly zero instead of NaN (0/0).
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, :, :] = jnp.where(
+            l == 0.0, NEG_INF, m_scr[:, :1] + jnp.log(l_safe)
+        )
 
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, scale, causal, window=0,
+    *, scale, causal, window=0, q_offset=0,
 ):
     block_q, d = q_ref.shape[1:]
     block_k = k_ref.shape[1]
-    q_start = pl.program_id(1) * block_q
+    q_start = pl.program_id(1) * block_q + q_offset
     k_idx = pl.program_id(2)
     k_start = k_idx * block_k
 
@@ -184,13 +194,13 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr, *, scale, causal, window=0,
+    dk_scr, dv_scr, *, scale, causal, window=0, q_offset=0,
 ):
     block_k, d = k_ref.shape[1:]
     block_q = q_ref.shape[1]
     k_start = pl.program_id(1) * block_k
     q_idx = pl.program_id(2)
-    q_start = q_idx * block_q
+    q_start = q_idx * block_q + q_offset
 
     @pl.when(q_idx == 0)
     def _init():
@@ -269,17 +279,22 @@ def _swap_q(spec_fn, block, *rest):
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, block_q, block_k, interpret, window=0):
-    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret, window)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, block_q, block_k, interpret, window=0, q_offset=0):
+    out, _ = _flash_fwd(
+        q, k, v, causal, block_q, block_k, interpret, window, q_offset
+    )
     return out
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, window=0):
+def _flash_fwd(
+    q, k, v, causal, block_q, block_k, interpret, window=0, q_offset=0
+):
     bh, seq, d = q.shape
     out, lse = pl.pallas_call(
         functools.partial(
-            _fwd_kernel, scale=d**-0.5, causal=causal, window=window
+            _fwd_kernel, scale=d**-0.5, causal=causal, window=window,
+            q_offset=q_offset,
         ),
         grid=(bh, seq // block_q, seq // block_k),
         in_specs=[
@@ -302,26 +317,30 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, window=0):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, window, residuals, g):
+def _flash_bwd(
+    causal, block_q, block_k, interpret, window, q_offset, residuals, g
+):
     q, k, v, out, lse = residuals
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )[:, :, None]
     return _flash_bwd_impl(
         causal, block_q, block_k, interpret, q, k, v, lse, g, delta,
-        window=window,
+        window=window, q_offset=q_offset,
     )
 
 
 def _flash_bwd_impl(
-    causal, block_q, block_k, interpret, q, k, v, lse, g, delta, window=0
+    causal, block_q, block_k, interpret, q, k, v, lse, g, delta,
+    window=0, q_offset=0,
 ):
     bh, seq, d = q.shape
     scale = d**-0.5
 
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, scale=scale, causal=causal, window=window
+            _bwd_dq_kernel, scale=scale, causal=causal, window=window,
+            q_offset=q_offset,
         ),
         grid=(bh, seq // block_q, seq // block_k),
         in_specs=[
@@ -340,7 +359,8 @@ def _flash_bwd_impl(
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, scale=scale, causal=causal, window=window
+            _bwd_dkv_kernel, scale=scale, causal=causal, window=window,
+            q_offset=q_offset,
         ),
         grid=(bh, seq // block_k, seq // block_q),
         in_specs=[
@@ -369,8 +389,10 @@ def _flash_bwd_impl(
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention_with_lse(q, k, v, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def flash_attention_with_lse(
+    q, k, v, causal, block_q, block_k, interpret, window=0, q_offset=0
+):
     """Tiled attention returning ``(out, lse)`` over ``[BH, T, D]`` inputs.
 
     The building block for composing this kernel with
@@ -380,18 +402,33 @@ def flash_attention_with_lse(q, k, v, causal, block_q, block_k, interpret):
     ``delta - dlse`` (since d(lse)/d(scores) is exactly the softmax ``p``,
     the same factor the dO path multiplies), so no extra kernel is needed.
 
+    ``window``/``q_offset`` (causal only) make one call compute a ring hop of
+    BANDED attention: masking sees query positions at ``local + q_offset``
+    while keys stay local, so an off-diagonal hop (queries ``q_offset``
+    rows below the visiting K/V block) masks with global coordinates and
+    out-of-band tiles skip their MXU work.
+
     ``lse`` is ``[BH, T, 1]`` float32.
     """
-    out, residuals = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    out, residuals = _flash_fwd(
+        q, k, v, causal, block_q, block_k, interpret, window, q_offset
+    )
     return out, residuals[-1]
 
 
-def _flash_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, residuals = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+def _flash_lse_fwd(
+    q, k, v, causal, block_q, block_k, interpret, window=0, q_offset=0
+):
+    out, residuals = _flash_fwd(
+        q, k, v, causal, block_q, block_k, interpret, window, q_offset
+    )
     return (out, residuals[-1]), residuals
 
 
-def _flash_lse_bwd(causal, block_q, block_k, interpret, residuals, cotangents):
+def _flash_lse_bwd(
+    causal, block_q, block_k, interpret, window, q_offset, residuals,
+    cotangents,
+):
     g_out, g_lse = cotangents
     q, k, v, out, lse = residuals
     delta = (
@@ -401,7 +438,8 @@ def _flash_lse_bwd(causal, block_q, block_k, interpret, residuals, cotangents):
         - g_lse.astype(jnp.float32)
     )
     return _flash_bwd_impl(
-        causal, block_q, block_k, interpret, q, k, v, lse, g_out, delta
+        causal, block_q, block_k, interpret, q, k, v, lse, g_out, delta,
+        window=window, q_offset=q_offset,
     )
 
 

@@ -208,3 +208,166 @@ class TestUlyssesAttention:
         ref = dot_product_attention(q, k, v, causal=True)
         out = ulysses_attention(q, k, v, mesh=mesh, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+# ------------------------------------------------- sliding window x SP
+
+
+class TestWindowedSequenceParallel:
+    """Sliding-window attention composed with sequence parallelism
+    (VERDICT r04 item 3 — the former feature-matrix hole): ring masks its
+    live hops to the band and NEVER ROTATES dead hops (the loop unrolls to
+    the static ring_live_hops bound), ulysses applies the band as a local
+    mask after its exchange. Both must equal the banded dense reference."""
+
+    # windows spanning: degenerate (1), sub-hop (5), exactly one hop (8,9),
+    # two hops (16), nearly full (31), band never binds (64 > T)
+    WINDOWS = [1, 5, 8, 9, 16, 31, 64]
+
+    @pytest.mark.parametrize("window", WINDOWS)
+    def test_ring_matches_banded_dense(self, window):
+        mesh = make_mesh({"sequence": 4}, devices=jax.devices()[:4])
+        q, k, v = _qkv(b=2, t=32, h=2, d=8)
+        ref = dot_product_attention(q, k, v, causal=True, window=window)
+        out = ring_attention(q, k, v, mesh=mesh, causal=True, window=window)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
+    @pytest.mark.parametrize("window", [5, 16, 48])
+    def test_ring_flash_matches_banded_dense(self, window):
+        """Same band, through the Pallas kernel (static q_offset per hop,
+        out-of-band tiles skipped in-kernel)."""
+        mesh = make_mesh({"sequence": 4}, devices=jax.devices()[:4])
+        q, k, v = _qkv(b=2, t=64, h=2, d=16)
+        ref = dot_product_attention(q, k, v, causal=True, window=window)
+        out = ring_attention(
+            q, k, v, mesh=mesh, causal=True, window=window,
+            use_flash=True, interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4
+        )
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("use_flash", [False, True])
+    def test_ring_gradients_match_banded_dense(self, use_flash):
+        mesh = make_mesh({"sequence": 4}, devices=jax.devices()[:4])
+        q, k, v = _qkv(b=2, t=64, h=2, d=16)
+        window = 20
+
+        def loss_dense(q, k, v):
+            return jnp.sum(
+                dot_product_attention(q, k, v, causal=True, window=window)
+                ** 2
+            )
+
+        def loss_ring(q, k, v):
+            return jnp.sum(
+                ring_attention(
+                    q, k, v, mesh=mesh, causal=True, window=window,
+                    use_flash=use_flash, interpret=use_flash,
+                )
+                ** 2
+            )
+
+        ref = jax.grad(loss_dense, (0, 1, 2))(q, k, v)
+        got = jax.grad(loss_ring, (0, 1, 2))(q, k, v)
+        for a, b in zip(got, ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            )
+
+    def test_ring_window_composes_with_gqa(self):
+        """kv_groups (GQA rotation at kv-head size) x window: parity vs the
+        banded dense reference on pre-broadcast K/V."""
+        mesh = make_mesh({"sequence": 4}, devices=jax.devices()[:4])
+        q, _, _ = _qkv(b=2, t=32, h=4, d=8, seed=1)
+        _, k, v = _qkv(b=2, t=32, h=2, d=8, seed=2)
+        kx = jnp.repeat(k, 2, axis=2)
+        vx = jnp.repeat(v, 2, axis=2)
+        ref = dot_product_attention(q, kx, vx, causal=True, window=10)
+        out = ring_attention(
+            q, k, v, mesh=mesh, causal=True, window=10, kv_groups=2
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
+    @pytest.mark.parametrize("window", WINDOWS)
+    def test_ulysses_matches_banded_dense(self, window):
+        from distributed_pytorch_tpu.ops.attention import ulysses_attention
+
+        mesh = make_mesh({"sequence": 4}, devices=jax.devices()[:4])
+        q, k, v = _qkv(b=2, t=32, h=4, d=8)
+        ref = dot_product_attention(q, k, v, causal=True, window=window)
+        out = ulysses_attention(
+            q, k, v, mesh=mesh, causal=True, window=window
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
+    def test_ulysses_window_gradients(self):
+        from distributed_pytorch_tpu.ops.attention import ulysses_attention
+
+        mesh = make_mesh({"sequence": 4}, devices=jax.devices()[:4])
+        q, k, v = _qkv(b=2, t=32, h=4, d=8)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(
+                dot_product_attention(q, k, v, causal=True, window=7) ** 2
+            )
+
+        def loss_uly(q, k, v):
+            return jnp.sum(
+                ulysses_attention(
+                    q, k, v, mesh=mesh, causal=True, window=7
+                )
+                ** 2
+            )
+
+        ref = jax.grad(loss_dense, (0, 1, 2))(q, k, v)
+        got = jax.grad(loss_uly, (0, 1, 2))(q, k, v)
+        for a, b in zip(got, ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            )
+
+    def test_window_requires_causal(self):
+        from distributed_pytorch_tpu.ops.attention import ulysses_attention
+
+        mesh = make_mesh({"sequence": 4}, devices=jax.devices()[:4])
+        q, k, v = _qkv(b=2, t=32, h=4, d=8)
+        with pytest.raises(ValueError, match="causal"):
+            ring_attention(q, k, v, mesh=mesh, causal=False, window=4)
+        with pytest.raises(ValueError, match="causal"):
+            ulysses_attention(q, k, v, mesh=mesh, causal=False, window=4)
+
+    def test_ring_live_hops_bound(self):
+        from distributed_pytorch_tpu.ops.attention import ring_live_hops
+
+        assert ring_live_hops(8, 8, 1) == 0  # self-only band
+        assert ring_live_hops(8, 8, 2) == 1
+        assert ring_live_hops(8, 8, 8) == 1
+        assert ring_live_hops(8, 8, 9) == 1  # hop 2's newest key: gap 9
+        assert ring_live_hops(8, 8, 10) == 2
+        assert ring_live_hops(4, 8, 10**6) == 3  # capped at axis_size - 1
+
+    def test_dead_hops_are_not_rotated(self):
+        """The O(window) ICI claim, verified on the lowered program: with
+        W <= t_local + 1 only ONE hop (2 collective-permutes: k and v)
+        survives; with W = 1 the program has NO collective-permute at
+        all. The unwindowed causal ring keeps its rotating while-loop."""
+        mesh = make_mesh({"sequence": 4}, devices=jax.devices()[:4])
+        q, k, v = _qkv(b=2, t=32, h=2, d=8)  # t_local = 8
+
+        def lowered(window):
+            fn = lambda q, k, v: ring_attention(  # noqa: E731
+                q, k, v, mesh=mesh, causal=True, window=window
+            )
+            return jax.jit(fn).lower(q, k, v).as_text()
+
+        assert lowered(1).count("collective_permute") == 0
+        assert lowered(5).count("collective_permute") == 2
+        assert lowered(10).count("collective_permute") == 4

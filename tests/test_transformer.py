@@ -395,13 +395,25 @@ class TestSlidingWindowModel:
             rtol=1e-4, atol=1e-4,
         )
 
-    def test_window_with_sequence_parallelism_raises(self):
+    def test_window_composes_with_sequence_parallelism(self):
+        """Ring and ulysses must reproduce the dense windowed logits on a
+        dp x sp mesh (closes VERDICT r04 item 3 — this combination used to
+        raise)."""
         mesh = make_mesh({"data": 2, "sequence": 4})
-        model = TransformerLM(
-            **self.WIN, mesh=mesh, sequence_axis="sequence"
-        )
-        with pytest.raises(ValueError, match="sliding-window"):
-            model.init(jax.random.PRNGKey(0), self._tokens(t=32))
+        dense = TransformerLM(**self.WIN)
+        tokens = self._tokens(t=32)
+        variables = dense.init(jax.random.PRNGKey(0), tokens)
+        ref = dense.apply(variables, tokens)
+        for mode in ("ring", "ulysses"):
+            sp = TransformerLM(
+                **self.WIN, mesh=mesh, sequence_axis="sequence",
+                sequence_mode=mode,
+            )
+            out = sp.apply(variables, tokens)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4,
+                err_msg=mode,
+            )
 
 
 class TestRopeScaling:

@@ -30,7 +30,9 @@ print("TUNNEL UP:", ds)
 EOF
   then
     say "tunnel recovered — firing chip_day.sh (serialized, do not interrupt)"
-    bash tools/chip_day.sh >chip_day.log 2>&1
+    # The payload needs the SAME axon plugin env the probe used, or every
+    # step silently falls back to CPU and wastes the recovered chip window.
+    PYTHONPATH=/root/.axon_site bash tools/chip_day.sh >chip_day.log 2>&1
     say "chip_day.sh finished rc=$? — see chip_day.log; probe loop exiting"
     exit 0
   else

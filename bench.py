@@ -413,6 +413,15 @@ def bench_scaling(n_steps: int = 10, per_chip_batch: int = 8, seq_len: int = 512
     optimizer = optax.adam(1e-4)
     rng = np.random.default_rng(0)
 
+    # On shared-host virtual devices the per-chip "efficiency" measures
+    # host-core saturation (~1/N by construction), not the interconnect —
+    # name the row key accordingly so the file cannot be misread as a
+    # scaling result (VERDICT r04 item 8). Real hardware keeps the real key.
+    meaningful = not on_cpu and len(devices) > 1
+    eff_key = (
+        "per_chip_efficiency" if meaningful
+        else "per_chip_ratio_shared_host_cores"
+    )
     rows = []
     base_sps = None
     for n in counts:
@@ -436,7 +445,7 @@ def bench_scaling(n_steps: int = 10, per_chip_batch: int = 8, seq_len: int = 512
                 "per_chip_batch": per_chip_batch,
                 "steps_per_sec": round(sps, 4),
                 "tokens_per_sec": round(sps * batch * seq_len, 1),
-                "per_chip_efficiency": round(sps / base_sps, 4),
+                eff_key: round(sps / base_sps, 4),
             }
         )
     return {
@@ -450,6 +459,11 @@ def bench_scaling(n_steps: int = 10, per_chip_batch: int = 8, seq_len: int = 512
         # saturation (expected ~1/N), not the interconnect. The harness is
         # validated here; the number waits for hardware.
         "awaiting_hardware": on_cpu or len(devices) == 1,
+        # False => the per-chip ratio is host-core saturation, NOT scaling
+        # efficiency; the north-star >=90% must never be read off this file
+        # unless this flag is true.
+        "efficiency_meaningful": meaningful,
+        "efficiency_key": eff_key,
         "rows": rows,
     }
 
@@ -515,13 +529,21 @@ def init_backend_with_retry(
             return payload, None
         last_err = payload
         # Drop the cached failed-backend state so the next attempt
-        # actually re-dials instead of replaying the cached error.
+        # actually re-dials instead of replaying the cached error. The
+        # private API may move between jax versions — say so when it does,
+        # because without the clear every retry silently replays the cached
+        # error and the loop only pretends to retry (ADVICE r04).
         try:
             from jax._src import xla_bridge as _xb
 
             _xb._clear_backends()
-        except Exception:
-            pass
+        except Exception as clear_err:
+            print(
+                "# WARNING: could not clear cached jax backends "
+                f"({type(clear_err).__name__}: {clear_err}); retries may "
+                "replay the same cached init error",
+                flush=True,
+            )
         if attempt < retries - 1:
             delay = base_delay * (2**attempt)
             print(
@@ -632,17 +654,19 @@ def run_benches(args, dev, peak):
         with open(path, "w") as f:
             json.dump(scaling, f, indent=1)
         last = scaling["rows"][-1]
+        ratio = last[scaling["efficiency_key"]]
         print(
             json.dumps(
                 {
                     # Same metric name as the failure path emits, so a
                     # driver keying records by metric associates both.
                     "metric": "dp_weak_scaling_efficiency",
-                    "value": last["per_chip_efficiency"],
+                    "value": ratio,
                     "unit": "ratio_vs_1dev",
-                    "vs_baseline": last["per_chip_efficiency"],
+                    "vs_baseline": ratio,
                     "n_devices": last["n_devices"],
                     "awaiting_hardware": scaling["awaiting_hardware"],
+                    "efficiency_meaningful": scaling["efficiency_meaningful"],
                 }
             )
         )

@@ -33,7 +33,7 @@ from distributed_pytorch_tpu.checkpoint import (
     save_checkpoint,
     save_snapshot,
 )
-from distributed_pytorch_tpu.generation import generate
+from distributed_pytorch_tpu.generation import generate, top_p_filter
 from distributed_pytorch_tpu.parallel.bootstrap import (
     is_main_process,
     setup_distributed,
@@ -64,6 +64,7 @@ __all__ = [
     "MaterializedDataset",
     "NativeShardedLoader",
     "generate",
+    "top_p_filter",
     "RandomDataset",
     "ShardedLoader",
     "StepProfiler",

@@ -327,6 +327,20 @@ def import_orbax(
         if item_meta is not None:
             meta_tree = getattr(item_meta, "tree", item_meta)
 
+        # Template dtypes by path: restoring at the template dtype makes
+        # tensorstore cast DURING the read, so _align_to_template's astype
+        # is a guaranteed no-op on the sharded path — an eager .astype on a
+        # restored global jax.Array would be computation on possibly
+        # non-addressable shards, exactly what sharded restore exists to
+        # avoid (ADVICE r04). Attribute access only; never materialize.
+        tmpl_dtypes = {
+            _path_str(p): (
+                leaf.dtype
+                if hasattr(leaf, "dtype")
+                else np.asarray(leaf).dtype
+            )
+            for p, leaf in jax.tree_util.tree_flatten_with_path(template)[0]
+        }
         consumed = set()
 
         def make_arg(p, _meta_leaf):
@@ -335,7 +349,9 @@ def import_orbax(
             if sharding is None:
                 return ocp.RestoreArgs()  # host numpy for unlisted leaves
             consumed.add(key)
-            return ocp.ArrayRestoreArgs(sharding=sharding)
+            return ocp.ArrayRestoreArgs(
+                sharding=sharding, dtype=tmpl_dtypes.get(key)
+            )
 
         restore_args = jax.tree_util.tree_map_with_path(make_arg, meta_tree)
         unmatched = set(shard_by_path) - consumed

@@ -70,6 +70,12 @@ from distributed_pytorch_tpu.elastic.store import KVStoreClient, KVStoreServer
 GEN_KEY = "tpurun/generation"  # bumped on every failure -> restart-the-world
 FATAL_KEY = "tpurun/fatal"  # set when restarts are exhausted or world aborts
 DONE_PREFIX = "tpurun/done/"  # done/<gen> counts agents whose workers finished
+FINISHED_PREFIX = "tpurun/finished/"  # finished/<gen> terminal marker: done/<gen> reached the world size
+# How long a locally-succeeded agent keeps waiting for the done counter to
+# fill after observing a generation bump: a bump can race the last DONE adds
+# (agents add DONE unconditionally once their workers succeed), so honoring
+# it instantly could split the world between "done" and "restart" verdicts.
+DONE_BUMP_GRACE = 10.0
 ACK_PREFIX = "tpurun/ack/"  # ack/<gen> exit barrier: node 0 keeps the store up until all ack
 JOIN_PREFIX = "tpurun/join/"  # join/<gen> counts agents present at <gen>
 MEMBER_PREFIX = "tpurun/member/"  # member/<gen>/<orig_rank> -> "1" (who joined)
@@ -245,10 +251,17 @@ class _Retry(Exception):
 
 
 class WorldCompleted(Exception):
-    """The rendezvous store vanished mid-rendezvous: the store lives on node
-    0's agent, which tears it down only when the world finished — so a node
-    still trying to (re)join (e.g. one revived after a scale-down) should
-    exit cleanly, not crash with a ConnectionError."""
+    """The world finished without this node: either the rendezvous store
+    vanished mid-rendezvous (it lives on node 0's agent, which tears it
+    down only when the world finished), or — ``finished=True`` — the store
+    is still up and the settled generation's done counter already reached
+    its member count. A node still trying to (re)join (e.g. one revived
+    after a scale-down) should exit cleanly, not crash or force a restart
+    of a world that has nothing left to restart."""
+
+    def __init__(self, finished: bool = False):
+        super().__init__()
+        self.finished = finished
 
 
 class ElasticAgent:
@@ -417,8 +430,17 @@ class ElasticAgent:
             raise _Retry(grace_start)
         members = [int(r) for r in world.split(",")]
         if cfg.node_rank not in members:
-            # The world settled without us (we are a revived latecomer):
-            # force a fresh generation that includes everyone.
+            # The world settled without us (we are a revived latecomer).
+            # If that world has ALREADY completed (every member reported
+            # done), bumping the generation would split the finishing
+            # agents between exit-0 and restart-into-a-dead-store (ADVICE
+            # r04) — and there is nothing left to restart anyway.
+            done = int(self.store.get(f"{DONE_PREFIX}{generation}") or 0)
+            if done >= len(members) or self.store.get(
+                f"{FINISHED_PREFIX}{generation}"
+            ):
+                raise WorldCompleted(finished=True)
+            # Otherwise force a fresh generation that includes everyone.
             self.store.add(GEN_KEY, 1)
             raise _Retry(grace_start)
         return generation, members
@@ -432,7 +454,18 @@ class ElasticAgent:
             while True:
                 try:
                     generation, members = self._rendezvous()
-                except WorldCompleted:
+                except WorldCompleted as wc:
+                    if wc.finished:
+                        # The settled world (which excludes us) has fully
+                        # completed — the job succeeded without this node.
+                        # Clean exit, whether or not we ran workers in an
+                        # earlier generation.
+                        print(
+                            "[tpurun] world completed without this "
+                            "(excluded) node; exiting",
+                            flush=True,
+                        )
+                        return 0
                     if self._group is None:
                         # Never spawned workers in this process: we are a
                         # revived latecomer and the world finished without
@@ -466,7 +499,13 @@ class ElasticAgent:
                 failure = self._monitor(group, generation, members)
                 if failure is None:
                     # Local workers all succeeded; wait for every live agent.
-                    self.store.add(f"{DONE_PREFIX}{generation}", 1)
+                    done_count = self.store.add(f"{DONE_PREFIX}{generation}", 1)
+                    if done_count is not None and int(done_count) >= len(members):
+                        # We are the DECIDER (our add completed the count):
+                        # publish the terminal marker so agents that later
+                        # observe a stray generation bump (revived-latecomer
+                        # race, ADVICE r04) still agree the world finished.
+                        self.store.set(f"{FINISHED_PREFIX}{generation}", "1")
                     result = self._await_world_done(generation, len(members))
                     if result == "done":
                         # Exit barrier: the store lives on node 0, so node 0
@@ -549,18 +588,36 @@ class ElasticAgent:
 
     def _await_world_done(self, generation: int, n_members: int) -> str:
         """After local success: block until all live agents report done
-        ('done') or a failure elsewhere bumps the generation ('restart')."""
+        ('done') or a failure elsewhere bumps the generation ('restart').
+
+        A generation bump is NOT immediately terminal: members add DONE
+        unconditionally once their workers succeed (their monitor checks
+        completion before the bump flag), so a bump can race the last DONE
+        adds — e.g. a revived latecomer bumping while the world finishes
+        (ADVICE r04). On seeing a bump, grant the counter a short grace to
+        fill (or the FINISHED marker to appear) before declaring restart,
+        so every agent reaches the same verdict."""
+        bump_deadline = None
         while True:
             try:
                 done = self.store.wait_ge(
                     f"{DONE_PREFIX}{generation}", n_members, timeout=1.0
                 )
-                if done is not None:
+                if done is not None or self.store.get(
+                    f"{FINISHED_PREFIX}{generation}"
+                ):
                     return "done"
-                if int(self.store.get(GEN_KEY) or 0) != generation:
-                    return "restart"
-                if self.store.get(FATAL_KEY):
-                    return "restart"
+                bumped = (
+                    int(self.store.get(GEN_KEY) or 0) != generation
+                    or bool(self.store.get(FATAL_KEY))
+                )
+                if bumped:
+                    if bump_deadline is None:
+                        bump_deadline = time.monotonic() + DONE_BUMP_GRACE
+                    elif time.monotonic() > bump_deadline:
+                        return "restart"
+                else:
+                    bump_deadline = None
             except (ConnectionError, OSError):
                 # The store dies only when node 0's agent exits — and after our
                 # own workers succeeded that means the world completed.

@@ -37,6 +37,51 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_pytorch_tpu.ops.quant import dequantize_pytree
 
 
+def truncate_logits(
+    logits: jnp.ndarray, top_k: int, top_p: float
+) -> jnp.ndarray:
+    """Apply top-k and/or nucleus truncation with ONE descending sort
+    (the decode hot loop calls this per token; sorting the vocab twice —
+    once for the k-th threshold, once for the nucleus cumsum — would be
+    pure waste). Semantically identical to top-k masking followed by
+    :func:`top_p_filter` over the renormalized survivors: the nucleus
+    probabilities are computed over the top-k prefix of the sorted row,
+    which IS the renormalized survivor distribution."""
+    if top_k <= 0 and not (0.0 < top_p < 1.0):
+        return logits
+    sorted_desc = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+    k = top_k if top_k > 0 else logits.shape[-1]
+    head = sorted_desc[..., :k]
+    threshold = head[..., -1:]  # k-th largest (keeps all when k = vocab)
+    if 0.0 < top_p < 1.0:
+        probs = jax.nn.softmax(head, axis=-1)
+        keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+        n_keep = jnp.sum(keep, axis=-1, keepdims=True)  # >= 1
+        nucleus_thr = jnp.take_along_axis(head, n_keep - 1, axis=-1)
+        threshold = jnp.maximum(threshold, nucleus_thr)
+    return jnp.where(logits < threshold, -jnp.inf, logits)
+
+
+def top_p_filter(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
+    """Nucleus filter: mask ``logits`` ([..., V]) to the smallest set of
+    tokens whose cumulative probability reaches ``top_p``, returning the
+    filtered logits (masked entries at ``-inf``).
+
+    The token that crosses the threshold is INCLUDED (the kept mass is
+    always >= top_p), and at least one token always survives — the
+    standard Holtzman et al. convention. Ties at the boundary logit are all
+    kept (negligible extra mass, no data-dependent shapes — XLA-friendly:
+    one sort + cumsum, no gather loops)."""
+    sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    # Keep while the mass BEFORE this token is < top_p; the first token has
+    # zero mass before it, so >= 1 token survives for any top_p.
+    keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+    n_keep = jnp.sum(keep, axis=-1, keepdims=True)  # [..., 1], >= 1
+    threshold = jnp.take_along_axis(sorted_logits, n_keep - 1, axis=-1)
+    return jnp.where(logits < threshold, -jnp.inf, logits)
+
+
 def generate(
     model,
     params,
@@ -46,6 +91,7 @@ def generate(
     prompt_lengths: Optional[jnp.ndarray] = None,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 0.0,
     rng: Optional[jax.Array] = None,
     pad_token: int = 0,
     mesh: Optional[Mesh] = None,
@@ -58,7 +104,10 @@ def generate(
 
     ``temperature=0`` is greedy argmax; otherwise softmax sampling at the
     given temperature, optionally truncated to the ``top_k`` most likely
-    tokens. ``prompt_lengths`` ([B]) supports ragged prompts padded to T0
+    tokens and/or the ``top_p`` nucleus (smallest set of tokens reaching
+    ``top_p`` cumulative mass; 0 or >= 1 disables). When both are given,
+    top-k truncates first and the nucleus is computed over the renormalized
+    survivors. ``prompt_lengths`` ([B]) supports ragged prompts padded to T0
     with ``pad_token`` — generation for each row starts after its own length.
     Returns ``[B, T0 + max_new_tokens]`` token ids.
 
@@ -191,7 +240,8 @@ def generate(
         )
 
     run = _compiled_run(
-        decode_model, total_len, float(temperature), int(top_k), prefill_len
+        decode_model, total_len, float(temperature), int(top_k),
+        float(top_p), prefill_len,
     )
     return run(params, tokens0, cache, prompt_lengths, rng)
 
@@ -202,6 +252,7 @@ def _compiled_run(
     total_len: int,
     temperature: float,
     top_k: int,
+    top_p: float = 0.0,
     prefill_len: int = 1,
 ):
     """Jitted decode loop, cached per (model config, length, sampling config,
@@ -212,10 +263,7 @@ def _compiled_run(
     def sample(logits, step_rng):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        scaled = logits / temperature
-        if top_k > 0:
-            kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
-            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+        scaled = truncate_logits(logits / temperature, top_k, top_p)
         return jax.random.categorical(step_rng, scaled).astype(jnp.int32)
 
     def run(params, tokens, cache, prompt_lengths, rng):

@@ -69,6 +69,7 @@ def main(args):
         args.new_tokens,
         temperature=args.temperature,
         top_k=args.top_k,
+        top_p=args.top_p,
         mesh=mesh,
         quantize=args.quantize,
         quantized_cache=args.quantized_cache,
@@ -121,6 +122,9 @@ if __name__ == "__main__":
     parser.add_argument("--temperature", type=float, default=0.0,
                         help="0 = greedy argmax")
     parser.add_argument("--top_k", type=int, default=0)
+    parser.add_argument("--top_p", type=float, default=0.0,
+                        help="nucleus sampling: keep the smallest token set "
+                        "reaching this cumulative mass (0 or >=1 disables)")
     parser.add_argument("--quantize", action="store_true",
                         help="weight-only int8 decode")
     parser.add_argument("--quantized_cache", action="store_true",

@@ -85,7 +85,10 @@ def main(args):
         optax.add_decayed_weights(5e-4),
         optax.sgd(schedule, momentum=0.9, nesterov=True),
     )
-    model = ResNet18(num_classes=10, cifar_stem=True, dtype=jnp.bfloat16)
+    model = ResNet18(
+        num_classes=10, cifar_stem=True, dtype=jnp.bfloat16,
+        num_filters=args.width,
+    )
     trainer = Trainer(
         model,
         train_loader,
@@ -126,6 +129,11 @@ if __name__ == "__main__":
                         "standard CIFAR training recipe)")
     parser.add_argument("--subset", default=0, type=int,
                         help="debug: use only the first N train samples")
+    parser.add_argument("--width", default=64, type=int,
+                        help="stem filter count (64 = standard ResNet-18; "
+                        "smaller = width-reduced variant for CPU-scale runs "
+                        "where the full net overfits small subsets, "
+                        "BASELINE.md round 4)")
     parser.add_argument("--log_every", default=0, type=int)
     parser.add_argument("--fake_devices", default=0, type=int,
                         help="debug: present N virtual CPU devices")

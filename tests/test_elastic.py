@@ -913,3 +913,112 @@ class TestScaleDownLiveTraining:
         assert single.returncode == 0, single.stdout + single.stderr
         clean = epoch_losses(single.stdout)
         np.testing.assert_allclose(survived[0], clean[0], rtol=1e-6)
+
+
+class TestCompletedWorldRace:
+    """ADVICE r04: a revived latecomer must not bump the generation of a
+    world that already completed, and a locally-succeeded agent must not
+    honor a stray bump before the done counter has had a chance to fill —
+    otherwise some agents exit 0 while others restart into a dead store."""
+
+    @pytest.fixture()
+    def rig(self):
+        from distributed_pytorch_tpu.elastic.agent import (
+            ElasticAgent,
+            ElasticConfig,
+        )
+        from distributed_pytorch_tpu.elastic.store import (
+            KVStoreClient,
+            KVStoreServer,
+        )
+
+        port = free_port()
+        with KVStoreServer(port):
+            with KVStoreClient("127.0.0.1", port) as admin:
+                cfg = ElasticConfig(
+                    nnodes=3, node_rank=2, rdzv_host="127.0.0.1",
+                    rdzv_port=port,
+                )
+                agent = ElasticAgent(cfg, ["true"])
+                try:
+                    yield agent, admin
+                finally:
+                    agent.store.close()
+
+    def test_latecomer_does_not_bump_completed_world(self, rig):
+        from distributed_pytorch_tpu.elastic.agent import (
+            DONE_PREFIX,
+            GEN_KEY,
+            WORLD_PREFIX,
+            WorldCompleted,
+        )
+
+        agent, admin = rig
+        admin.set(GEN_KEY, "0")
+        admin.set(f"{WORLD_PREFIX}0", "0,1")  # settled without node 2
+        admin.add(f"{DONE_PREFIX}0", 2)  # ...and fully completed
+        with pytest.raises(WorldCompleted) as exc:
+            agent._rendezvous_once(agent.cfg, time.monotonic())
+        assert exc.value.finished
+        assert int(admin.get(GEN_KEY)) == 0  # NOT bumped
+
+    def test_latecomer_still_bumps_live_world(self, rig):
+        from distributed_pytorch_tpu.elastic.agent import (
+            DONE_PREFIX,
+            GEN_KEY,
+            WORLD_PREFIX,
+        )
+        from distributed_pytorch_tpu.elastic.agent import _Retry
+
+        agent, admin = rig
+        admin.set(GEN_KEY, "0")
+        admin.set(f"{WORLD_PREFIX}0", "0,1")
+        admin.add(f"{DONE_PREFIX}0", 1)  # one member still running
+        with pytest.raises(_Retry):
+            agent._rendezvous_once(agent.cfg, time.monotonic())
+        assert int(admin.get(GEN_KEY)) == 1  # restart-the-world as before
+
+    def test_await_world_done_survives_bump_when_counter_fills(
+        self, rig, monkeypatch
+    ):
+        import distributed_pytorch_tpu.elastic.agent as agent_mod
+        from distributed_pytorch_tpu.elastic.agent import DONE_PREFIX, GEN_KEY
+
+        agent, admin = rig
+        monkeypatch.setattr(agent_mod, "DONE_BUMP_GRACE", 5.0)
+        admin.set(GEN_KEY, "8")  # bumped past our generation 7...
+        admin.add(f"{DONE_PREFIX}7", 2)
+        # ...while the last member's DONE lands shortly after.
+        t = threading.Thread(
+            target=lambda: (time.sleep(1.5), admin.add(f"{DONE_PREFIX}7", 1))
+        )
+        t.start()
+        try:
+            assert agent._await_world_done(7, 3) == "done"
+        finally:
+            t.join()
+
+    def test_await_world_done_restarts_when_counter_never_fills(
+        self, rig, monkeypatch
+    ):
+        import distributed_pytorch_tpu.elastic.agent as agent_mod
+        from distributed_pytorch_tpu.elastic.agent import DONE_PREFIX, GEN_KEY
+
+        agent, admin = rig
+        monkeypatch.setattr(agent_mod, "DONE_BUMP_GRACE", 1.0)
+        admin.set(GEN_KEY, "8")
+        admin.add(f"{DONE_PREFIX}7", 1)  # a member truly failed: never fills
+        start = time.monotonic()
+        assert agent._await_world_done(7, 3) == "restart"
+        assert time.monotonic() - start >= 1.0  # grace observed
+
+    def test_finished_marker_alone_is_terminal(self, rig):
+        from distributed_pytorch_tpu.elastic.agent import (
+            FINISHED_PREFIX,
+            GEN_KEY,
+        )
+
+        agent, admin = rig
+        admin.set(GEN_KEY, "9")  # even with a bump in place
+        admin.set(f"{FINISHED_PREFIX}7", "1")
+        assert agent._await_world_done(7, 3) == "done"

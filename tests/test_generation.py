@@ -206,7 +206,12 @@ def test_parallel_prefill_matches_serial_prompt_walk():
         tokens0 = jnp.concatenate(
             [prompt, jnp.zeros((4, 6), jnp.int32)], axis=1
         )
-        run = _compiled_run(decode_model, total_len, 0.0, 0, prefill_len)
+        # Keyword, not positional: top_p sits between top_k and prefill_len
+        # in the signature, and a silently-defaulted prefill_len=1 would
+        # turn this test into a no-op (it pins the CHUNKED prefill path).
+        run = _compiled_run(
+            decode_model, total_len, 0.0, 0, prefill_len=prefill_len
+        )
         return np.asarray(
             run(params, tokens0, cache, lengths, jax.random.PRNGKey(0))
         )
@@ -253,3 +258,122 @@ def test_gqa_tensor_parallel_decode_parity():
         model, params, prompt, 5, mesh=mesh, param_shardings=shardings
     )
     np.testing.assert_array_equal(np.asarray(single), np.asarray(sharded))
+
+
+# ----------------------------------------------------------- nucleus (top-p)
+
+
+class TestTopP:
+    """Nucleus sampling (VERDICT r04 item 6): the filter keeps the smallest
+    token set reaching top_p cumulative mass (crossing token included, >=1
+    survivor), samples only from it, and is mesh-consistent."""
+
+    def _kept(self, filtered):
+        return np.isfinite(np.asarray(filtered))
+
+    def test_filter_keeps_minimal_nucleus(self):
+        from distributed_pytorch_tpu.generation import top_p_filter
+
+        logits = jnp.log(jnp.array([[0.5, 0.3, 0.15, 0.05]]))
+        # cumulative mass BEFORE each token: 0, .5, .8, .95
+        np.testing.assert_array_equal(
+            self._kept(top_p_filter(logits, 0.8))[0],
+            [True, True, False, False],
+        )
+        np.testing.assert_array_equal(
+            self._kept(top_p_filter(logits, 0.81))[0],
+            [True, True, True, False],
+        )
+        np.testing.assert_array_equal(
+            self._kept(top_p_filter(logits, 0.999))[0],
+            [True, True, True, True],
+        )
+        # Tiny top_p: the argmax always survives.
+        np.testing.assert_array_equal(
+            self._kept(top_p_filter(logits, 1e-6))[0],
+            [True, False, False, False],
+        )
+
+    def test_filter_is_order_invariant(self):
+        from distributed_pytorch_tpu.generation import top_p_filter
+
+        base = jnp.log(jnp.array([0.4, 0.25, 0.2, 0.1, 0.05]))
+        perm = jnp.array([3, 0, 4, 2, 1])
+        filtered = top_p_filter(base[perm][None, :], 0.7)
+        # Nucleus of the sorted dist is {0.4, 0.25, 0.2} (cum-before .65 < .7
+        # for the third); the same tokens must survive any input order.
+        np.testing.assert_array_equal(
+            self._kept(filtered)[0],
+            np.asarray([False, True, False, True, True]),
+        )
+
+    def test_filter_keeps_per_row_nuclei(self):
+        from distributed_pytorch_tpu.generation import top_p_filter
+
+        logits = jnp.log(
+            jnp.array([[0.97, 0.01, 0.01, 0.01], [0.25, 0.25, 0.25, 0.25]])
+        )
+        kept = self._kept(top_p_filter(logits, 0.5))
+        np.testing.assert_array_equal(kept[0], [True, False, False, False])
+        # Uniform row: 0.5 mass needs two tokens, but boundary TIES are all
+        # kept (documented convention).
+        assert kept[1].all()
+
+    def test_samples_stay_inside_nucleus(self):
+        from distributed_pytorch_tpu.generation import top_p_filter
+
+        rng = np.random.default_rng(3)
+        logits = jnp.asarray(rng.standard_normal((4, 32)), jnp.float32)
+        filtered = top_p_filter(logits, 0.6)
+        kept = self._kept(filtered)
+        draws = jax.vmap(
+            lambda key: jax.random.categorical(key, filtered, axis=-1)
+        )(jax.random.split(jax.random.PRNGKey(0), 64))
+        for row in range(4):
+            assert kept[row, np.asarray(draws)[:, row]].all()
+
+    def test_generate_top_p_shapes_and_mesh_parity(self):
+        """Sampled decode with top_p runs end to end, respects vocab bounds,
+        and the mesh path reproduces the single-device tokens at the same
+        rng."""
+        from distributed_pytorch_tpu.parallel.mesh import make_mesh
+
+        model = tiny_lm()
+        params, _ = make_params(model, batch=8, seq=6)
+        prompt = jnp.asarray(
+            np.random.default_rng(5).integers(0, 48, (8, 6)), jnp.int32
+        )
+        kw = dict(
+            temperature=1.0, top_p=0.8, top_k=16, rng=jax.random.PRNGKey(11)
+        )
+        single = generate(model, params, prompt, 7, **kw)
+        assert single.shape == (8, 13)
+        assert (np.asarray(single) >= 0).all()
+        assert (np.asarray(single) < 48).all()
+        mesh = make_mesh({"data": 8})
+        sharded = generate(model, params, prompt, 7, mesh=mesh, **kw)
+        np.testing.assert_array_equal(np.asarray(sharded), np.asarray(single))
+
+    def test_truncate_logits_matches_sequential_filters(self):
+        """The fused single-sort path (decode hot loop) must keep exactly
+        the tokens that top-k masking followed by top_p_filter over the
+        renormalized survivors keeps."""
+        from distributed_pytorch_tpu.generation import (
+            top_p_filter,
+            truncate_logits,
+        )
+
+        rng = np.random.default_rng(9)
+        logits = jnp.asarray(rng.standard_normal((5, 64)), jnp.float32)
+        for top_k, top_p in [(0, 0.7), (8, 0.0), (8, 0.7), (3, 0.95), (64, 0.5)]:
+            fused = np.isfinite(np.asarray(truncate_logits(logits, top_k, top_p)))
+            ref = logits
+            if top_k > 0:
+                kth = jnp.sort(ref, axis=-1)[:, -top_k][:, None]
+                ref = jnp.where(ref < kth, -jnp.inf, ref)
+            if 0.0 < top_p < 1.0:
+                ref = top_p_filter(ref, top_p)
+            np.testing.assert_array_equal(
+                fused, np.isfinite(np.asarray(ref)),
+                err_msg=f"top_k={top_k} top_p={top_p}",
+            )

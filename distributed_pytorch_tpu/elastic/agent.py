@@ -71,11 +71,16 @@ GEN_KEY = "tpurun/generation"  # bumped on every failure -> restart-the-world
 FATAL_KEY = "tpurun/fatal"  # set when restarts are exhausted or world aborts
 DONE_PREFIX = "tpurun/done/"  # done/<gen> counts agents whose workers finished
 FINISHED_PREFIX = "tpurun/finished/"  # finished/<gen> terminal marker: done/<gen> reached the world size
-# How long a locally-succeeded agent keeps waiting for the done counter to
-# fill after observing a generation bump: a bump can race the last DONE adds
-# (agents add DONE unconditionally once their workers succeed), so honoring
-# it instantly could split the world between "done" and "restart" verdicts.
-DONE_BUMP_GRACE = 10.0
+# How long the done counter may STALL (no new adds) after a generation bump
+# before a locally-succeeded agent honors the bump and restarts: a bump can
+# race the last DONE adds (agents add DONE unconditionally once their
+# workers succeed, within one ~0.2s poll cycle), so honoring it instantly
+# could split the world between "done" and "restart" verdicts. The deadline
+# EXTENDS while the counter advances — completion in flight — and a counter
+# that stalls (a member truly failed: its DONE will never come) restarts
+# after only this long, so genuine failures aren't delayed by a fixed
+# worst-case grace.
+DONE_BUMP_GRACE = 3.0
 ACK_PREFIX = "tpurun/ack/"  # ack/<gen> exit barrier: node 0 keeps the store up until all ack
 JOIN_PREFIX = "tpurun/join/"  # join/<gen> counts agents present at <gen>
 MEMBER_PREFIX = "tpurun/member/"  # member/<gen>/<orig_rank> -> "1" (who joined)
@@ -594,10 +599,13 @@ class ElasticAgent:
         unconditionally once their workers succeed (their monitor checks
         completion before the bump flag), so a bump can race the last DONE
         adds — e.g. a revived latecomer bumping while the world finishes
-        (ADVICE r04). On seeing a bump, grant the counter a short grace to
-        fill (or the FINISHED marker to appear) before declaring restart,
-        so every agent reaches the same verdict."""
-        bump_deadline = None
+        (ADVICE r04). On seeing a bump, keep waiting only while the counter
+        is still ADVANCING (completion in flight); once it stalls for
+        ``DONE_BUMP_GRACE`` the missing member has truly failed — restart.
+        FATAL is honored immediately (the counter cannot save a world whose
+        restart budget is spent)."""
+        last_done = -1
+        stall_deadline = None
         while True:
             try:
                 done = self.store.wait_ge(
@@ -607,17 +615,21 @@ class ElasticAgent:
                     f"{FINISHED_PREFIX}{generation}"
                 ):
                     return "done"
-                bumped = (
-                    int(self.store.get(GEN_KEY) or 0) != generation
-                    or bool(self.store.get(FATAL_KEY))
-                )
-                if bumped:
-                    if bump_deadline is None:
-                        bump_deadline = time.monotonic() + DONE_BUMP_GRACE
-                    elif time.monotonic() > bump_deadline:
+                if self.store.get(FATAL_KEY):
+                    return "restart"
+                if int(self.store.get(GEN_KEY) or 0) != generation:
+                    done_now = int(
+                        self.store.get(f"{DONE_PREFIX}{generation}") or 0
+                    )
+                    now = time.monotonic()
+                    if done_now != last_done:
+                        last_done = done_now
+                        stall_deadline = now + DONE_BUMP_GRACE
+                    elif now > stall_deadline:
                         return "restart"
                 else:
-                    bump_deadline = None
+                    last_done = -1
+                    stall_deadline = None
             except (ConnectionError, OSError):
                 # The store dies only when node 0's agent exits — and after our
                 # own workers succeeded that means the world completed.

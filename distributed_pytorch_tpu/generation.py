@@ -50,14 +50,26 @@ def truncate_logits(
     if top_k <= 0 and not (0.0 < top_p < 1.0):
         return logits
     sorted_desc = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
-    k = top_k if top_k > 0 else logits.shape[-1]
-    head = sorted_desc[..., :k]
-    threshold = head[..., -1:]  # k-th largest (keeps all when k = vocab)
+    if top_k > 0:
+        threshold = sorted_desc[..., top_k - 1 : top_k]  # k-th largest
+        # Tie-inclusive survivor set, exactly like masking then re-sorting:
+        # entries equal to the k-th value all survive (matters for bf16 /
+        # quantized logits where ties are common), so the nucleus below is
+        # computed over the same renormalized distribution the sequential
+        # top-k -> top_p_filter composition sees.
+        masked_sorted = jnp.where(
+            sorted_desc >= threshold, sorted_desc, -jnp.inf
+        )
+    else:
+        threshold = sorted_desc[..., -1:]  # keeps everything
+        masked_sorted = sorted_desc
     if 0.0 < top_p < 1.0:
-        probs = jax.nn.softmax(head, axis=-1)
+        probs = jax.nn.softmax(masked_sorted, axis=-1)
         keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
         n_keep = jnp.sum(keep, axis=-1, keepdims=True)  # >= 1
-        nucleus_thr = jnp.take_along_axis(head, n_keep - 1, axis=-1)
+        # keep is a prefix of the survivor prefix, where masked_sorted ==
+        # sorted_desc — so indexing the unmasked sort is safe.
+        nucleus_thr = jnp.take_along_axis(sorted_desc, n_keep - 1, axis=-1)
         threshold = jnp.maximum(threshold, nucleus_thr)
     return jnp.where(logits < threshold, -jnp.inf, logits)
 

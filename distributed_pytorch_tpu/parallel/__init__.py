@@ -16,6 +16,7 @@ from distributed_pytorch_tpu.parallel.partitioning import (
 )
 from distributed_pytorch_tpu.parallel.pipeline import (
     PIPELINE_STAGE_RULES,
+    pipeline_1f1b_grads,
     pipeline_apply,
 )
 from distributed_pytorch_tpu.parallel.sharding import (
@@ -27,6 +28,7 @@ from distributed_pytorch_tpu.parallel.sharding import (
 __all__ = [
     "PIPELINE_STAGE_RULES",
     "TRANSFORMER_TP_RULES",
+    "pipeline_1f1b_grads",
     "pipeline_apply",
     "batch_sharding",
     "is_main_process",

@@ -24,6 +24,28 @@ built the TPU way rather than as a torch-style stage-process runtime:
 Bubble accounting: with ``M`` microbatches and ``S`` stages the pipeline runs
 ``M + S - 1`` steps, efficiency ``M / (M + S - 1)`` — pick ``M >= 4*S`` for
 >80% utilization.
+
+Two schedules live here:
+
+* :func:`pipeline_apply` — GPipe: forward-only primitive, differentiated by
+  XLA's autodiff. Activation residuals for ALL ``M`` microbatches are alive
+  when the (autodiff-scheduled) backward begins: memory ``O(M)``.
+* :func:`pipeline_1f1b_grads` — 1F1B (PipeDream-flush): a gradient-PRODUCING
+  primitive. The loss head runs INSIDE the last stage's schedule slot, so
+  microbatch ``i``'s backward starts while later microbatches are still
+  streaming forward; each stage keeps at most ``2(S-1-s)+1`` residual
+  inputs alive (the classic 1F1B bound). Backward recomputes the stage
+  forward from the stored INPUT (recompute-vjp, the standard large-model
+  configuration: full remat costs one extra stage-forward per microbatch
+  and makes the residual a single activation tensor instead of the whole
+  autodiff tape). What shrinks from ``O(M)`` to ``O(S)`` is therefore the
+  TAPE memory — ``M x (layers-per-stage x per-layer tape)`` becomes
+  ``O(S) boundary activations + ONE stage's tape`` — which is the term
+  that dominates for real multi-layer stages. The batch-boundary arrays
+  (``x``, ``targets``, and the optional ``dx`` output) remain ``O(M)`` by
+  nature: they ARE the caller's batch. The bubble fraction
+  ``2(S-1) / (M + 2(S-1))`` matches GPipe-with-remat; the win is memory —
+  which is what decides whether a deep model FITS.
 """
 
 from __future__ import annotations
@@ -160,3 +182,312 @@ def pipeline_apply(
         out_specs=x_spec,
         check_vma=False,
     )(stacked_params, x)
+
+
+def _1f1b_shard_body(
+    stage_fn: Callable,
+    last_fn: Callable,
+    stage_params: Any,
+    last_params: Any,
+    x: jnp.ndarray,
+    targets: jnp.ndarray,
+    *,
+    axis: str,
+    data_axis: Optional[str],
+    num_microbatches: int,
+    with_dx: bool = True,
+):
+    """Per-device 1F1B body (under shard_map).
+
+    Closed-form schedule with unit F/B slots per cycle ``t``:
+
+    * forward of microbatch ``i`` at stage ``s`` happens at cycle
+      ``F(s, i) = s + i`` (same streaming as GPipe);
+    * backward at ``B(s, i) = 2(S-1) - s + i`` — so the LAST stage runs
+      ``B(i)`` in the same cycle as ``F(i)`` (loss head fused into its
+      slot), and stage ``s`` receives the activation-gradient its
+      successor produced one cycle earlier (``B(s+1, i) = B(s, i) - 1``).
+
+    Residual inputs live from ``F(s, i)`` to ``B(s, i)`` — at most
+    ``2(S-1-s) + 1`` in flight — stored in a ``min(M, 2S-1)``-slot ring
+    (slot ``i mod K``; the lifetime bound proves no overwrite-before-use).
+    Total cycles: ``M + 2(S-1)``.
+
+    Stage roles are ``lax.cond`` branches on the traced stage index: no
+    collectives inside the branches, so SPMD stays uniform — the two
+    ``ppermute``\\ s (activations forward, gradients backward) happen
+    unconditionally every cycle.
+    """
+    n_stages = jax.lax.psum(1, axis)
+    stage_idx = jax.lax.axis_index(axis)
+    is_last = stage_idx == n_stages - 1
+    params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+
+    m = num_microbatches
+    microbatches = x.reshape((m, x.shape[0] // m) + x.shape[1:])
+    tgt_mb = targets.reshape((m, targets.shape[0] // m) + targets.shape[1:])
+    mb_shape = microbatches.shape[1:]
+    k_slots = min(m, 2 * n_stages - 1)
+
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+    n_cycles = m + 2 * (n_stages - 1)
+
+    zero_params = jax.tree_util.tree_map(jnp.zeros_like, params)
+    zero_last = jax.tree_util.tree_map(jnp.zeros_like, last_params)
+
+    def composite(p, lp, xx, tt):
+        y = stage_fn(p, xx)
+        return last_fn(lp, y, tt), y
+
+    def f_last(xx, tt):
+        # Last stage: forward + loss head + FULL backward in one slot (its
+        # B(i) cycle IS its F(i) cycle) — no residual needed.
+        (loss_mb, y), grads = jax.value_and_grad(
+            composite, argnums=(0, 1, 2), has_aux=True
+        )(params, last_params, xx, tt)
+        dp, dlp, dxc = grads
+        return y, loss_mb, dp, dlp, dxc
+
+    def f_plain(xx, tt):
+        return (
+            stage_fn(params, xx),
+            jnp.zeros((), jnp.float32),
+            zero_params,
+            zero_last,
+            jnp.zeros(mb_shape, x.dtype),
+        )
+
+    def f_skip(xx, tt):
+        return (
+            jnp.zeros(mb_shape, x.dtype),
+            jnp.zeros((), jnp.float32),
+            zero_params,
+            zero_last,
+            jnp.zeros(mb_shape, x.dtype),
+        )
+
+    def b_recompute(xx, g):
+        # Non-last backward: recompute the stage forward from the stored
+        # input, pull the received activation-gradient through it.
+        _, vjp_fn = jax.vjp(stage_fn, params, xx)
+        dp, dx = vjp_fn(g)
+        return dp, dx
+
+    def b_skip(xx, g):
+        return zero_params, jnp.zeros(mb_shape, x.dtype)
+
+    def body(carry, t):
+        (recv_f, recv_b, resid, gp, glp, dx_bank, loss_acc) = carry
+        i_f = t - stage_idx
+        i_b = t - (2 * (n_stages - 1) - stage_idx)
+        f_valid = (i_f >= 0) & (i_f < m)
+        b_valid = (i_b >= 0) & (i_b < m)
+
+        inject = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(i_f, 0, m - 1), axis=0, keepdims=False
+        )
+        x_in = jnp.where(stage_idx == 0, inject, recv_f)
+        tgt = jax.lax.dynamic_index_in_dim(
+            tgt_mb, jnp.clip(i_f, 0, m - 1), axis=0, keepdims=False
+        )
+
+        # ---- forward slot (last stage: fused forward+loss+backward)
+        y, loss_mb, dp_f, dlp, dx_last = jax.lax.cond(
+            f_valid,
+            lambda xx, tt: jax.lax.cond(is_last, f_last, f_plain, xx, tt),
+            f_skip,
+            x_in,
+            tgt,
+        )
+
+        # ---- residual ring: store this cycle's input for the later backward
+        slot_f = jnp.clip(i_f, 0, m - 1) % k_slots
+        resid = jnp.where(
+            f_valid & ~is_last,
+            jax.lax.dynamic_update_index_in_dim(resid, x_in, slot_f, axis=0),
+            resid,
+        )
+
+        # ---- backward slot (non-last stages; gradient arrived last cycle)
+        slot_b = jnp.clip(i_b, 0, m - 1) % k_slots
+        x_resid = jax.lax.dynamic_index_in_dim(
+            resid, slot_b, axis=0, keepdims=False
+        )
+        dp_b, dx_b = jax.lax.cond(
+            b_valid & ~is_last, b_recompute, b_skip, x_resid, recv_b
+        )
+
+        # ---- accumulate
+        add = lambda a, b, ok: jax.tree_util.tree_map(  # noqa: E731
+            lambda u, v: u + jnp.where(ok, v, jnp.zeros_like(v)), a, b
+        )
+        gp = add(add(gp, dp_f, f_valid & is_last), dp_b, b_valid & ~is_last)
+        glp = add(glp, dlp, f_valid & is_last)
+        loss_acc = loss_acc + jnp.where(f_valid & is_last, loss_mb, 0.0)
+        if with_dx:
+            # Stage 0 banks d(loss)/d(x_mb) for the caller (embedding
+            # backward). Skipped entirely when the caller doesn't train
+            # anything upstream of the pipeline — the bank is an O(M)
+            # carry replicated on every stage, so don't pay it for nothing.
+            dx_bank = jnp.where(
+                b_valid & (stage_idx == 0),
+                jax.lax.dynamic_update_index_in_dim(
+                    dx_bank, dx_b, jnp.clip(i_b, 0, m - 1), axis=0
+                ),
+                dx_bank,
+            )
+
+        # ---- ring traffic: activations forward, gradients backward. The
+        # last stage's dx leaves in ITS cycle (dx_last); others send dx_b.
+        send_b = jnp.where(is_last, dx_last, dx_b)
+        recv_f = jax.lax.ppermute(y, axis, fwd_perm)
+        recv_b = jax.lax.ppermute(send_b, axis, bwd_perm)
+        return (recv_f, recv_b, resid, gp, glp, dx_bank, loss_acc), None
+
+    carry0 = (
+        jnp.zeros(mb_shape, x.dtype),
+        jnp.zeros(mb_shape, x.dtype),
+        jnp.zeros((k_slots,) + mb_shape, x.dtype),
+        zero_params,
+        zero_last,
+        jnp.zeros(((m,) + mb_shape) if with_dx else (0,), x.dtype),
+        jnp.zeros((), jnp.float32),
+    )
+    (_, _, _, gp, glp, dx_bank, loss_acc), _ = jax.lax.scan(
+        body, carry0, jnp.arange(n_cycles)
+    )
+
+    # Normalize to MEAN over microbatches, then over the data axis.
+    inv_m = 1.0 / m
+    gp = jax.tree_util.tree_map(lambda g: g * inv_m, gp)
+    glp = jax.tree_util.tree_map(lambda g: g * inv_m, glp)
+    if with_dx:
+        dx_bank = dx_bank * inv_m
+    loss = loss_acc * inv_m
+    # Only the last stage holds the real loss / head grads — replicate over
+    # the stage ring (masked psum), like pipeline_apply's output.
+    mask_last = jnp.where(is_last, 1.0, 0.0)
+    loss = jax.lax.psum(loss * mask_last, axis)
+    glp = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g * mask_last, axis), glp
+    )
+    if data_axis is not None:
+        n_data = jax.lax.psum(1, data_axis)
+        loss = jax.lax.psum(loss, data_axis) / n_data
+        mean_d = lambda g: jax.lax.psum(g, data_axis) / n_data  # noqa: E731
+        gp = jax.tree_util.tree_map(mean_d, gp)
+        glp = jax.tree_util.tree_map(mean_d, glp)
+        if with_dx:
+            # dx stays per-sample (data-sharded) but the loss it
+            # differentiates is the GLOBAL mean: scale 1/n_data, no psum.
+            dx_bank = dx_bank / n_data
+    gp = jax.tree_util.tree_map(lambda g: g[None], gp)  # re-stack stage dim
+    if not with_dx:
+        return loss, gp, glp
+    return loss, gp, glp, dx_bank.reshape(x.shape)
+
+
+def pipeline_1f1b_grads(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stacked_params: Any,
+    last_fn: Callable[[Any, jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    last_params: Any,
+    x: jnp.ndarray,
+    targets: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    axis: str = "stage",
+    num_microbatches: int = 8,
+    data_axis: Optional[str] = "data",
+    with_dx: bool = True,
+):
+    """One-forward-one-backward (PipeDream-flush) pipelined LOSS + GRADIENTS.
+
+    ``stage_fn(params_for_one_stage, x) -> y`` (shape-preserving, as in
+    :func:`pipeline_apply`); ``last_fn(last_params, y, targets) -> scalar``
+    is the loss head, returning the MEAN loss of one microbatch — it runs
+    inside the last stage's schedule slot, which is what lets backward for
+    microbatch ``i`` start while microbatch ``i+1`` is still streaming
+    forward (the 1F1B memory bound; see module docstring).
+
+    Returns ``(loss, d_stacked_params, d_last_params, dx)`` where ``loss``
+    and the grads are means over the global batch: loss replicated,
+    ``d_stacked_params`` stage-sharded like ``stacked_params``,
+    ``d_last_params`` replicated, ``dx`` sharded like ``x`` (feed it to the
+    embedding/pre-pipeline backward). ``with_dx=False`` returns ``dx=None``
+    and drops the O(M) input-gradient bank from the scan carry entirely —
+    use it whenever nothing upstream of the pipeline is trained.
+
+    Use this instead of autodiff-through-:func:`pipeline_apply` when
+    ``M x per-stage-tape`` does not fit — the schedule holds at most
+    ``2(S-1)+1`` boundary residuals per stage plus ONE recompute tape,
+    regardless of ``M`` (see module docstring for the honest accounting).
+    """
+    n_stages = mesh.shape[axis]
+    leading = {
+        leaf.shape[0] for leaf in jax.tree_util.tree_leaves(stacked_params)
+    }
+    if len(leading) != 1:
+        raise ValueError(
+            f"stacked_params leaves disagree on the leading (stage) dim: {leading}"
+        )
+    (n_stacked,) = leading
+    if n_stages == 1:
+        # Serial fallback: same math, ordinary autodiff.
+        def serial_loss(stacked, lp, xx):
+            out = xx
+            for s in range(n_stacked):
+                params_s = jax.tree_util.tree_map(
+                    lambda p, s=s: p[s], stacked
+                )
+                out = stage_fn(params_s, out)
+            return last_fn(lp, out, targets)
+
+        loss, (gp, glp, dx) = jax.value_and_grad(
+            serial_loss, argnums=(0, 1, 2)
+        )(stacked_params, last_params, x)
+        return loss, gp, glp, (dx if with_dx else None)
+    if n_stacked != n_stages:
+        raise ValueError(
+            f"stacked_params hold {n_stacked} stages but mesh axis {axis!r} "
+            f"has size {n_stages}; they must match"
+        )
+    d_ax = data_axis if (data_axis and data_axis in mesh.shape) else None
+    local_batch = x.shape[0] // (mesh.shape[d_ax] if d_ax else 1)
+    if local_batch % num_microbatches != 0:
+        raise ValueError(
+            f"per-data-shard batch {local_batch} not divisible by "
+            f"num_microbatches {num_microbatches}"
+        )
+
+    x_spec = P(*((d_ax,) + (None,) * (x.ndim - 1)))
+    tgt_spec = P(*((d_ax,) + (None,) * (targets.ndim - 1)))
+    params_spec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    last_spec = jax.tree_util.tree_map(lambda _: P(), last_params)
+
+    body = functools.partial(
+        _1f1b_shard_body,
+        stage_fn,
+        last_fn,
+        axis=axis,
+        data_axis=d_ax,
+        num_microbatches=num_microbatches,
+        with_dx=with_dx,
+    )
+    if not with_dx:
+        loss, gp, glp = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(params_spec, last_spec, x_spec, tgt_spec),
+            out_specs=(P(), params_spec, last_spec),
+            check_vma=False,
+        )(stacked_params, last_params, x, targets)
+        return loss, gp, glp, None
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(params_spec, last_spec, x_spec, tgt_spec),
+        out_specs=(P(), params_spec, last_spec, x_spec),
+        check_vma=False,
+    )(stacked_params, last_params, x, targets)

@@ -1022,3 +1022,19 @@ class TestCompletedWorldRace:
         admin.set(GEN_KEY, "9")  # even with a bump in place
         admin.set(f"{FINISHED_PREFIX}7", "1")
         assert agent._await_world_done(7, 3) == "done"
+
+    def test_fatal_is_honored_immediately(self, rig):
+        from distributed_pytorch_tpu.elastic.agent import (
+            DONE_PREFIX,
+            FATAL_KEY,
+            GEN_KEY,
+        )
+
+        agent, admin = rig
+        admin.set(GEN_KEY, "7")  # not bumped
+        admin.set(FATAL_KEY, "node1-restarts-exhausted")
+        admin.add(f"{DONE_PREFIX}7", 1)
+        start = time.monotonic()
+        assert agent._await_world_done(7, 3) == "restart"
+        # No stall-window wait on the fatal path (one wait_ge poll only).
+        assert time.monotonic() - start < 3.0

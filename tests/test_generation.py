@@ -365,15 +365,30 @@ class TestTopP:
 
         rng = np.random.default_rng(9)
         logits = jnp.asarray(rng.standard_normal((5, 64)), jnp.float32)
-        for top_k, top_p in [(0, 0.7), (8, 0.0), (8, 0.7), (3, 0.95), (64, 0.5)]:
-            fused = np.isfinite(np.asarray(truncate_logits(logits, top_k, top_p)))
-            ref = logits
-            if top_k > 0:
-                kth = jnp.sort(ref, axis=-1)[:, -top_k][:, None]
-                ref = jnp.where(ref < kth, -jnp.inf, ref)
-            if 0.0 < top_p < 1.0:
-                ref = top_p_filter(ref, top_p)
-            np.testing.assert_array_equal(
-                fused, np.isfinite(np.asarray(ref)),
-                err_msg=f"top_k={top_k} top_p={top_p}",
+        # Integer-valued logits force TIES, including at the k-th largest —
+        # the case where a naive exactly-k nucleus diverges from the
+        # documented tie-inclusive semantics (bf16/quantized models tie
+        # often). [2,1,1,1] with top_k=2 is the canonical counterexample.
+        tied = jnp.asarray(
+            np.concatenate(
+                [
+                    rng.integers(-2, 3, (4, 64)).astype(np.float32),
+                    np.array([[2.0, 1.0, 1.0, 1.0] + [0.0] * 60]),
+                ]
             )
+        )
+        for top_k, top_p in [(0, 0.7), (8, 0.0), (8, 0.7), (3, 0.95), (64, 0.5), (2, 0.6)]:
+            for case, arr in (("continuous", logits), ("tied", tied)):
+                fused = np.isfinite(
+                    np.asarray(truncate_logits(arr, top_k, top_p))
+                )
+                ref = arr
+                if top_k > 0:
+                    kth = jnp.sort(ref, axis=-1)[:, -top_k][:, None]
+                    ref = jnp.where(ref < kth, -jnp.inf, ref)
+                if 0.0 < top_p < 1.0:
+                    ref = top_p_filter(ref, top_p)
+                np.testing.assert_array_equal(
+                    fused, np.isfinite(np.asarray(ref)),
+                    err_msg=f"{case} top_k={top_k} top_p={top_p}",
+                )

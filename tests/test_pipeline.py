@@ -139,3 +139,162 @@ def test_pp_training_loss_decreases_with_sharded_stages():
     stacked = state.params["stages"]
     leaf = jtu.tree_leaves(stacked)[0]
     assert not leaf.sharding.is_fully_replicated
+
+
+# ------------------------------------------------------------------ 1F1B
+
+
+class Test1F1B:
+    """pipeline_1f1b_grads (VERDICT r04 item 7): the memory-bounded
+    PipeDream-flush schedule must reproduce the serial chain's loss and
+    every gradient (stage params, head params, input) exactly."""
+
+    S = 4
+
+    def _setup(self, m=8, d=6, batch=16, seed=0):
+        rng = np.random.default_rng(seed)
+        w = jnp.asarray(rng.standard_normal((self.S, d, d)) * 0.4, jnp.float32)
+        b = jnp.asarray(rng.standard_normal((self.S, d)) * 0.1, jnp.float32)
+        head = jnp.asarray(rng.standard_normal((d, 3)) * 0.4, jnp.float32)
+        x = jnp.asarray(rng.standard_normal((batch, d)), jnp.float32)
+        t = jnp.asarray(rng.standard_normal((batch, 3)), jnp.float32)
+        return {"w": w, "b": b}, head, x, t
+
+    @staticmethod
+    def _stage_fn(params, xin):
+        return jnp.tanh(xin @ params["w"] + params["b"])
+
+    @staticmethod
+    def _last_fn(head, y, tgt):
+        return jnp.mean((y @ head - tgt) ** 2)
+
+    def _serial_reference(self, stacked, head, x, t):
+        def loss_fn(stacked, head, x):
+            h = x
+            for s in range(self.S):
+                h = self._stage_fn(
+                    jax.tree_util.tree_map(lambda p, s=s: p[s], stacked), h
+                )
+            return self._last_fn(head, h, t)
+
+        return jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(stacked, head, x)
+
+    @pytest.mark.parametrize("m", [2, 4, 8])  # m < S, m == S, m > S
+    def test_matches_serial_gradients(self, m):
+        from distributed_pytorch_tpu.parallel.pipeline import (
+            pipeline_1f1b_grads,
+        )
+
+        mesh = make_mesh({"stage": self.S}, devices=jax.devices()[: self.S])
+        stacked, head, x, t = self._setup(m=m)
+        loss, gp, glp, dx = pipeline_1f1b_grads(
+            self._stage_fn, stacked, self._last_fn, head, x, t,
+            mesh=mesh, num_microbatches=m, data_axis=None,
+        )
+        ref_loss, (ref_gp, ref_glp, ref_dx) = self._serial_reference(
+            stacked, head, x, t
+        )
+        np.testing.assert_allclose(
+            float(loss), float(ref_loss), rtol=1e-5, err_msg=f"m={m}"
+        )
+        for key in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(gp[key]), np.asarray(ref_gp[key]),
+                rtol=1e-4, atol=1e-5, err_msg=f"m={m} {key}",
+            )
+        np.testing.assert_allclose(
+            np.asarray(glp), np.asarray(ref_glp), rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(dx), np.asarray(ref_dx), rtol=1e-4, atol=1e-5
+        )
+
+    def test_composes_with_data_parallelism(self):
+        from distributed_pytorch_tpu.parallel.pipeline import (
+            pipeline_1f1b_grads,
+        )
+
+        mesh = make_mesh({"data": 2, "stage": self.S})
+        stacked, head, x, t = self._setup(m=4, batch=16)
+        loss, gp, glp, dx = pipeline_1f1b_grads(
+            self._stage_fn, stacked, self._last_fn, head, x, t,
+            mesh=mesh, num_microbatches=4,
+        )
+        # DP x PP reference: the global-batch mean is the mean of per-shard
+        # means (equal shards), which is the plain full-batch mean.
+        ref_loss, (ref_gp, ref_glp, ref_dx) = self._serial_reference(
+            stacked, head, x, t
+        )
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        for key in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(gp[key]), np.asarray(ref_gp[key]),
+                rtol=1e-4, atol=1e-5, err_msg=key,
+            )
+        np.testing.assert_allclose(
+            np.asarray(glp), np.asarray(ref_glp), rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(dx), np.asarray(ref_dx), rtol=1e-4, atol=1e-5
+        )
+
+    def test_serial_fallback_on_trivial_axis(self):
+        from distributed_pytorch_tpu.parallel.pipeline import (
+            pipeline_1f1b_grads,
+        )
+
+        mesh = make_mesh({"stage": 1}, devices=jax.devices()[:1])
+        stacked, head, x, t = self._setup(m=4)
+        loss, gp, glp, dx = pipeline_1f1b_grads(
+            self._stage_fn, stacked, self._last_fn, head, x, t,
+            mesh=mesh, num_microbatches=4, data_axis=None,
+        )
+        ref_loss, (ref_gp, ref_glp, ref_dx) = self._serial_reference(
+            stacked, head, x, t
+        )
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(gp["w"]), np.asarray(ref_gp["w"]), rtol=1e-5
+        )
+
+    def test_training_loss_decreases(self):
+        """SGD on 1F1B grads actually trains (stage AND head params move)."""
+        from distributed_pytorch_tpu.parallel.pipeline import (
+            pipeline_1f1b_grads,
+        )
+
+        mesh = make_mesh({"stage": self.S}, devices=jax.devices()[: self.S])
+        stacked, head, x, t = self._setup(m=4)
+        losses = []
+        for _ in range(12):
+            loss, gp, glp, _ = pipeline_1f1b_grads(
+                self._stage_fn, stacked, self._last_fn, head, x, t,
+                mesh=mesh, num_microbatches=4, data_axis=None,
+            )
+            losses.append(float(loss))
+            stacked = jax.tree_util.tree_map(
+                lambda p, g: p - 0.1 * g, stacked, gp
+            )
+            head = head - 0.1 * glp
+        # The random-target regression has an irreducible residual; assert a
+        # clear, steady descent rather than an arbitrary halving.
+        assert losses[-1] < 0.8 * losses[0], losses
+        assert all(b < a for a, b in zip(losses, losses[1:])), losses
+
+    def test_with_dx_false_matches_and_returns_none(self):
+        from distributed_pytorch_tpu.parallel.pipeline import (
+            pipeline_1f1b_grads,
+        )
+
+        mesh = make_mesh({"stage": self.S}, devices=jax.devices()[: self.S])
+        stacked, head, x, t = self._setup(m=4)
+        loss, gp, glp, dx = pipeline_1f1b_grads(
+            self._stage_fn, stacked, self._last_fn, head, x, t,
+            mesh=mesh, num_microbatches=4, data_axis=None, with_dx=False,
+        )
+        assert dx is None
+        ref_loss, (ref_gp, _, _) = self._serial_reference(stacked, head, x, t)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(gp["w"]), np.asarray(ref_gp["w"]), rtol=1e-4, atol=1e-5
+        )

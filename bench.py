@@ -592,6 +592,12 @@ def main():
         "write BENCH_SCALING.json",
     )
     parser.add_argument(
+        "--window_sweep", action="store_true",
+        help="measure LM step time vs sliding-window size at T=8192 "
+        "(the flash kernel skips out-of-band tiles; compute should fall "
+        "toward O(T x W)) and write BENCH_WINDOW.json",
+    )
+    parser.add_argument(
         "--fake_devices", type=int, default=0, metavar="N",
         help="run on N virtual CPU devices instead of the real backend "
         "(the --scaling rig until a multi-chip slice exists)",
@@ -611,10 +617,12 @@ def main():
         jax.config.update("jax_platforms", "cpu")
 
     scaling_metric = "dp_weak_scaling_efficiency"
-    metric, unit = (
-        (scaling_metric, "ratio_vs_1dev") if args.scaling
-        else ("resnet50_bf16_train_steps_per_sec", "steps/s")
-    )
+    if args.scaling:
+        metric, unit = scaling_metric, "ratio_vs_1dev"
+    elif args.window_sweep:
+        metric, unit = "window1024_speedup_vs_full_t8192", "ratio"
+    else:
+        metric, unit = "resnet50_bf16_train_steps_per_sec", "steps/s"
 
     dev, err = init_backend_with_retry()
     if dev is None:
@@ -667,6 +675,41 @@ def run_benches(args, dev, peak):
                     "n_devices": last["n_devices"],
                     "awaiting_hardware": scaling["awaiting_hardware"],
                     "efficiency_meaningful": scaling["efficiency_meaningful"],
+                }
+            )
+        )
+        return
+
+    if args.window_sweep:
+        # Exclusive mode: step time vs band width at T=8192, fused head.
+        # Speedup is steps/s vs the full-causal row (same model, less
+        # compute); the per-row MFU uses the BANDED analytic FLOP basis, so
+        # it reads as kernel efficiency on the smaller work, not speedup.
+        rows = []
+        for w in (0, 512, 1024, 2048, 4096):
+            row = attach_mfu(bench_lm(8192, True, window=w), peak)
+            rows.append(row)
+            print(f"# window={w or 'full'}: {row['steps_per_sec']} steps/s",
+                  flush=True)
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_WINDOW.json"
+        )
+        with open(path, "w") as f:
+            json.dump(
+                {"mode": "sliding_window_sweep", "seq_len": 8192,
+                 "device_kind": dev.device_kind, "rows": rows},
+                f, indent=1,
+            )
+        full_sps = rows[0]["steps_per_sec"]
+        w1024 = next(r for r in rows if "_win1024_" in r["workload"])
+        speedup = round(w1024["steps_per_sec"] / full_sps, 4)
+        print(
+            json.dumps(
+                {
+                    "metric": "window1024_speedup_vs_full_t8192",
+                    "value": speedup,
+                    "unit": "ratio",
+                    "vs_baseline": speedup,
                 }
             )
         )

@@ -49,6 +49,9 @@ def truncate_logits(
     which IS the renormalized survivor distribution."""
     if top_k <= 0 and not (0.0 < top_p < 1.0):
         return logits
+    # top_k beyond the vocab means "keep everything" (the pre-fusion code
+    # clamped the same way via negative-index sort slicing).
+    top_k = min(top_k, logits.shape[-1])
     sorted_desc = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
     if top_k > 0:
         threshold = sorted_desc[..., top_k - 1 : top_k]  # k-th largest

@@ -385,6 +385,13 @@ def _1f1b_shard_body(
     gp = jax.tree_util.tree_map(lambda g: g[None], gp)  # re-stack stage dim
     if not with_dx:
         return loss, gp, glp
+    # Only stage 0 banked dx; replicate it over the stage ring (masked
+    # psum, same as loss/glp and pipeline_apply's output) so the
+    # stage-replicated out_spec is actually true on every device — a
+    # downstream embedding backward on stages > 0 must not see zeros.
+    dx_bank = jax.lax.psum(
+        jnp.where(stage_idx == 0, dx_bank, jnp.zeros_like(dx_bank)), axis
+    )
     return loss, gp, glp, dx_bank.reshape(x.shape)
 
 

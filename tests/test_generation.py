@@ -392,3 +392,17 @@ class TestTopP:
                     fused, np.isfinite(np.asarray(ref)),
                     err_msg=f"{case} top_k={top_k} top_p={top_p}",
                 )
+
+    def test_top_k_beyond_vocab_keeps_everything(self):
+        """top_k > vocab must degrade to keep-all (the pre-fusion behavior),
+        not crash on an empty slice."""
+        from distributed_pytorch_tpu.generation import truncate_logits
+
+        logits = jnp.asarray(
+            np.random.default_rng(1).standard_normal((2, 8)), jnp.float32
+        )
+        out = truncate_logits(logits, 100, 0.0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(logits))
+        # ...and still composes with a nucleus.
+        out_p = truncate_logits(logits, 100, 0.5)
+        assert np.isfinite(np.asarray(out_p)).sum() < logits.size

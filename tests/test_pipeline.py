@@ -298,3 +298,24 @@ class Test1F1B:
         np.testing.assert_allclose(
             np.asarray(gp["w"]), np.asarray(ref_gp["w"]), rtol=1e-4, atol=1e-5
         )
+
+    def test_dx_is_identical_on_every_stage_shard(self):
+        """dx leaves the shard_map stage-REPLICATED for real: every device's
+        shard must hold the same (correct) values, not just stage 0's
+        (host-side np.asarray reads only the first shard, which hid this)."""
+        from distributed_pytorch_tpu.parallel.pipeline import (
+            pipeline_1f1b_grads,
+        )
+
+        mesh = make_mesh({"stage": self.S}, devices=jax.devices()[: self.S])
+        stacked, head, x, t = self._setup(m=4)
+        _, _, _, dx = pipeline_1f1b_grads(
+            self._stage_fn, stacked, self._last_fn, head, x, t,
+            mesh=mesh, num_microbatches=4, data_axis=None,
+        )
+        _, (_, _, ref_dx) = self._serial_reference(stacked, head, x, t)
+        for shard in dx.addressable_shards:
+            np.testing.assert_allclose(
+                np.asarray(shard.data), np.asarray(ref_dx),
+                rtol=1e-4, atol=1e-5, err_msg=f"device {shard.device}",
+            )

@@ -33,8 +33,20 @@ run python tools/decode_bench.py
 #    (BASELINE.md round 4); use --augment only with real CIFAR-10 data.
 run python examples/real_data.py --epochs 6 --batch_size 128 --lr 0.02
 
-# 5. Flash block-table sweep IF this chip kind is not already in
+# 5. Sliding-window step-time-vs-band sweep (round-4 queue; now includes
+#    the round-5 windowed-ring kernel offsets) -> BENCH_WINDOW.json.
+run python bench.py --window_sweep
+
+# 6. GQA decode A/B: kv-head reduction x int8 (round-4 queue) — compare
+#    against step 3's full-head rows.
+run python tools/decode_bench.py --n_kv_heads 2
+
+# 7. Flash block-table sweep IF this chip kind is not already in
 #    DEFAULT_TABLE (prints a mergeable entry; skip on v5e).
 # run python tools/flash_autotune_gen.py --export blocks_$(date +%s).json
 
-echo "done — commit BENCH_MATRIX.json + BASELINE.md updates" >&2
+# NOTE pod-only A/Bs stay queued for multi-chip hardware (cannot run on one
+# tunneled chip): ring-vs-ulysses (examples/longcontext_lm.py --sp_mode),
+# windowed-ring hop elision, bench.py --scaling real efficiency.
+
+echo "done — commit BENCH_MATRIX.json + BENCH_WINDOW.json + BASELINE.md updates" >&2

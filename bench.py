@@ -753,7 +753,10 @@ def run_benches(args, dev, peak):
                 "matmuls), identical for dense and fused head - XLA cannot "
                 "count Pallas custom-call FLOPs and undercounts the chunked "
                 "fused head, so cost analysis would misrank those rows "
-                "(BASELINE.md round 3)."
+                "(BASELINE.md round 3). _winW rows: the attention term is "
+                "the BANDED analytic count (only in-band k columns), so "
+                "their MFU denominator is smaller than the full-causal "
+                "twins' - compare step time across rows, not MFU."
             ),
             "workloads": matrix,
         }

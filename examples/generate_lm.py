@@ -15,6 +15,13 @@ Flags tour:
 Run:  python examples/generate_lm.py --batch 4 --new_tokens 32 [--quantize]
 """
 
+import os
+import sys
+
+# Make the repo importable when run as `python tools/x.py` / `python examples/x.py`
+# (sys.path[0] is the script's dir, not the repo root).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 
 import numpy as np

@@ -14,6 +14,13 @@ Run:  python examples/longcontext_lm.py --steps 20 --seq_len 2048 \
       python examples/longcontext_lm.py --sp_mode ulysses ...
 """
 
+import os
+import sys
+
+# Make the repo importable when run as `python tools/x.py` / `python examples/x.py`
+# (sys.path[0] is the script's dir, not the repo root).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import time
 

@@ -13,6 +13,13 @@ global batch is ``batch_size * n_chips``.
 Run:  python examples/multichip.py 10 2 [--batch_size 32]
 """
 
+import os
+import sys
+
+# Make the repo importable when run as `python tools/x.py` / `python examples/x.py`
+# (sys.path[0] is the script's dir, not the repo root).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 
 

@@ -17,6 +17,13 @@ Run (N processes):    COORDINATOR_ADDRESS=host0:1234 NUM_PROCESSES=N PROCESS_ID=
                           python examples/multichip_envrun.py 10 2
 """
 
+import os
+import sys
+
+# Make the repo importable when run as `python tools/x.py` / `python examples/x.py`
+# (sys.path[0] is the script's dir, not the repo root).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 
 

@@ -16,6 +16,13 @@ View traces:  tensorboard --logdir log/resnet50
 Run:  python examples/multichip_profile.py [--epochs 3] [--batch_size 32] [--bf16]
 """
 
+import os
+import sys
+
+# Make the repo importable when run as `python tools/x.py` / `python examples/x.py`
+# (sys.path[0] is the script's dir, not the repo root).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 
 

@@ -21,6 +21,13 @@ Run on a pod slice (from launch/tpu_pod_run.sh):
         --command="cd /path/to/repo && python examples/multihost_pod.py 50 5"
 """
 
+import os
+import sys
+
+# Make the repo importable when run as `python tools/x.py` / `python examples/x.py`
+# (sys.path[0] is the script's dir, not the repo root).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 
 

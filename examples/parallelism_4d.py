@@ -11,6 +11,13 @@ configurations.
 Run:  python examples/parallelism_4d.py --steps 10 --fake_devices 8
 """
 
+import os
+import sys
+
+# Make the repo importable when run as `python tools/x.py` / `python examples/x.py`
+# (sys.path[0] is the script's dir, not the repo root).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 
 

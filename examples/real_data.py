@@ -16,6 +16,13 @@ stand-in otherwise — this rig has no egress):
     python examples/real_data.py --epochs 2 --fake_devices 8   # CPU CI rig
 """
 
+import os
+import sys
+
+# Make the repo importable when run as `python tools/x.py` / `python examples/x.py`
+# (sys.path[0] is the script's dir, not the repo root).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 
 

@@ -7,6 +7,13 @@ default device.
 Run:  python examples/single_chip.py 10 2 [--batch_size 32] [--policy bf16]
 """
 
+import os
+import sys
+
+# Make the repo importable when run as `python tools/x.py` / `python examples/x.py`
+# (sys.path[0] is the script's dir, not the repo root).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 
 

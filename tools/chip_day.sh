@@ -9,11 +9,13 @@
 # Steps (each is independently restartable; comment out what you have):
 set -u
 cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
 run() {
   echo "=== [$(date +%H:%M:%S)] $*" >&2
   "$@"
-  echo "=== [$(date +%H:%M:%S)] rc=$? : $*" >&2
+  local rc=$?  # capture BEFORE $(date) below resets $?
+  echo "=== [$(date +%H:%M:%S)] rc=$rc : $*" >&2
 }
 
 # 1. Headline (driver metric): ResNet-50 b32 steps/s + MFU.

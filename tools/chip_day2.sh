@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Round-5 remainder queue: the chip_day.sh steps that failed (ModuleNotFoundError,
+# fixed since) or were polluted by concurrent host load, most valuable first so a
+# short recovery window still captures the headline. Same rules as chip_day.sh:
+# run ALONE, never ctrl-C a step. Usage:
+#
+#   bash tools/chip_day2.sh 2>&1 | tee chip_day2.log
+set -u
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+run() {
+  echo "=== [$(date +%H:%M:%S)] $*" >&2
+  "$@"
+  local rc=$?  # capture BEFORE $(date) below resets $?
+  echo "=== [$(date +%H:%M:%S)] rc=$rc : $*" >&2
+}
+
+# 1. Clean headline (the 03:48 run had a concurrent pytest stealing host CPU).
+run python bench.py
+
+# 2+3. int8 decode A/Bs (weights + KV cache), full-head then kv_heads=2 —
+#      decides the quant_matmul wiring (BASELINE.md round-3 queue).
+run python tools/decode_bench.py
+run python tools/decode_bench.py --n_kv_heads 2
+
+# 4. Real-data-rung curve, full 50k stand-in (NO --augment: crop/flip destroy
+#    the stand-in's pixel-aligned signal — BASELINE.md round 4).
+run python examples/real_data.py --epochs 6 --batch_size 128 --lr 0.02
+
+# 5. Clean full matrix -> BENCH_MATRIX.json (the 03:50 run was host-polluted:
+#    b32 rows ~10-18% low vs the standalone headline at the same hour).
+run python bench.py --matrix
+
+# 6. Window sweep re-run: the first attempt printed 4/5 rows then the relay
+#    wedged mid w=4096 compile; BENCH_WINDOW.json is only written at the end.
+run python bench.py --window_sweep
+
+echo "done — commit BENCH_MATRIX.json + BENCH_WINDOW.json + BASELINE.md updates" >&2

@@ -1,9 +1,12 @@
 #!/usr/bin/env bash
 # Tunnel-recovery automation (VERDICT r04 item 1): probe the axon TPU tunnel
-# on a fixed cadence, log every attempt, and fire tools/chip_day.sh the
-# moment a probe succeeds — so no chip-minute is wasted waiting on a human.
+# on a fixed cadence, log every attempt, and fire the payload script
+# (default tools/chip_day.sh; override with PROBE_PAYLOAD=) the moment a
+# probe succeeds — so no chip-minute is wasted waiting on a human.
 #
 #   bash tools/probe_and_fire.sh &        # logs to chip_probe.log
+#   PROBE_PAYLOAD=tools/chip_day2.sh PROBE_INTERVAL=600 \
+#     bash tools/probe_and_fire.sh &      # round-5 remainder queue, 10 min
 #
 # Design constraints (BASELINE.md round-3/4 outage notes):
 #  * The probe is a plain `jax.devices()` dial — no compile in flight, so
@@ -17,6 +20,12 @@ cd "$(dirname "$0")/.."
 LOG=${PROBE_LOG:-chip_probe.log}
 INTERVAL=${PROBE_INTERVAL:-1200}   # seconds between probes
 PROBE_TIMEOUT=${PROBE_TIMEOUT:-150}
+PAYLOAD=${PROBE_PAYLOAD:-tools/chip_day.sh}  # fired once on recovery
+# Default log derives from the payload name so overriding PROBE_PAYLOAD
+# alone cannot truncate an earlier payload's log (the only record of any
+# rows captured before a mid-run wedge).
+PAYLOAD_LOG=${PROBE_PAYLOAD_LOG:-$(basename "${PAYLOAD%.sh}").log}
+[ -f "$PAYLOAD" ] || { echo "payload missing: $PAYLOAD" >&2; exit 1; }
 
 say() { echo "[$(date -u +%FT%TZ)] $*" | tee -a "$LOG" >&2; }
 
@@ -29,11 +38,11 @@ assert ds and ds[0].platform != "cpu", ds
 print("TUNNEL UP:", ds)
 EOF
   then
-    say "tunnel recovered — firing chip_day.sh (serialized, do not interrupt)"
+    say "tunnel recovered — firing $PAYLOAD (serialized, do not interrupt)"
     # The payload needs the SAME axon plugin env the probe used, or every
     # step silently falls back to CPU and wastes the recovered chip window.
-    PYTHONPATH=/root/.axon_site bash tools/chip_day.sh >chip_day.log 2>&1
-    say "chip_day.sh finished rc=$? — see chip_day.log; probe loop exiting"
+    PYTHONPATH=/root/.axon_site bash "$PAYLOAD" >"$PAYLOAD_LOG" 2>&1
+    say "$PAYLOAD finished rc=$? — see $PAYLOAD_LOG; probe loop exiting"
     exit 0
   else
     say "probe failed (tunnel still wedged); next attempt in ${INTERVAL}s"

@@ -1,0 +1,21 @@
+# Shared preamble for the serialized chip-day queues (sourced, not run):
+# repo-root cwd, package on PYTHONPATH, and a run() helper that logs each
+# step's rc AND counts failures — the sourcing script should `exit
+# "$FAILED_STEPS"` so probe_and_fire.sh's "finished rc=" line distinguishes
+# a window that captured everything from one that captured nothing (the
+# round-5 ModuleNotFoundError window reported rc=0 for exactly this reason).
+set -u
+cd "$(dirname "${BASH_SOURCE[1]}")/.."
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+FAILED_STEPS=0
+
+run() {
+  echo "=== [$(date +%H:%M:%S)] $*" >&2
+  "$@"
+  local rc=$?  # capture BEFORE $(date) below resets $?
+  if [ "$rc" -ne 0 ]; then
+    FAILED_STEPS=$((FAILED_STEPS + 1))
+  fi
+  echo "=== [$(date +%H:%M:%S)] rc=$rc : $*" >&2
+}

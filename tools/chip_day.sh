@@ -7,16 +7,7 @@
 #   bash tools/chip_day.sh 2>&1 | tee chip_day.log
 #
 # Steps (each is independently restartable; comment out what you have):
-set -u
-cd "$(dirname "$0")/.."
-export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
-
-run() {
-  echo "=== [$(date +%H:%M:%S)] $*" >&2
-  "$@"
-  local rc=$?  # capture BEFORE $(date) below resets $?
-  echo "=== [$(date +%H:%M:%S)] rc=$rc : $*" >&2
-}
+source "$(dirname "$0")/_chip_common.sh"
 
 # 1. Headline (driver metric): ResNet-50 b32 steps/s + MFU.
 run python bench.py
@@ -51,4 +42,5 @@ run python tools/decode_bench.py --n_kv_heads 2
 # tunneled chip): ring-vs-ulysses (examples/longcontext_lm.py --sp_mode),
 # windowed-ring hop elision, bench.py --scaling real efficiency.
 
-echo "done — commit BENCH_MATRIX.json + BENCH_WINDOW.json + BASELINE.md updates" >&2
+echo "done (failed steps: $FAILED_STEPS) — commit BENCH_MATRIX.json + BENCH_WINDOW.json + BASELINE.md updates" >&2
+exit "$FAILED_STEPS"

@@ -5,16 +5,7 @@
 # run ALONE, never ctrl-C a step. Usage:
 #
 #   bash tools/chip_day2.sh 2>&1 | tee chip_day2.log
-set -u
-cd "$(dirname "$0")/.."
-export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
-
-run() {
-  echo "=== [$(date +%H:%M:%S)] $*" >&2
-  "$@"
-  local rc=$?  # capture BEFORE $(date) below resets $?
-  echo "=== [$(date +%H:%M:%S)] rc=$rc : $*" >&2
-}
+source "$(dirname "$0")/_chip_common.sh"
 
 # 1. Clean headline (the 03:48 run had a concurrent pytest stealing host CPU).
 run python bench.py
@@ -36,4 +27,5 @@ run python bench.py --matrix
 #    wedged mid w=4096 compile; BENCH_WINDOW.json is only written at the end.
 run python bench.py --window_sweep
 
-echo "done — commit BENCH_MATRIX.json + BENCH_WINDOW.json + BASELINE.md updates" >&2
+echo "done (failed steps: $FAILED_STEPS) — commit BENCH_MATRIX.json + BENCH_WINDOW.json + BASELINE.md updates" >&2
+exit "$FAILED_STEPS"

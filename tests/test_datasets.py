@@ -91,6 +91,69 @@ class TestSyntheticCifar10:
         oracle = synthetic_oracle_accuracy(x_test, y_test)
         assert 0.90 <= oracle <= 0.96, oracle
 
+    def test_smooth_templates_keep_oracle_band_and_determinism(self):
+        """``smooth_frac`` redistributes template variance across spatial
+        frequencies without moving the Bayes ceiling (expected pairwise
+        template distances are correlation-invariant), so the design band
+        holds at any mix — and generation stays deterministic."""
+        from distributed_pytorch_tpu.utils.datasets import (
+            synthetic_oracle_accuracy,
+        )
+
+        a = synthetic_cifar10(n_train=32, n_test=2000, smooth_frac=0.5)
+        b = synthetic_cifar10(n_train=32, n_test=2000, smooth_frac=0.5)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+        oracle = synthetic_oracle_accuracy(
+            a[2], a[3], smooth_frac=0.5
+        )
+        assert 0.90 <= oracle <= 0.96, oracle
+
+    def test_smooth_component_is_low_frequency_unit_std(self):
+        """The low-pass helper: unit per-template std (so ``contrast``
+        keeps meaning) and energy actually concentrated at low spatial
+        frequencies."""
+        from distributed_pytorch_tpu.utils.datasets import _lowpass
+
+        rng = np.random.default_rng(3)
+        white = rng.standard_normal((4, 32, 32, 3)).astype(np.float32)
+        smooth = _lowpass(white, 6.0)
+        np.testing.assert_allclose(
+            smooth.std(axis=(1, 2, 3)), 1.0, rtol=1e-5
+        )
+        spec = np.abs(np.fft.fft2(smooth, axes=(1, 2))) ** 2
+        # Everything beyond the first few spatial harmonics is gone.
+        low = spec[:, :4, :4, :].sum() + spec[:, -3:, :4, :].sum() \
+            + spec[:, :4, -3:, :].sum() + spec[:, -3:, -3:, :].sum()
+        assert low / spec.sum() > 0.95
+
+    def test_conv_reachable_ceiling_justifies_smooth_default(self):
+        """The round-5 finding, as an executable claim: classify with ONLY
+        the low-frequency template component (the part a weight-shared
+        conv stack + GAP can express) and accuracy must still clear the
+        real-data rung's >=0.5 bar by a wide margin at the 0.5 default —
+        while the full oracle needs the white part too, keeping the task
+        multi-epoch for linear learners."""
+        from distributed_pytorch_tpu.utils.datasets import (
+            _synthetic_template_components,
+            synthetic_oracle_accuracy,
+        )
+
+        sf = 0.5
+        _, smooth_only = _synthetic_template_components(0, 2.6, sf)
+        smooth_only = smooth_only.reshape(10, -1)
+        _, _, x, y = synthetic_cifar10(n_train=1, n_test=2000, smooth_frac=sf)
+        xb = x.astype(np.float32).reshape(len(x), -1)
+        d = (
+            (xb**2).sum(1, keepdims=True)
+            - 2.0 * xb @ smooth_only.T
+            + (smooth_only**2).sum(1)[None, :]
+        )
+        partial = float((d.argmin(1) == y).mean())
+        full = synthetic_oracle_accuracy(x, y, smooth_frac=sf)
+        assert partial >= 0.70, partial  # conv-reachable headroom over 0.5
+        assert full - partial >= 0.08, (full, partial)  # white part matters
+
     def test_learning_takes_multiple_epochs(self):
         """The round-3 stand-in hit accuracy 1.0 in epoch 1, proving only
         plumbing. Here a linear learner (nearest-template is linear, so it

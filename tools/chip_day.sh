@@ -24,7 +24,10 @@ run python tools/decode_bench.py
 #    ResNet-18 overfits noise instead of pooling the template signal).
 #    NO --augment: crop/flip destroy the stand-in's pixel-aligned signal
 #    (BASELINE.md round 4); use --augment only with real CIFAR-10 data.
-run python examples/real_data.py --epochs 6 --batch_size 128 --lr 0.02
+#    --smooth_frac 0.0 pins the ORIGINAL white-template stand-in this
+#    queue's round-4 record used (now known GAP-conv-unlearnable, BASELINE
+#    round 5); the current recipe lives in chip_day2.sh step 4.
+run python examples/real_data.py --epochs 6 --batch_size 128 --lr 0.02 --smooth_frac 0.0
 
 # 5. Sliding-window step-time-vs-band sweep (round-4 queue; now includes
 #    the round-5 windowed-ring kernel offsets) -> BENCH_WINDOW.json.

@@ -16,8 +16,10 @@ run python tools/decode_bench.py
 run python tools/decode_bench.py --n_kv_heads 2
 
 # 4. Real-data-rung curve, full 50k stand-in (NO --augment: crop/flip destroy
-#    the stand-in's pixel-aligned signal — BASELINE.md round 4).
-run python examples/real_data.py --epochs 6 --batch_size 128 --lr 0.02
+#    the stand-in's pixel-aligned signal — BASELINE.md round 4). smooth_frac
+#    defaults to 0.5: the round-5 CPU-measured recipe that lifts a conv net
+#    off chance (white templates are GAP-conv-unlearnable, BASELINE.md r5).
+run python examples/real_data.py --epochs 8 --batch_size 128 --lr 0.1
 
 # 5. Clean full matrix -> BENCH_MATRIX.json (the 03:50 run was host-polluted:
 #    b32 rows ~10-18% low vs the standalone headline at the same hour).

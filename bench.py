@@ -686,7 +686,11 @@ def run_benches(args, dev, peak):
         # compute); the per-row MFU uses the BANDED analytic FLOP basis, so
         # it reads as kernel efficiency on the smaller work, not speedup.
         rows = []
-        for w in (0, 512, 1024, 2048, 4096):
+        # No w=4096 row: its compile RPC is what wedged the relay on
+        # 2026-07-31 (BASELINE.md round 5) and the trend is already visible
+        # by 2048 (band cost rising toward the full-causal floor); do not
+        # re-risk a recovered tunnel on the least informative row.
+        for w in (0, 512, 1024, 2048):
             row = attach_mfu(bench_lm(8192, True, window=w), peak)
             rows.append(row)
             print(f"# window={w or 'full'}: {row['steps_per_sec']} steps/s",

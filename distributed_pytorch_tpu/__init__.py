@@ -34,6 +34,7 @@ from distributed_pytorch_tpu.checkpoint import (
     save_snapshot,
 )
 from distributed_pytorch_tpu.generation import generate, top_p_filter
+from distributed_pytorch_tpu.speculative import speculative_generate
 from distributed_pytorch_tpu.parallel.bootstrap import (
     is_main_process,
     setup_distributed,
@@ -64,6 +65,7 @@ __all__ = [
     "MaterializedDataset",
     "NativeShardedLoader",
     "generate",
+    "speculative_generate",
     "top_p_filter",
     "RandomDataset",
     "ShardedLoader",

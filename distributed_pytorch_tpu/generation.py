@@ -97,6 +97,27 @@ def top_p_filter(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
     return jnp.where(logits < threshold, -jnp.inf, logits)
 
 
+def bucketed_prefill_len(prompt_lengths) -> int:
+    """Static prefill length, computed HOST-SIDE before any device placement
+    (a batch-sharded array could span non-addressable devices). Clamped to
+    1: a zero-length row means position 0 is already generated, so the
+    serial loop must start at t=0 (the loop body at position t decides
+    token t+1 — the last prefix token must go through the loop to produce
+    the first prediction).
+
+    Bucketed DOWN to a power of two: prefill_len is part of the
+    compile-cache key, and with naturally varied prompt lengths an exact
+    value would compile a fresh decode executable per distinct
+    batch-minimum (thrashing the lru cache). Rounding down is always safe —
+    positions between the bucketed prefill and each row's true prompt
+    length are replayed by the serial loop's keep-prompt path — and costs
+    at most 2x the prefill tokens while capping the variants at log2(T).
+    Shared by :func:`generate` and ``speculative.speculative_generate`` so
+    both paths bucket identically."""
+    prefill_len = max(1, int(np.min(np.asarray(prompt_lengths))))
+    return 1 << (prefill_len.bit_length() - 1)
+
+
 def generate(
     model,
     params,
@@ -218,21 +239,7 @@ def generate(
         axis=1,
     )
     prompt_lengths = jnp.asarray(prompt_lengths, jnp.int32)
-    # Static prefill length, computed HOST-SIDE before any device placement
-    # (a batch-sharded array could span non-addressable devices). Clamped to
-    # 1: a zero-length row means position 0 is already generated, so the
-    # serial loop must start at t=0. -1 below because the loop body at
-    # position t decides token t+1 — the last prefix token must go through
-    # the loop to produce the first prediction.
-    prefill_len = max(1, int(np.min(np.asarray(prompt_lengths))))
-    # Bucket DOWN to a power of two: prefill_len is part of the compile-cache
-    # key, and with naturally varied prompt lengths an exact value would
-    # compile a fresh decode executable per distinct batch-minimum (thrashing
-    # the 32-entry cache). Rounding down is always safe — positions between
-    # the bucketed prefill and each row's true prompt length are replayed by
-    # the serial loop's keep-prompt path — and costs at most 2x the prefill
-    # tokens while capping the number of variants at log2(T).
-    prefill_len = 1 << (prefill_len.bit_length() - 1)
+    prefill_len = bucketed_prefill_len(prompt_lengths)
 
     if mesh is not None:
         batch_sh = NamedSharding(mesh, P(data_axis))

@@ -1,0 +1,257 @@
+"""Speculative decoding: draft-model proposal + chunked target verification.
+
+No reference analog (the reference is a training tutorial; this repo's
+inference surface goes beyond it — see ``generation.py``). Autoregressive
+decode on TPU is latency-bound: each token is one tiny matmul pass that
+cannot fill the MXU. Speculative decoding (Leviathan et al., 2023) converts
+the TARGET model's serial decode into chunked verification: a small draft
+model proposes ``gamma`` tokens autoregressively (cheap — its weights fit
+the budget the target's can't), then the target scores all ``gamma``
+positions in ONE multi-token forward — the same chunked decode path the
+bucketed prefill uses (``models/transformer.py::Attention._decode_step``
+handles per-position RoPE and the intra-chunk causal mask), so the verify
+pass is MXU-shaped instead of bandwidth-shaped.
+
+Greedy only, and exact BY ACCEPTANCE RULE: every emitted token equals the
+target model's argmax given its prefix (only draft tokens matching the
+target's own greedy choice are kept; the target's choice is emitted at the
+first mismatch), so ``speculative_generate == generate(temperature=0)``
+token-for-token — pinned by ``tests/test_speculative.py``. One honest
+caveat: the verify pass computes those argmaxes from a ``gamma``-wide
+chunked forward while plain ``generate`` uses single-token forwards, and
+in reduced precision (bf16) XLA may fuse/reduce the two shapes differently
+— a near-TIE between the top two logits can then break differently. The
+rule is exact; float equality across chunk widths is the model's to
+provide (the tests pin exactness at float32; ties this close are
+epsilon-measure for trained models). Sampled speculative decoding
+(modified rejection sampling) is out of scope.
+
+Design notes (TPU/XLA):
+
+* The outer loop is a ``lax.while_loop`` (the per-round advance is
+  data-dependent: 1 to ``gamma`` positions), with every shape inside static:
+  the draft phase is always ``gamma`` single-token steps, the verify chunk
+  is always ``gamma`` wide, and the token buffer is padded by ``gamma`` so
+  the final round's writes never need masking.
+* Cache rollback is an index rewind, not a copy: the decode cache masks
+  reads at ``k_abs <= q_abs`` (positions beyond ``cache_index`` are
+  invisible) and writes through ``dynamic_update_slice`` at the index, so
+  stale K/V from rejected draft tokens is dead by construction — rolling
+  back IS setting ``cache_index`` (`_set_cache_index`), O(1).
+* Batched rounds advance by the MINIMUM acceptance across rows (the cache
+  index is one scalar per layer, not per row). Greedy determinism makes
+  this exact: a row that accepted further just re-derives the identical
+  tokens next round. The expected speedup therefore decays with batch
+  size; B=1 is the latency case speculative decoding exists for.
+* The per-round advance is capped at ``gamma`` (no "bonus" ``gamma+1``-th
+  token on full acceptance): emitting it would advance past the draft
+  cache's fill point and turn the next draft phase into a ragged catch-up
+  chunk. One potential extra token per round is not worth dynamic shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _set_cache_index(cache, value):
+    """Rewind every layer's ``cache_index`` leaf to ``value`` (O(1) — see
+    module docstring on why this is a complete rollback)."""
+    value = jnp.asarray(value, jnp.int32)
+
+    def maybe(path, leaf):
+        last = path[-1]
+        key = getattr(last, "key", None)
+        return value if key == "cache_index" else leaf
+
+    return jax.tree_util.tree_map_with_path(maybe, cache)
+
+
+def speculative_generate(
+    model,
+    params,
+    draft_model,
+    draft_params,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    *,
+    gamma: int = 4,
+    prompt_lengths: Optional[jnp.ndarray] = None,
+    pad_token: int = 0,
+    return_stats: bool = False,
+):
+    """Greedy-decode ``max_new_tokens`` continuations of ``prompt`` [B, T0]
+    with ``model`` as the target, using ``draft_model`` to propose
+    ``gamma``-token chunks. Returns ``[B, T0 + max_new_tokens]`` ids —
+    token-for-token identical to ``generate(model, ..., temperature=0)``
+    up to reduced-precision argmax ties across chunk widths (see module
+    docstring; exact at float32).
+
+    ``return_stats=True`` additionally returns ``{"rounds": R,
+    "positions_advanced": A}``: A/R in [1, gamma] is the mean accepted
+    chunk length (draft quality x batch-min effect); the target ran R
+    chunked forwards instead of A serial single-token steps.
+
+    Both models must share the vocabulary; the draft is typically a
+    narrower/shallower ``TransformerLM``. Single-mesh (unsharded) decode —
+    compose with TP/DP via ``generation.generate`` if sharding is needed.
+    """
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    tv = getattr(model, "vocab_size", None)
+    dv = getattr(draft_model, "vocab_size", None)
+    if tv is not None and dv is not None and tv != dv:
+        raise ValueError(
+            f"target and draft must share a vocabulary, got {tv} vs {dv}"
+        )
+    target = model.clone(decode=True)
+    draft = draft_model.clone(decode=True)
+
+    batch, prompt_len = prompt.shape
+    total_len = prompt_len + max_new_tokens
+    if prompt_lengths is None:
+        prompt_lengths = jnp.full((batch,), prompt_len, jnp.int32)
+    prompt_lengths = jnp.asarray(prompt_lengths, jnp.int32)
+
+    # Buffer and caches padded by gamma: the last round may write (but
+    # never emit) up to gamma - 1 positions past total_len.
+    buf_len = total_len + gamma
+    tokens0 = jnp.concatenate(
+        [
+            jnp.asarray(prompt, jnp.int32),
+            jnp.full((batch, buf_len - prompt_len), pad_token, jnp.int32),
+        ],
+        axis=1,
+    )
+
+    from distributed_pytorch_tpu.generation import bucketed_prefill_len
+
+    prefill_len = bucketed_prefill_len(prompt_lengths)
+
+    t_abstract = jax.eval_shape(
+        target.init, jax.random.PRNGKey(0),
+        jnp.zeros((batch, buf_len), jnp.int32),
+    )["cache"]
+    d_abstract = jax.eval_shape(
+        draft.init, jax.random.PRNGKey(0),
+        jnp.zeros((batch, buf_len), jnp.int32),
+    )["cache"]
+    zeros = lambda s: jnp.zeros(s.shape, s.dtype)  # noqa: E731
+    tcache = jax.tree_util.tree_map(zeros, t_abstract)
+    dcache = jax.tree_util.tree_map(zeros, d_abstract)
+
+    run = _compiled_spec_run(target, draft, buf_len, gamma, prefill_len)
+    tokens, rounds, advanced = run(
+        params, draft_params, tokens0, tcache, dcache, prompt_lengths,
+        total_len,
+    )
+    tokens = tokens[:, :total_len]
+    if return_stats:
+        return tokens, {"rounds": rounds, "positions_advanced": advanced}
+    return tokens
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_spec_run(target, draft, buf_len, gamma, prefill_len):
+    """Jitted speculative loop, cached per (model pair, shapes, gamma)."""
+
+    def run(params, draft_params, tokens, tcache, dcache, prompt_lengths,
+            total_len):
+        batch = tokens.shape[0]
+
+        if prefill_len > 1:
+            chunk = tokens[:, : prefill_len - 1]
+            _, up = target.apply(
+                {"params": params, "cache": tcache}, chunk, mutable=["cache"]
+            )
+            tcache = up["cache"]
+            _, up = draft.apply(
+                {"params": draft_params, "cache": dcache}, chunk,
+                mutable=["cache"],
+            )
+            dcache = up["cache"]
+
+        def draft_step(i, carry):
+            tokens, dcache, t = carry
+            current = jax.lax.dynamic_slice(tokens, (0, t + i), (batch, 1))
+            logits, up = draft.apply(
+                {"params": draft_params, "cache": dcache}, current,
+                mutable=["cache"],
+            )
+            proposal = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            keep_prompt = (t + i + 1) < prompt_lengths
+            existing = jax.lax.dynamic_slice(
+                tokens, (0, t + i + 1), (batch, 1)
+            )[:, 0]
+            nxt = jnp.where(keep_prompt, existing, proposal)
+            tokens = jax.lax.dynamic_update_slice(
+                tokens, nxt[:, None], (0, t + i + 1)
+            )
+            return tokens, up["cache"], t
+
+        def body(carry):
+            tokens, tcache, dcache, t, rounds, advanced = carry
+            # Round entry invariant: both cache_index == t; tokens[.., :t+1]
+            # are final (target-greedy-consistent).
+            tokens, dcache, _ = jax.lax.fori_loop(
+                0, gamma, draft_step, (tokens, dcache, t)
+            )
+            # Target verifies the whole proposal in one chunked forward:
+            # positions t .. t+gamma-1 predict t+1 .. t+gamma.
+            chunk = jax.lax.dynamic_slice(tokens, (0, t), (batch, gamma))
+            logits, up = target.apply(
+                {"params": params, "cache": tcache}, chunk, mutable=["cache"]
+            )
+            tcache = up["cache"]
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, gamma]
+
+            pos = t + 1 + jnp.arange(gamma)[None, :]  # positions decided
+            written = jax.lax.dynamic_slice(
+                tokens, (0, t + 1), (batch, gamma)
+            )
+            # Prompt positions are given, not generated: auto-accept.
+            match = (written == g) | (pos < prompt_lengths[:, None])
+            n_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [B]
+            n = jnp.min(n_row)  # batch-min advance (see module docstring)
+
+            # Correction write: position t+n+1 gets the target's own token.
+            # When n == gamma the clamped write is a no-op by construction
+            # (match[:, gamma-1] held for every row, so written == g there);
+            # rows that accepted beyond n overwrite with the identical value
+            # (their match[:, n] held too).
+            ni = jnp.minimum(n, gamma - 1)
+            g_n = jax.lax.dynamic_index_in_dim(
+                g, ni, axis=1, keepdims=False
+            )  # [B]: each row's own target token at the correction column
+            keep_prompt = (t + ni + 1) < prompt_lengths
+            existing = jax.lax.dynamic_slice(
+                tokens, (0, t + ni + 1), (batch, 1)
+            )[:, 0]
+            corrected = jnp.where(keep_prompt, existing, g_n)
+            tokens = jax.lax.dynamic_update_slice(
+                tokens, corrected[:, None], (0, t + ni + 1)
+            )
+
+            t_new = t + jnp.minimum(n + 1, gamma)
+            tcache = _set_cache_index(tcache, t_new)
+            dcache = _set_cache_index(dcache, t_new)
+            return (tokens, tcache, dcache, t_new, rounds + 1,
+                    advanced + (t_new - t))
+
+        def cond(carry):
+            return carry[3] < total_len - 1
+
+        t0 = jnp.asarray(prefill_len - 1, jnp.int32)
+        tokens, _, _, _, rounds, advanced = jax.lax.while_loop(
+            cond, body,
+            (tokens, tcache, dcache, t0, jnp.zeros((), jnp.int32),
+             jnp.zeros((), jnp.int32)),
+        )
+        return tokens, rounds, advanced
+
+    return jax.jit(run, static_argnames=())

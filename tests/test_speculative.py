@@ -1,0 +1,155 @@
+"""Speculative decoding: the acceptance rule must make it EXACTLY the
+target model's greedy decode — speedup may vary with the draft, correctness
+may not."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu.generation import generate
+from distributed_pytorch_tpu.models import TransformerLM
+from distributed_pytorch_tpu.speculative import speculative_generate
+
+V = 48
+
+
+def lm(seed=1, **kw):
+    cfg = dict(vocab_size=V, d_model=16, n_layers=2, n_heads=2, d_ff=32,
+               dtype=jnp.float32)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+def init(model, batch=2, seq=10, seed=0, key=1):
+    tokens = np.random.default_rng(seed).integers(0, V, (batch, seq), np.int32)
+    params = model.init(jax.random.PRNGKey(key), jnp.asarray(tokens))["params"]
+    return params, tokens
+
+
+class TestExactGreedyParity:
+    def test_draft_equals_target_accepts_everything(self):
+        """A perfect draft (the target itself) must accept every chunk:
+        positions_advanced == rounds * gamma, and the tokens are the plain
+        greedy decode."""
+        model = lm()
+        params, tokens = init(model)
+        ref = np.asarray(generate(model, params, jnp.asarray(tokens), 12))
+        out, stats = speculative_generate(
+            model, params, model, params, jnp.asarray(tokens), 12,
+            gamma=4, return_stats=True,
+        )
+        np.testing.assert_array_equal(np.asarray(out), ref)
+        assert int(stats["positions_advanced"]) == 4 * int(stats["rounds"])
+
+    def test_independent_draft_still_exact(self):
+        """A differently-initialized (i.e. bad) draft changes only the
+        round count, never the output."""
+        model = lm()
+        params, tokens = init(model)
+        draft = lm()
+        draft_params, _ = init(draft, key=99)
+        ref = np.asarray(generate(model, params, jnp.asarray(tokens), 12))
+        out, stats = speculative_generate(
+            model, params, draft, draft_params, jnp.asarray(tokens), 12,
+            gamma=4, return_stats=True,
+        )
+        np.testing.assert_array_equal(np.asarray(out), ref)
+        # A bad draft must cost extra rounds vs the perfect-draft minimum.
+        assert int(stats["rounds"]) >= (12 + 3) // 4
+
+    def test_narrow_draft_architecture(self):
+        """The realistic shape: a narrower, shallower draft sharing only
+        the vocabulary."""
+        model = lm()
+        params, tokens = init(model, batch=3, seq=8)
+        draft = lm(d_model=8, n_layers=1, n_heads=1, d_ff=16)
+        draft_params, _ = init(draft, key=7)
+        ref = np.asarray(generate(model, params, jnp.asarray(tokens), 9))
+        out = speculative_generate(
+            model, params, draft, draft_params, jnp.asarray(tokens), 9,
+            gamma=3,
+        )
+        np.testing.assert_array_equal(np.asarray(out), ref)
+
+    @pytest.mark.parametrize("gamma", [1, 2, 5])
+    def test_gamma_sweep(self, gamma):
+        model = lm()
+        params, tokens = init(model)
+        draft = lm(d_model=8, n_layers=1, n_heads=1, d_ff=16)
+        draft_params, _ = init(draft, key=3)
+        ref = np.asarray(generate(model, params, jnp.asarray(tokens), 7))
+        out = speculative_generate(
+            model, params, draft, draft_params, jnp.asarray(tokens), 7,
+            gamma=gamma,
+        )
+        np.testing.assert_array_equal(np.asarray(out), ref)
+
+    def test_ragged_prompts(self):
+        """Rows with different prompt lengths: prompt positions are given,
+        not generated — they must be auto-accepted and preserved verbatim,
+        and the continuations must match plain greedy decode."""
+        model = lm()
+        params, tokens = init(model, batch=3, seq=9)
+        lengths = jnp.asarray([9, 5, 7], jnp.int32)
+        t = jnp.asarray(tokens)
+        ref = np.asarray(
+            generate(model, params, t, 8, prompt_lengths=lengths)
+        )
+        draft = lm(d_model=8, n_layers=1, n_heads=1, d_ff=16)
+        draft_params, _ = init(draft, key=5)
+        out = np.asarray(
+            speculative_generate(
+                model, params, draft, draft_params, t, 8,
+                prompt_lengths=lengths, gamma=4,
+            )
+        )
+        np.testing.assert_array_equal(out, ref)
+        for row, L in enumerate([9, 5, 7]):
+            np.testing.assert_array_equal(out[row, :L], tokens[row, :L])
+
+
+class TestValidation:
+    def test_vocab_mismatch_rejected(self):
+        model = lm()
+        params, tokens = init(model)
+        draft = TransformerLM(
+            vocab_size=V + 1, d_model=8, n_layers=1, n_heads=1, d_ff=16,
+            dtype=jnp.float32,
+        )
+        draft_params = draft.init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 4), jnp.int32)
+        )["params"]
+        with np.testing.assert_raises(ValueError):
+            speculative_generate(
+                model, params, draft, draft_params, jnp.asarray(tokens), 4
+            )
+
+    def test_gamma_must_be_positive(self):
+        model = lm()
+        params, tokens = init(model)
+        with np.testing.assert_raises(ValueError):
+            speculative_generate(
+                model, params, model, params, jnp.asarray(tokens), 4, gamma=0
+            )
+
+
+class TestStats:
+    def test_advance_counts_cover_emitted_tokens(self):
+        """rounds >= ceil(new/gamma); positions_advanced >= the emitted
+        continuation (the final round may advance past total_len)."""
+        model = lm()
+        params, tokens = init(model)
+        draft = lm(d_model=8, n_layers=1, n_heads=1, d_ff=16)
+        draft_params, _ = init(draft, key=11)
+        new = 10
+        out, stats = speculative_generate(
+            model, params, draft, draft_params, jnp.asarray(tokens), new,
+            gamma=4, return_stats=True,
+        )
+        rounds = int(stats["rounds"])
+        advanced = int(stats["positions_advanced"])
+        assert out.shape[-1] == tokens.shape[1] + new
+        assert advanced >= new - 1  # t0 may start 1 short of prompt end
+        assert rounds <= advanced  # every round advances >= 1
+        assert advanced <= rounds * 4

@@ -47,6 +47,7 @@ from distributed_pytorch_tpu.training.losses import (
     smoothed_cross_entropy_loss,
     softmax_cross_entropy_loss,
 )
+from distributed_pytorch_tpu.training.lora import LoraModel, merge_lora
 from distributed_pytorch_tpu.training.train_step import TrainState, make_train_step
 from distributed_pytorch_tpu.training.trainer import Trainer
 from distributed_pytorch_tpu.utils.data import (
@@ -72,6 +73,8 @@ __all__ = [
     "StepProfiler",
     "TrainState",
     "Trainer",
+    "LoraModel",
+    "merge_lora",
     "export_orbax",
     "import_orbax",
     "is_main_process",

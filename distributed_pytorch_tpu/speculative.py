@@ -93,9 +93,12 @@ def speculative_generate(
     docstring; exact at float32).
 
     ``return_stats=True`` additionally returns ``{"rounds": R,
-    "positions_advanced": A}``: A/R in [1, gamma] is the mean accepted
-    chunk length (draft quality x batch-min effect); the target ran R
-    chunked forwards instead of A serial single-token steps.
+    "positions_advanced": A}``, counting only GENERATED positions (rounds
+    that merely replay bucketed-down prompt tails are excluded — their
+    auto-accepted prompt positions would overstate draft quality): A/R in
+    [1, gamma] is the mean accepted chunk length (draft quality x
+    batch-min effect); the target ran R chunked forwards instead of A
+    serial single-token steps.
 
     Both models must share the vocabulary; the draft is typically a
     narrower/shallower ``TransformerLM``. Single-mesh (unsharded) decode —
@@ -240,8 +243,18 @@ def _compiled_spec_run(target, draft, buf_len, gamma, prefill_len):
             t_new = t + jnp.minimum(n + 1, gamma)
             tcache = _set_cache_index(tcache, t_new)
             dcache = _set_cache_index(dcache, t_new)
-            return (tokens, tcache, dcache, t_new, rounds + 1,
-                    advanced + (t_new - t))
+            # Stats count only GENERATED positions: rounds replaying
+            # bucketed-down prompt tails auto-accept via the prompt term in
+            # `match`, and crediting those would overstate draft quality
+            # (position p is generated iff p >= its row's prompt length;
+            # p > max_prompt - 1 covers every row).
+            max_prompt = jnp.max(prompt_lengths)
+            gen_adv = jnp.maximum(
+                t_new - jnp.maximum(t, max_prompt - 1), 0
+            )
+            return (tokens, tcache, dcache, t_new,
+                    rounds + (gen_adv > 0).astype(jnp.int32),
+                    advanced + gen_adv)
 
         def cond(carry):
             return carry[3] < total_len - 1

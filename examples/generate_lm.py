@@ -75,16 +75,19 @@ def main(args):
             for name, active in (
                 ("--temperature", args.temperature > 0),
                 ("--top_k", args.top_k > 0),
-                ("--top_p", args.top_p > 0),
+                # 0 or >= 1 disables nucleus sampling (its own help text),
+                # so only an ACTIVE top_p conflicts.
+                ("--top_p", 0 < args.top_p < 1),
                 ("--quantize", args.quantize),
                 ("--quantized_cache", args.quantized_cache),
+                ("--fake_devices > 1 (sharded decode)", args.fake_devices > 1),
             )
             if active
         ]
         if dropped:
             raise SystemExit(
-                f"--speculative is greedy-only full-precision decode; "
-                f"incompatible with {', '.join(dropped)}"
+                f"--speculative is greedy-only single-device full-precision "
+                f"decode; incompatible with {', '.join(dropped)}"
             )
         # Greedy speculative decode against a width/depth-reduced draft
         # sharing the vocabulary (randomly initialized here — a real draft

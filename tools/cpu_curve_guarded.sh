@@ -1,44 +1,7 @@
 #!/usr/bin/env bash
-# Run the real-data rung's CPU fallback curve (VERDICT r04 item 5) WITHOUT
-# polluting any concurrently-recovered chip window: the host has ONE core
-# (BASELINE.md round-5 operational lesson), so this wrapper SIGSTOPs the
-# training process whenever a chip-day payload is running and SIGCONTs it
-# when the chip is idle again. Usage:
+# Run the real-data rung's CPU fallback curve (VERDICT r04 item 5) without
+# polluting any concurrently-recovered chip window — the real-data instance
+# of tools/host_guarded.sh (see there for the pause/resume mechanics).
 #
 #   bash tools/cpu_curve_guarded.sh [real_data.py args...] >cpu_curve.log 2>&1 &
-source "$(dirname "$0")/_chip_common.sh"
-
-python examples/real_data.py "$@" &
-PID=$!
-echo "[guard] curve pid=$PID" >&2
-# CONT before TERM: a plain TERM to a SIGSTOPped process stays pending
-# forever, orphaning the curve in state T. Trap signals too, not just EXIT
-# (bash delivers the trap only after the current sleep finishes, <=20s),
-# and exit explicitly from the signal path or bash resumes the loop.
-cleanup() { kill -CONT "$PID" 2>/dev/null; kill "$PID" 2>/dev/null; }
-trap cleanup EXIT
-trap 'cleanup; trap - EXIT; exit 143' INT TERM
-
-paused=0
-while kill -0 "$PID" 2>/dev/null; do
-  # Anything dialing the real chip wins the core: chip-day queues and the
-  # bare driver bench. "bash <path>" / "python <path>" with no space in the
-  # path survives absolute/relative launch variants, while launcher shells
-  # that merely MENTION these scripts in an env assignment (probe_and_fire's
-  # PROBE_PAYLOAD=... argv) don't read as a live payload forever.
-  if pgrep -f "bash [^ ]*tools/chip_day|python [^ ]*bench\.py|python [^ ]*tools/decode_bench" >/dev/null; then
-    if [ "$paused" = 0 ]; then
-      echo "[guard $(date +%H:%M:%S)] chip payload active - pausing curve" >&2
-      kill -STOP "$PID"; paused=1
-    fi
-  elif [ "$paused" = 1 ]; then
-    echo "[guard $(date +%H:%M:%S)] chip idle - resuming curve" >&2
-    kill -CONT "$PID"; paused=0
-  fi
-  sleep 20
-done
-wait "$PID"
-rc=$?
-trap - EXIT
-echo "[guard] curve finished rc=$rc" >&2
-exit $rc
+exec bash "$(dirname "$0")/host_guarded.sh" python examples/real_data.py "$@"

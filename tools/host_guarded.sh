@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Run ANY host-CPU command without polluting a concurrently-recovered chip
+# window: SIGSTOPs the command whenever a chip payload (chip-day queue,
+# driver bench, decode bench) is live on this one-core host, SIGCONTs when
+# the chip is idle (BASELINE.md round-5 operational lesson). Usage:
+#
+#   bash tools/host_guarded.sh python -m pytest tests/ -q   >suite.log 2>&1 &
+#
+# tools/cpu_curve_guarded.sh is the real-data-curve instance of this.
+source "$(dirname "$0")/_chip_common.sh"
+
+"$@" &
+PID=$!
+echo "[guard] guarded pid=$PID: $*" >&2
+# CONT before TERM: a plain TERM to a SIGSTOPped process stays pending
+# forever, orphaning the child in state T. Trap signals too, not just EXIT
+# (bash delivers the trap only after the current sleep finishes, <=20s),
+# and exit explicitly from the signal path or bash resumes the loop.
+cleanup() { kill -CONT "$PID" 2>/dev/null; kill "$PID" 2>/dev/null; }
+trap cleanup EXIT
+trap 'cleanup; trap - EXIT; exit 143' INT TERM
+
+paused=0
+while kill -0 "$PID" 2>/dev/null; do
+  # "bash <path>" / "python <path>" with no space in the path survives
+  # absolute/relative launch variants, while launcher shells that merely
+  # MENTION these scripts in an env assignment (probe_and_fire's
+  # PROBE_PAYLOAD=... argv) don't read as a live payload forever.
+  if pgrep -f "bash [^ ]*tools/chip_day|python [^ ]*bench\.py|python [^ ]*tools/decode_bench" >/dev/null; then
+    if [ "$paused" = 0 ]; then
+      echo "[guard $(date +%H:%M:%S)] chip payload active - pausing" >&2
+      kill -STOP "$PID"; paused=1
+    fi
+  elif [ "$paused" = 1 ]; then
+    echo "[guard $(date +%H:%M:%S)] chip idle - resuming" >&2
+    kill -CONT "$PID"; paused=0
+  fi
+  sleep 20
+done
+wait "$PID"
+rc=$?
+trap - EXIT
+echo "[guard] command finished rc=$rc" >&2
+exit $rc

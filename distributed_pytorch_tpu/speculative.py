@@ -97,8 +97,10 @@ def speculative_generate(
     that merely replay bucketed-down prompt tails are excluded — their
     auto-accepted prompt positions would overstate draft quality): A/R in
     [1, gamma] is the mean accepted chunk length (draft quality x
-    batch-min effect); the target ran R chunked forwards instead of A
-    serial single-token steps.
+    batch-min effect). R is a LOWER bound on the target's chunked
+    forwards (replay-only rounds run one too but count toward neither);
+    with power-of-two prompt lengths the two coincide, and either way
+    the target ran far fewer forwards than A serial single-token steps.
 
     Both models must share the vocabulary; the draft is typically a
     narrower/shallower ``TransformerLM``. Single-mesh (unsharded) decode —

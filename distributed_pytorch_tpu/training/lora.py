@@ -165,10 +165,16 @@ class LoraModel:
         inner_mutable = mutable
         if isinstance(mutable, (list, tuple)):
             inner_mutable = [m for m in mutable if m != "lora_base"]
+        elif isinstance(mutable, str):
+            inner_mutable = False if mutable == "lora_base" else mutable
         out = self.model.apply(
             {"params": merged, **vs}, *args, mutable=inner_mutable, **kw
         )
-        if isinstance(mutable, (list, tuple)) and "lora_base" in mutable:
+        if mutable:  # every truthy form returns (out, state) — keep the
+            # facade closed: lora_base always rides back so the standard
+            # flax round-trip {**vars, **new_state} re-applies cleanly.
+            if inner_mutable is False:  # mutable == "lora_base" edge
+                return out, {"lora_base": base}
             preds, new_state = out
             return preds, {**dict(new_state), "lora_base": base}
         return out

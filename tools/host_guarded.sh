@@ -26,7 +26,35 @@ while kill -0 "$PID" 2>/dev/null; do
   # absolute/relative launch variants, while launcher shells that merely
   # MENTION these scripts in an env assignment (probe_and_fire's
   # PROBE_PAYLOAD=... argv) don't read as a live payload forever.
-  if pgrep -f "bash [^ ]*tools/chip_day|python [^ ]*bench\.py|python [^ ]*tools/decode_bench" >/dev/null; then
+  # Exclude the guarded child, the guard itself, AND the guard's ancestor
+  # chain: guarding a command that IS a chip payload (e.g.
+  # `host_guarded.sh python bench.py ...`) must not self-pause into a
+  # permanent STOP (a stopped process still matches pgrep, so the idle
+  # branch would never fire) — and launcher/harness shells above us carry
+  # the same command string in their argv.
+  excl="$PID $$"
+  anc=$$
+  while [ "$anc" -gt 1 ] 2>/dev/null; do
+    anc=$(awk '{print $4}' "/proc/$anc/stat" 2>/dev/null) || break
+    excl="$excl $anc"
+  done
+  # ...and our DESCENDANTS: $(...) substitutions fork subshells carrying
+  # the guard's own argv, which would otherwise match the pattern every
+  # poll when the guarded command is itself bench-shaped.
+  is_ours() {
+    local p=$1
+    case " $excl " in *" $p "*) return 0 ;; esac
+    while [ "$p" -gt 1 ] 2>/dev/null; do
+      p=$(awk '{print $4}' "/proc/$p/stat" 2>/dev/null) || return 1
+      [ "$p" = "$$" ] && return 0
+    done
+    return 1
+  }
+  others=""
+  for cand in $(pgrep -f "bash [^ ]*tools/chip_day|python [^ ]*bench\.py|python [^ ]*tools/decode_bench"); do
+    is_ours "$cand" || others="$others $cand"
+  done
+  if [ -n "$others" ]; then
     if [ "$paused" = 0 ]; then
       echo "[guard $(date +%H:%M:%S)] chip payload active - pausing" >&2
       kill -STOP "$PID"; paused=1

@@ -12,19 +12,25 @@ bucketed prefill uses (``models/transformer.py::Attention._decode_step``
 handles per-position RoPE and the intra-chunk causal mask), so the verify
 pass is MXU-shaped instead of bandwidth-shaped.
 
-Greedy only, and exact BY ACCEPTANCE RULE: every emitted token equals the
-target model's argmax given its prefix (only draft tokens matching the
-target's own greedy choice are kept; the target's choice is emitted at the
-first mismatch), so ``speculative_generate == generate(temperature=0)``
-token-for-token — pinned by ``tests/test_speculative.py``. One honest
-caveat: the verify pass computes those argmaxes from a ``gamma``-wide
+Exact BY ACCEPTANCE RULE, in both modes. Greedy (``temperature=0``):
+every emitted token equals the target model's argmax given its prefix
+(only draft tokens matching the target's own greedy choice are kept; the
+target's choice is emitted at the first mismatch), so
+``speculative_generate == generate(temperature=0)`` token-for-token —
+pinned by ``tests/test_speculative.py``. Sampled (``temperature > 0``):
+Leviathan et al.'s modified rejection sampling — accept draft token x
+with probability ``min(1, p(x)/q(x))``, resample the first rejection from
+the residual ``max(p - q, 0)`` — whose lemma makes every emitted token
+exactly ``p``-distributed (marginal law pinned statistically against the
+target's softmax, with a plain-sampling control calibrating the bound).
+One honest caveat: the verify pass computes ``p`` from a ``gamma``-wide
 chunked forward while plain ``generate`` uses single-token forwards, and
 in reduced precision (bf16) XLA may fuse/reduce the two shapes differently
-— a near-TIE between the top two logits can then break differently. The
-rule is exact; float equality across chunk widths is the model's to
-provide (the tests pin exactness at float32; ties this close are
-epsilon-measure for trained models). Sampled speculative decoding
-(modified rejection sampling) is out of scope.
+— a near-TIE between the top two logits can then break differently (or,
+sampled, shift a probability by float-epsilon). The rule is exact; float
+equality across chunk widths is the model's to provide (the tests pin
+greedy exactness at float32; ties this close are epsilon-measure for
+trained models).
 
 Design notes (TPU/XLA):
 
@@ -39,10 +45,14 @@ Design notes (TPU/XLA):
   stale K/V from rejected draft tokens is dead by construction — rolling
   back IS setting ``cache_index`` (`_set_cache_index`), O(1).
 * Batched rounds advance by the MINIMUM acceptance across rows (the cache
-  index is one scalar per layer, not per row). Greedy determinism makes
-  this exact: a row that accepted further just re-derives the identical
-  tokens next round. The expected speedup therefore decays with batch
-  size; B=1 is the latency case speculative decoding exists for.
+  index is one scalar per layer, not per row). Exactness survives in both
+  modes: greedy rows re-derive the identical tokens next round
+  (determinism), and sampled rows stay exactly p-distributed because
+  whether a row's accepted-but-unfinalized trial is kept or discarded
+  depends only on OTHER rows' independent randomness — a discarded
+  position simply gets a fresh, equally-exact trial next round. The
+  expected speedup still decays with batch size; B=1 is the latency case
+  speculative decoding exists for.
 * The per-round advance is capped at ``gamma`` (no "bonus" ``gamma+1``-th
   token on full acceptance): emitting it would advance past the draft
   cache's fill point and turn the next draft phase into a ragged catch-up
@@ -84,13 +94,21 @@ def speculative_generate(
     prompt_lengths: Optional[jnp.ndarray] = None,
     pad_token: int = 0,
     return_stats: bool = False,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    rng: Optional[jax.Array] = None,
 ):
-    """Greedy-decode ``max_new_tokens`` continuations of ``prompt`` [B, T0]
-    with ``model`` as the target, using ``draft_model`` to propose
-    ``gamma``-token chunks. Returns ``[B, T0 + max_new_tokens]`` ids —
+    """Speculatively decode ``max_new_tokens`` continuations of ``prompt``
+    [B, T0] with ``model`` as the target, using ``draft_model`` to propose
+    ``gamma``-token chunks. Returns ``[B, T0 + max_new_tokens]`` ids whose
+    law is EXACTLY the target's own decode: at ``temperature=0`` (default)
     token-for-token identical to ``generate(model, ..., temperature=0)``
-    up to reduced-precision argmax ties across chunk widths (see module
-    docstring; exact at float32).
+    (up to reduced-precision argmax ties across chunk widths — see module
+    docstring; exact at float32); at ``temperature > 0`` each emitted
+    token is exactly target-distributed under the same
+    ``temperature``/``top_k``/``top_p`` filters via modified rejection
+    sampling (``rng`` seeds the draws).
 
     ``return_stats=True`` additionally returns ``{"rounds": R,
     "positions_advanced": A}``, counting only GENERATED positions (rounds
@@ -101,6 +119,20 @@ def speculative_generate(
     forwards (replay-only rounds run one too but count toward neither);
     with power-of-two prompt lengths the two coincide, and either way
     the target ran far fewer forwards than A serial single-token steps.
+
+    ``temperature > 0`` switches to SAMPLED speculative decoding
+    (Leviathan et al. modified rejection sampling): the draft SAMPLES each
+    proposal from its own (temperature/top-k/top-p filtered) distribution
+    q, the target accepts token x with probability ``min(1, p(x)/q(x))``
+    against its equally-filtered distribution p, and the first rejected
+    position is resampled from the residual ``max(p - q, 0)`` — which
+    makes every emitted token EXACTLY ``p``-distributed, the same law as
+    ``generate(temperature=..., top_k=..., top_p=...)`` (the classic
+    lemma; the marginal is pinned statistically in
+    ``tests/test_speculative.py``). The draft's full distributions are
+    recomputed in one chunked draft forward at verify time (cache rewound
+    and replayed) rather than carried through the proposal loop — one
+    cheap extra draft pass instead of a ``[B, gamma, V]`` carry.
 
     Both models must share the vocabulary; the draft is typically a
     narrower/shallower ``TransformerLM``. Single-mesh (unsharded) decode —
@@ -150,10 +182,15 @@ def speculative_generate(
     tcache = jax.tree_util.tree_map(zeros, t_abstract)
     dcache = jax.tree_util.tree_map(zeros, d_abstract)
 
-    run = _compiled_spec_run(target, draft, buf_len, gamma, prefill_len)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    run = _compiled_spec_run(
+        target, draft, buf_len, gamma, prefill_len, float(temperature),
+        int(top_k), float(top_p),
+    )
     tokens, rounds, advanced = run(
         params, draft_params, tokens0, tcache, dcache, prompt_lengths,
-        total_len,
+        total_len, rng,
     )
     tokens = tokens[:, :total_len]
     if return_stats:
@@ -162,11 +199,26 @@ def speculative_generate(
 
 
 @functools.lru_cache(maxsize=16)
-def _compiled_spec_run(target, draft, buf_len, gamma, prefill_len):
-    """Jitted speculative loop, cached per (model pair, shapes, gamma)."""
+def _compiled_spec_run(target, draft, buf_len, gamma, prefill_len,
+                       temperature=0.0, top_k=0, top_p=0.0):
+    """Jitted speculative loop, cached per (model pair, shapes, gamma,
+    sampling config)."""
+    from distributed_pytorch_tpu.generation import truncate_logits
+
+    sampled = temperature > 0.0
+
+    def filtered(logits):
+        # The distribution ACTUALLY sampled from, f32 for the acceptance
+        # ratio arithmetic.
+        return jax.nn.softmax(
+            truncate_logits(logits / temperature, top_k, top_p).astype(
+                jnp.float32
+            ),
+            axis=-1,
+        )
 
     def run(params, draft_params, tokens, tcache, dcache, prompt_lengths,
-            total_len):
+            total_len, rng):
         batch = tokens.shape[0]
 
         if prefill_len > 1:
@@ -182,13 +234,23 @@ def _compiled_spec_run(target, draft, buf_len, gamma, prefill_len):
             dcache = up["cache"]
 
         def draft_step(i, carry):
-            tokens, dcache, t = carry
+            tokens, dcache, t, round_key = carry
             current = jax.lax.dynamic_slice(tokens, (0, t + i), (batch, 1))
             logits, up = draft.apply(
                 {"params": draft_params, "cache": dcache}, current,
                 mutable=["cache"],
             )
-            proposal = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            last = logits[:, -1, :]
+            if sampled:
+                # Propose x ~ q (the draft's filtered distribution); the
+                # full q row is recomputed at verify time in one chunked
+                # draft pass instead of being carried through this loop.
+                proposal = jax.random.categorical(
+                    jax.random.fold_in(round_key, i),
+                    truncate_logits(last / temperature, top_k, top_p),
+                ).astype(jnp.int32)
+            else:
+                proposal = jnp.argmax(last, axis=-1).astype(jnp.int32)
             keep_prompt = (t + i + 1) < prompt_lengths
             existing = jax.lax.dynamic_slice(
                 tokens, (0, t + i + 1), (batch, 1)
@@ -197,14 +259,15 @@ def _compiled_spec_run(target, draft, buf_len, gamma, prefill_len):
             tokens = jax.lax.dynamic_update_slice(
                 tokens, nxt[:, None], (0, t + i + 1)
             )
-            return tokens, up["cache"], t
+            return tokens, up["cache"], t, round_key
 
         def body(carry):
             tokens, tcache, dcache, t, rounds, advanced = carry
+            round_key = jax.random.fold_in(rng, t)
             # Round entry invariant: both cache_index == t; tokens[.., :t+1]
-            # are final (target-greedy-consistent).
-            tokens, dcache, _ = jax.lax.fori_loop(
-                0, gamma, draft_step, (tokens, dcache, t)
+            # are final (target-consistent).
+            tokens, dcache, _, _ = jax.lax.fori_loop(
+                0, gamma, draft_step, (tokens, dcache, t, round_key)
             )
             # Target verifies the whole proposal in one chunked forward:
             # positions t .. t+gamma-1 predict t+1 .. t+gamma.
@@ -213,31 +276,71 @@ def _compiled_spec_run(target, draft, buf_len, gamma, prefill_len):
                 {"params": params, "cache": tcache}, chunk, mutable=["cache"]
             )
             tcache = up["cache"]
-            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, gamma]
 
             pos = t + 1 + jnp.arange(gamma)[None, :]  # positions decided
+            in_prompt = pos < prompt_lengths[:, None]
             written = jax.lax.dynamic_slice(
                 tokens, (0, t + 1), (batch, gamma)
             )
-            # Prompt positions are given, not generated: auto-accept.
-            match = (written == g) | (pos < prompt_lengths[:, None])
+            if sampled:
+                # Full q rows in ONE chunked draft replay: rewind the draft
+                # cache to t and re-feed the same chunk (the K/V writes are
+                # recomputed identically, so the cache stays consistent at
+                # t+gamma afterwards).
+                dlogits, up = draft.apply(
+                    {"params": draft_params,
+                     "cache": _set_cache_index(dcache, t)},
+                    chunk, mutable=["cache"],
+                )
+                dcache = up["cache"]
+                pf = filtered(logits)   # [B, gamma, V]
+                qf = filtered(dlogits)  # [B, gamma, V]
+                px = jnp.take_along_axis(pf, written[..., None], axis=-1)[..., 0]
+                qx = jnp.take_along_axis(qf, written[..., None], axis=-1)[..., 0]
+                u = jax.random.uniform(
+                    jax.random.fold_in(round_key, gamma), (batch, gamma)
+                )
+                # u < min(1, px/qx)  <=>  u*qx < px (q(x) > 0 a.s. — x was
+                # sampled from q). Prompt positions are given: auto-accept.
+                match = (u * qx < px) | in_prompt
+            else:
+                g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                match = (written == g) | in_prompt
             n_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [B]
             n = jnp.min(n_row)  # batch-min advance (see module docstring)
 
-            # Correction write: position t+n+1 gets the target's own token.
-            # When n == gamma the clamped write is a no-op by construction
-            # (match[:, gamma-1] held for every row, so written == g there);
-            # rows that accepted beyond n overwrite with the identical value
-            # (their match[:, n] held too).
+            # Correction write at position t+n+1. Greedy: the target's own
+            # token. Sampled: a draw from the residual max(p - q, 0) — the
+            # distribution that makes the emitted token exactly p-law
+            # (falling back to p itself in the measure-zero p == q corner
+            # where the residual has no mass). When n == gamma the clamped
+            # write is a no-op (every row accepted column gamma-1, and
+            # n_row > ni routes those rows to their already-written token);
+            # rows that accepted beyond n keep their accepted token the
+            # same way.
             ni = jnp.minimum(n, gamma - 1)
-            g_n = jax.lax.dynamic_index_in_dim(
-                g, ni, axis=1, keepdims=False
-            )  # [B]: each row's own target token at the correction column
-            keep_prompt = (t + ni + 1) < prompt_lengths
-            existing = jax.lax.dynamic_slice(
-                tokens, (0, t + ni + 1), (batch, 1)
-            )[:, 0]
-            corrected = jnp.where(keep_prompt, existing, g_n)
+            if sampled:
+                pf_n = jax.lax.dynamic_index_in_dim(
+                    pf, ni, axis=1, keepdims=False
+                )  # [B, V]
+                qf_n = jax.lax.dynamic_index_in_dim(
+                    qf, ni, axis=1, keepdims=False
+                )
+                residual = jnp.maximum(pf_n - qf_n, 0.0)
+                has_mass = jnp.sum(residual, axis=-1, keepdims=True) > 0
+                res_dist = jnp.where(has_mass, residual, pf_n)
+                replacement = jax.random.categorical(
+                    jax.random.fold_in(round_key, gamma + 1),
+                    jnp.log(res_dist),
+                ).astype(jnp.int32)
+            else:
+                replacement = jax.lax.dynamic_index_in_dim(
+                    g, ni, axis=1, keepdims=False
+                )  # [B]: each row's own target token at the correction column
+            kept = jax.lax.dynamic_index_in_dim(
+                written, ni, axis=1, keepdims=False
+            )
+            corrected = jnp.where(n_row > ni, kept, replacement)
             tokens = jax.lax.dynamic_update_slice(
                 tokens, corrected[:, None], (0, t + ni + 1)
             )

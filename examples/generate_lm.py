@@ -68,16 +68,12 @@ def main(args):
     )
 
     if args.speculative:
-        # No silent flag drops: speculation is greedy-only and runs the
+        # No silent flag drops: speculation (greedy or sampled — the
+        # temperature/top_k/top_p flags pass through) runs the
         # full-precision single-device path.
         dropped = [
             name
             for name, active in (
-                ("--temperature", args.temperature > 0),
-                ("--top_k", args.top_k > 0),
-                # 0 or >= 1 disables nucleus sampling (its own help text),
-                # so only an ACTIVE top_p conflicts.
-                ("--top_p", 0 < args.top_p < 1),
                 ("--quantize", args.quantize),
                 ("--quantized_cache", args.quantized_cache),
                 ("--fake_devices > 1 (sharded decode)", args.fake_devices > 1),
@@ -86,14 +82,15 @@ def main(args):
         ]
         if dropped:
             raise SystemExit(
-                f"--speculative is greedy-only single-device full-precision "
-                f"decode; incompatible with {', '.join(dropped)}"
+                f"--speculative is single-device full-precision decode; "
+                f"incompatible with {', '.join(dropped)}"
             )
-        # Greedy speculative decode against a width/depth-reduced draft
-        # sharing the vocabulary (randomly initialized here — a real draft
-        # would be trained/distilled; acceptance statistics show the
-        # machinery either way and the OUTPUT is target-greedy-exact by
-        # construction, see speculative.py).
+        # Speculative decode against a width/depth-reduced draft sharing
+        # the vocabulary (randomly initialized here — a real draft would
+        # be trained/distilled; acceptance statistics show the machinery
+        # either way, and the OUTPUT is exactly the target's own decode by
+        # construction: greedy-exact at temperature 0, target-distributed
+        # rejection sampling above it — see speculative.py).
         from distributed_pytorch_tpu.speculative import speculative_generate
 
         draft = model.clone(
@@ -107,6 +104,8 @@ def main(args):
         out, stats = speculative_generate(
             model, params, draft, draft_params, prompt, args.new_tokens,
             gamma=args.gamma, return_stats=True,
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, rng=jax.random.PRNGKey(args.seed),
         )
         out = np.asarray(out)
         rounds = int(stats["rounds"])
@@ -193,9 +192,11 @@ if __name__ == "__main__":
                         help="nucleus sampling: keep the smallest token set "
                         "reaching this cumulative mass (0 or >=1 disables)")
     parser.add_argument("--speculative", action="store_true",
-                        help="greedy speculative decode with a reduced "
-                        "draft model (speculative.py); prints acceptance "
-                        "stats, output stays target-greedy-exact")
+                        help="speculative decode with a reduced draft model "
+                        "(speculative.py): greedy by default, modified "
+                        "rejection sampling with --temperature (exactly "
+                        "target-distributed either way); prints acceptance "
+                        "stats")
     parser.add_argument("--gamma", type=int, default=4,
                         help="speculative proposal chunk length")
     parser.add_argument("--quantize", action="store_true",

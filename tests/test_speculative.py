@@ -1,6 +1,7 @@
-"""Speculative decoding: the acceptance rule must make it EXACTLY the
-target model's greedy decode — speedup may vary with the draft, correctness
-may not."""
+"""Speculative decoding: the acceptance rule must make the output EXACTLY
+the target model's own decode — token-for-token greedy at temperature 0,
+exactly target-distributed rejection sampling above it. Speedup may vary
+with the draft, correctness may not."""
 
 import jax
 import jax.numpy as jnp

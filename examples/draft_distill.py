@@ -97,6 +97,11 @@ def main(args):
     opt = optax.adam(1e-2)
     opt_state = opt.init(draft_params)
     steps_per_epoch = len(inputs) // args.batch_size
+    if steps_per_epoch == 0:
+        raise SystemExit(
+            f"--batch_size {args.batch_size} exceeds --n_train "
+            f"{args.n_train}: distillation would silently no-op"
+        )
     for epoch in range(args.distill_epochs):
         order = np.random.default_rng(epoch).permutation(len(inputs))
         loss = None

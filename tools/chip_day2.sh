@@ -29,5 +29,12 @@ run python bench.py --matrix
 #    wedged mid w=4096 compile; BENCH_WINDOW.json is only written at the end.
 run python bench.py --window_sweep
 
+# 7. (optional) Speculative-decode speedup A/B. NOTE: the on-the-fly draft
+#    distills against a RANDOM-INIT target, whose conditionals a small
+#    draft largely cannot learn - expect low acceptance and an honest
+#    sub-1.0 speedup; the trained-target acceptance story (1.00 -> 4.00)
+#    is examples/draft_distill.py. Uncomment when chip time is plentiful.
+# run python tools/decode_bench.py --speculative
+
 echo "done (failed steps: $FAILED_STEPS) — commit BENCH_MATRIX.json + BENCH_WINDOW.json + BASELINE.md updates" >&2
 exit "$FAILED_STEPS"

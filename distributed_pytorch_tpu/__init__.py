@@ -33,7 +33,11 @@ from distributed_pytorch_tpu.checkpoint import (
     save_checkpoint,
     save_snapshot,
 )
-from distributed_pytorch_tpu.generation import generate, top_p_filter
+from distributed_pytorch_tpu.generation import (
+    beam_search,
+    generate,
+    top_p_filter,
+)
 from distributed_pytorch_tpu.speculative import speculative_generate
 from distributed_pytorch_tpu.parallel.bootstrap import (
     is_main_process,
@@ -65,6 +69,7 @@ __all__ = [
     "ArrayDataset",
     "MaterializedDataset",
     "NativeShardedLoader",
+    "beam_search",
     "generate",
     "speculative_generate",
     "top_p_filter",

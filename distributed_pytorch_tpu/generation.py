@@ -349,3 +349,158 @@ def generate_text_ids(model, params, prompt_ids, max_new_tokens, **kw) -> np.nda
     return np.asarray(
         generate(model, params, jnp.asarray(prompt_ids), max_new_tokens, **kw)
     )
+
+
+def beam_search(
+    model,
+    params,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    *,
+    beam_size: int = 4,
+    length_penalty: float = 0.0,
+):
+    """Fixed-length beam search over the KV-cache decode path: maintain the
+    ``beam_size`` highest-log-probability continuations per batch row, one
+    compiled ``fori_loop`` like :func:`generate`.
+
+    Returns ``(tokens, scores)``: ``tokens`` is ``[B, beam, T0 + new]``
+    sorted best-first, ``scores`` is ``[B, beam]`` — the summed next-token
+    log-probabilities of each continuation, divided by
+    ``(new_tokens) ** length_penalty`` when a penalty is set (0 = raw sum;
+    GNMT-style normalization at 1.0). The best row's raw score EQUALS the
+    full-forward log-prob sum of its tokens (pinned by test — the cache
+    reorder below is the part that could silently break this).
+
+    TPU shape: beams live flattened in the batch dim (``[B*beam, ...]``),
+    so every model call is the same single-token decode the greedy path
+    compiles; the per-step beam reorder is a ``jnp.take`` of every cache
+    leaf along that dim (a gather XLA schedules well, but it does copy the
+    cache each step — O(T^2) bytes over a decode, the classic beam cost).
+    Uniform prompts only (no ``prompt_lengths``): ragged beams inside a
+    prompt would force per-row divergence bookkeeping nobody needs —
+    left-pad ragged batches instead. No EOS handling: this framework's
+    models are tokenizer-free LMs; fixed-horizon search keeps shapes
+    static (and XLA happy).
+    """
+    if beam_size < 1:
+        raise ValueError(f"beam_size must be >= 1, got {beam_size}")
+    decode_model = model.clone(decode=True)
+    batch, prompt_len = prompt.shape
+    total_len = prompt_len + max_new_tokens
+    flat = batch * beam_size
+
+    # Cache sized for [B]: the prefill runs ONCE per batch row and the
+    # leaves are repeated to [B*beam] inside the compiled run — every beam
+    # starts from the identical prompt, so prefilling flat would burn
+    # beam_size x the prefill FLOPs and cache writes on bit-equal rows.
+    abstract = jax.eval_shape(
+        decode_model.init,
+        jax.random.PRNGKey(0),
+        jnp.zeros((batch, total_len), jnp.int32),
+    )["cache"]
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), abstract
+    )
+    tokens0 = jnp.concatenate(
+        [
+            jnp.repeat(jnp.asarray(prompt, jnp.int32), beam_size, axis=0),
+            jnp.full((flat, max_new_tokens), 0, jnp.int32),
+        ],
+        axis=1,
+    )
+    run = _compiled_beam_run(
+        decode_model, total_len, prompt_len, beam_size,
+        float(length_penalty),
+    )
+    return run(params, tokens0, cache)
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_beam_run(decode_model, total_len, prompt_len, beam_size,
+                       length_penalty):
+    """Jitted beam loop, cached per (model config, lengths, beam)."""
+
+    def run(params, tokens, cache):
+        flat = tokens.shape[0]
+        batch = flat // beam_size
+        dtype = getattr(decode_model, "dtype", jnp.bfloat16)
+
+        if prompt_len > 1:
+            # Prefill at [B] (the cache arrives [B]-sized; beams are
+            # identical here), then fan the filled cache out to [B*beam].
+            chunk = tokens[::beam_size, : prompt_len - 1]
+            _, up = decode_model.apply(
+                {"params": dequantize_pytree(params, dtype), "cache": cache},
+                chunk,
+                mutable=["cache"],
+            )
+            cache = up["cache"]
+        cache = jax.tree_util.tree_map(
+            lambda leaf: jnp.repeat(leaf, beam_size, axis=0)
+            if leaf.ndim > 0 and leaf.shape[0] == batch
+            else leaf,
+            cache,
+        )
+
+        # Only beam 0 is live at the start — every beam holds the same
+        # prompt, and without this mask the first top-k would pick the
+        # same token beam_size times.
+        scores = jnp.tile(
+            jnp.where(jnp.arange(beam_size) == 0, 0.0, -jnp.inf)[None, :],
+            (batch, 1),
+        )  # [B, beam]
+
+        def body(t, carry):
+            tokens, cache, scores = carry
+            current = jax.lax.dynamic_slice(tokens, (0, t), (flat, 1))
+            logits, up = decode_model.apply(
+                {"params": dequantize_pytree(params, dtype), "cache": cache},
+                current,
+                mutable=["cache"],
+            )
+            cache = up["cache"]
+            logp = jax.nn.log_softmax(
+                logits[:, -1, :].astype(jnp.float32), axis=-1
+            )  # [B*beam, V]
+            v = logp.shape[-1]
+            cand = scores[..., None] + logp.reshape(batch, beam_size, v)
+            top, idx = jax.lax.top_k(
+                cand.reshape(batch, beam_size * v), beam_size
+            )  # [B, beam]
+            parent = idx // v  # which beam each winner extends
+            token = (idx % v).astype(jnp.int32)
+            # Reorder beams: winner k of row b continues beam parent[b, k]
+            # — gather tokens and every cache leaf along the flattened dim.
+            flat_src = (
+                jnp.arange(batch)[:, None] * beam_size + parent
+            ).reshape(-1)  # [B*beam]
+            tokens = jnp.take(tokens, flat_src, axis=0)
+            cache = jax.tree_util.tree_map(
+                lambda leaf: jnp.take(leaf, flat_src, axis=0)
+                if leaf.ndim > 0 and leaf.shape[0] == flat
+                else leaf,
+                cache,
+            )
+            tokens = jax.lax.dynamic_update_slice(
+                tokens, token.reshape(-1)[:, None], (0, t + 1)
+            )
+            return tokens, cache, top
+
+        tokens, _, scores = jax.lax.fori_loop(
+            prompt_len - 1, total_len - 1, body, (tokens, cache, scores)
+        )
+        if length_penalty and total_len > prompt_len:
+            # (max_new_tokens == 0 would divide by 0.0 ** penalty == 0.)
+            scores = scores / (
+                float(total_len - prompt_len) ** length_penalty
+            )
+        # Sort best-first (top_k returns sorted, but the last reorder
+        # interleaves; make the contract explicit).
+        order = jnp.argsort(-scores, axis=-1)
+        tokens = tokens.reshape(batch, beam_size, -1)
+        tokens = jnp.take_along_axis(tokens, order[..., None], axis=1)
+        scores = jnp.take_along_axis(scores, order, axis=1)
+        return tokens, scores
+
+    return jax.jit(run)

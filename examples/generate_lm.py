@@ -123,6 +123,46 @@ def main(args):
         )
         return
 
+    if args.beam:
+        from distributed_pytorch_tpu.generation import beam_search
+
+        # Same no-silent-flag-drops contract as --speculative above.
+        blocked = [
+            name
+            for name, active in (
+                ("sampling flags (deterministic search)",
+                 args.temperature > 0 or args.top_k > 0
+                 or 0 < args.top_p < 1),
+                ("--quantize", args.quantize),
+                ("--quantized_cache", args.quantized_cache),
+                ("--fake_devices > 1 (sharded decode)",
+                 args.fake_devices > 1),
+            )
+            if active
+        ]
+        if blocked:
+            raise SystemExit(
+                f"--beam is single-device full-precision deterministic "
+                f"search; incompatible with {', '.join(blocked)}"
+            )
+        out, scores = beam_search(
+            model, params, prompt, args.new_tokens, beam_size=args.beam,
+            length_penalty=args.length_penalty,
+        )
+        out, scores = np.asarray(out), np.asarray(scores)
+        for row in range(min(args.batch, 2)):
+            for k in range(min(args.beam, 3)):
+                ids = out[row, k]
+                print(
+                    f"[row {row} beam {k}] score={scores[row, k]:.3f} "
+                    f"-> {ids[args.prompt_len:].tolist()}"
+                )
+        print(
+            f"beam search: {args.batch}x{args.beam} beams x "
+            f"{args.new_tokens} tokens"
+        )
+        return
+
     mesh = None
     if jax.device_count() > 1 and args.batch % jax.device_count() == 0:
         from distributed_pytorch_tpu.parallel.mesh import make_mesh
@@ -199,6 +239,10 @@ if __name__ == "__main__":
                         "stats")
     parser.add_argument("--gamma", type=int, default=4,
                         help="speculative proposal chunk length")
+    parser.add_argument("--beam", type=int, default=0,
+                        help="beam_search with this many beams (prints "
+                        "top sequences + true log-prob scores)")
+    parser.add_argument("--length_penalty", type=float, default=0.0)
     parser.add_argument("--quantize", action="store_true",
                         help="weight-only int8 decode")
     parser.add_argument("--quantized_cache", action="store_true",

@@ -74,6 +74,7 @@ def main(args):
         dropped = [
             name
             for name, active in (
+                ("--beam", args.beam > 0),
                 ("--quantize", args.quantize),
                 ("--quantized_cache", args.quantized_cache),
                 ("--fake_devices > 1 (sharded decode)", args.fake_devices > 1),

@@ -616,6 +616,12 @@ def main():
         # import is authoritative.
         jax.config.update("jax_platforms", "cpu")
 
+    if args.scaling and args.window_sweep:
+        # Both are exclusive whole-run modes; silently preferring one would
+        # burn a chip window on the wrong measurement (the queue scripts
+        # run these as separate precious steps).
+        parser.error("--scaling and --window_sweep are exclusive modes; "
+                     "run them as separate invocations")
     scaling_metric = "dp_weak_scaling_efficiency"
     if args.scaling:
         metric, unit = scaling_metric, "ratio_vs_1dev"

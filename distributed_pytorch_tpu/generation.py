@@ -85,16 +85,11 @@ def top_p_filter(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
     The token that crosses the threshold is INCLUDED (the kept mass is
     always >= top_p), and at least one token always survives — the
     standard Holtzman et al. convention. Ties at the boundary logit are all
-    kept (negligible extra mass, no data-dependent shapes — XLA-friendly:
-    one sort + cumsum, no gather loops)."""
-    sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
-    # Keep while the mass BEFORE this token is < top_p; the first token has
-    # zero mass before it, so >= 1 token survives for any top_p.
-    keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
-    n_keep = jnp.sum(keep, axis=-1, keepdims=True)  # [..., 1], >= 1
-    threshold = jnp.take_along_axis(sorted_logits, n_keep - 1, axis=-1)
-    return jnp.where(logits < threshold, -jnp.inf, logits)
+    kept. Delegates to :func:`truncate_logits` (top_k disabled) so the
+    sort/cumsum/threshold convention has exactly ONE implementation —
+    ``tests/test_generation.py`` pins the equivalence the public name
+    promises."""
+    return truncate_logits(logits, 0, top_p)
 
 
 def bucketed_prefill_len(prompt_lengths) -> int:

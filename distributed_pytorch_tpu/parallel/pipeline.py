@@ -236,9 +236,23 @@ def _1f1b_shard_body(
     zero_params = jax.tree_util.tree_map(jnp.zeros_like, params)
     zero_last = jax.tree_util.tree_map(jnp.zeros_like, last_params)
 
+    # Dtype discipline for the lax.cond branches: a stage_fn/last_fn that
+    # PROMOTES (bf16 activations over f32 params -> f32 output, or a
+    # reduced-precision loss) must not desynchronize the branch
+    # signatures. Every activation-side value — streamed y, residuals,
+    # activation-gradients, their zero stubs — is cast to the promoted
+    # ``act_dtype`` (an exact upcast where it applies), and the loss is
+    # accumulated in f32 regardless of last_fn's output dtype.
+    y_aval = jax.eval_shape(
+        lambda p, xx: stage_fn(p, xx),
+        params,
+        jax.ShapeDtypeStruct(mb_shape, x.dtype),
+    )
+    act_dtype = jnp.result_type(x.dtype, y_aval.dtype)
+
     def composite(p, lp, xx, tt):
-        y = stage_fn(p, xx)
-        return last_fn(lp, y, tt), y
+        y = stage_fn(p, xx).astype(act_dtype)
+        return last_fn(lp, y, tt).astype(jnp.float32), y
 
     def f_last(xx, tt):
         # Last stage: forward + loss head + FULL backward in one slot (its
@@ -251,31 +265,32 @@ def _1f1b_shard_body(
 
     def f_plain(xx, tt):
         return (
-            stage_fn(params, xx),
+            stage_fn(params, xx).astype(act_dtype),
             jnp.zeros((), jnp.float32),
             zero_params,
             zero_last,
-            jnp.zeros(mb_shape, x.dtype),
+            jnp.zeros(mb_shape, act_dtype),
         )
 
     def f_skip(xx, tt):
         return (
-            jnp.zeros(mb_shape, x.dtype),
+            jnp.zeros(mb_shape, act_dtype),
             jnp.zeros((), jnp.float32),
             zero_params,
             zero_last,
-            jnp.zeros(mb_shape, x.dtype),
+            jnp.zeros(mb_shape, act_dtype),
         )
 
     def b_recompute(xx, g):
         # Non-last backward: recompute the stage forward from the stored
         # input, pull the received activation-gradient through it.
-        _, vjp_fn = jax.vjp(stage_fn, params, xx)
+        _, vjp_fn = jax.vjp(lambda p, v: stage_fn(p, v).astype(act_dtype),
+                            params, xx)
         dp, dx = vjp_fn(g)
         return dp, dx
 
     def b_skip(xx, g):
-        return zero_params, jnp.zeros(mb_shape, x.dtype)
+        return zero_params, jnp.zeros(mb_shape, act_dtype)
 
     def body(carry, t):
         (recv_f, recv_b, resid, gp, glp, dx_bank, loss_acc) = carry
@@ -346,12 +361,12 @@ def _1f1b_shard_body(
         return (recv_f, recv_b, resid, gp, glp, dx_bank, loss_acc), None
 
     carry0 = (
-        jnp.zeros(mb_shape, x.dtype),
-        jnp.zeros(mb_shape, x.dtype),
-        jnp.zeros((k_slots,) + mb_shape, x.dtype),
+        jnp.zeros(mb_shape, act_dtype),
+        jnp.zeros(mb_shape, act_dtype),
+        jnp.zeros((k_slots,) + mb_shape, act_dtype),
         zero_params,
         zero_last,
-        jnp.zeros(((m,) + mb_shape) if with_dx else (0,), x.dtype),
+        jnp.zeros(((m,) + mb_shape) if with_dx else (0,), act_dtype),
         jnp.zeros((), jnp.float32),
     )
     (_, _, _, gp, glp, dx_bank, loss_acc), _ = jax.lax.scan(
@@ -392,7 +407,10 @@ def _1f1b_shard_body(
     dx_bank = jax.lax.psum(
         jnp.where(stage_idx == 0, dx_bank, jnp.zeros_like(dx_bank)), axis
     )
-    return loss, gp, glp, dx_bank.reshape(x.shape)
+    # The caller's cotangent convention: dx matches x's dtype (the bank
+    # accumulated at the promoted act_dtype; this downcast is the only
+    # place precision is intentionally dropped, mirroring jax.grad).
+    return loss, gp, glp, dx_bank.reshape(x.shape).astype(x.dtype)
 
 
 def pipeline_1f1b_grads(

@@ -111,14 +111,16 @@ def speculative_generate(
     sampling (``rng`` seeds the draws).
 
     ``return_stats=True`` additionally returns ``{"rounds": R,
-    "positions_advanced": A}``, counting only GENERATED positions (rounds
-    that merely replay bucketed-down prompt tails are excluded — their
-    auto-accepted prompt positions would overstate draft quality): A/R in
-    [1, gamma] is the mean accepted chunk length (draft quality x
-    batch-min effect). R is a LOWER bound on the target's chunked
-    forwards (replay-only rounds run one too but count toward neither);
-    with power-of-two prompt lengths the two coincide, and either way
-    the target ran far fewer forwards than A serial single-token steps.
+    "positions_advanced": A}``, counting only GENERATED positions — per
+    row (position p counts for row b iff ``p >= prompt_lengths[b]``),
+    averaged over the batch, so ragged batches report the true mean;
+    rounds that merely replay bucketed-down prompt tails count toward
+    neither (their auto-accepted prompt positions would overstate draft
+    quality). A/R in [1, gamma] is the mean accepted chunk length (draft
+    quality x batch-min effect). R is a LOWER bound on the target's
+    chunked forwards (replay-only rounds run one too); with uniform
+    power-of-two prompt lengths the two coincide, and either way the
+    target ran far fewer forwards than A serial single-token steps.
 
     ``temperature > 0`` switches to SAMPLED speculative decoding
     (Leviathan et al. modified rejection sampling): the draft SAMPLES each
@@ -350,13 +352,16 @@ def _compiled_spec_run(target, draft, buf_len, gamma, prefill_len,
             dcache = _set_cache_index(dcache, t_new)
             # Stats count only GENERATED positions: rounds replaying
             # bucketed-down prompt tails auto-accept via the prompt term in
-            # `match`, and crediting those would overstate draft quality
-            # (position p is generated iff p >= its row's prompt length;
-            # p > max_prompt - 1 covers every row).
-            max_prompt = jnp.max(prompt_lengths)
-            gen_adv = jnp.maximum(
-                t_new - jnp.maximum(t, max_prompt - 1), 0
-            )
+            # `match`, and crediting those would overstate draft quality.
+            # Counted PER ROW (position p is generated for row b iff
+            # p >= prompt_lengths[b]) and averaged over the batch, so a
+            # ragged batch — where some rows are already generating while
+            # others still replay their prompt — reports the true mean
+            # accepted chunk instead of the batch-max approximation.
+            per_row = jnp.clip(
+                t_new - jnp.maximum(t, prompt_lengths - 1), 0, t_new - t
+            ).astype(jnp.float32)
+            gen_adv = jnp.mean(per_row)
             return (tokens, tcache, dcache, t_new,
                     rounds + (gen_adv > 0).astype(jnp.int32),
                     advanced + gen_adv)
@@ -368,7 +373,7 @@ def _compiled_spec_run(target, draft, buf_len, gamma, prefill_len,
         tokens, _, _, _, rounds, advanced = jax.lax.while_loop(
             cond, body,
             (tokens, tcache, dcache, t0, jnp.zeros((), jnp.int32),
-             jnp.zeros((), jnp.int32)),
+             jnp.zeros((), jnp.float32)),
         )
         return tokens, rounds, advanced
 

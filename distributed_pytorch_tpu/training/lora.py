@@ -162,22 +162,23 @@ class LoraModel:
             base, vs.pop("params"), rank=self.rank, alpha=self.alpha,
             rules=self.rules,
         )
+        # mutable=False is flax's only "return bare output" form; every
+        # other value — list (INCLUDING []), tuple, True, str — makes
+        # flax return (out, state), and the facade must re-attach
+        # lora_base to that state so the standard round-trip that rebuilds
+        # variables from the returned collections re-applies cleanly.
         inner_mutable = mutable
         if isinstance(mutable, (list, tuple)):
             inner_mutable = [m for m in mutable if m != "lora_base"]
         elif isinstance(mutable, str):
-            inner_mutable = False if mutable == "lora_base" else mutable
+            inner_mutable = [] if mutable == "lora_base" else mutable
         out = self.model.apply(
             {"params": merged, **vs}, *args, mutable=inner_mutable, **kw
         )
-        if mutable:  # every truthy form returns (out, state) — keep the
-            # facade closed: lora_base always rides back so the standard
-            # flax round-trip {**vars, **new_state} re-applies cleanly.
-            if inner_mutable is False:  # mutable == "lora_base" edge
-                return out, {"lora_base": base}
-            preds, new_state = out
-            return preds, {**dict(new_state), "lora_base": base}
-        return out
+        if mutable is False:
+            return out
+        preds, new_state = out
+        return preds, {**dict(new_state), "lora_base": base}
 
     def merged_params(self, state_or_variables):
         """Merged full-model params from a ``TrainState`` (adapters in

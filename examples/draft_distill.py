@@ -77,25 +77,14 @@ def main(args):
     before = acceptance(draft_params)
 
     # 2) Distill: forward KL(target || draft) on the training sequences,
-    # teacher logits computed on the fly (no logit dataset to manage).
+    # teacher logits computed on the fly (training/distill.py — the same
+    # step tools/decode_bench.py --speculative uses).
+    from distributed_pytorch_tpu.training.distill import make_distill_step
+
     inputs = jnp.asarray(data[:, :-1])
-
-    @jax.jit
-    def distill_step(dp, opt_state, batch):
-        t_logits = target.apply({"params": target_params}, batch)
-        t_probs = jax.nn.softmax(t_logits, axis=-1)
-
-        def kl(dp):
-            d_logits = draft.apply({"params": dp}, batch)
-            d_logp = jax.nn.log_softmax(d_logits, axis=-1)
-            return -jnp.mean(jnp.sum(t_probs * d_logp, axis=-1))
-
-        loss, grads = jax.value_and_grad(kl)(dp)
-        updates, opt_state = opt.update(grads, opt_state, dp)
-        return optax.apply_updates(dp, updates), opt_state, loss
-
     opt = optax.adam(1e-2)
     opt_state = opt.init(draft_params)
+    distill_step = make_distill_step(target, draft, opt)
     steps_per_epoch = len(inputs) // args.batch_size
     if steps_per_epoch == 0:
         raise SystemExit(
@@ -108,7 +97,7 @@ def main(args):
         for i in range(steps_per_epoch):
             idx = order[i * args.batch_size : (i + 1) * args.batch_size]
             draft_params, opt_state, loss = distill_step(
-                draft_params, opt_state, inputs[idx]
+                draft_params, opt_state, inputs[idx], target_params
             )
         print(f"distill epoch {epoch}: kl={float(loss):.4f}", flush=True)
 

@@ -75,6 +75,7 @@ def main(args):
             name
             for name, active in (
                 ("--beam", args.beam > 0),
+                ("--length_penalty", args.length_penalty != 0),
                 ("--quantize", args.quantize),
                 ("--quantized_cache", args.quantized_cache),
                 ("--fake_devices > 1 (sharded decode)", args.fake_devices > 1),
@@ -102,9 +103,10 @@ def main(args):
         draft_params = draft.init(
             jax.random.PRNGKey(args.seed + 1), jnp.zeros((1, 8), jnp.int32)
         )["params"]
+        gamma = 4 if args.gamma is None else args.gamma
         out, stats = speculative_generate(
             model, params, draft, draft_params, prompt, args.new_tokens,
-            gamma=args.gamma, return_stats=True,
+            gamma=gamma, return_stats=True,
             temperature=args.temperature, top_k=args.top_k,
             top_p=args.top_p, rng=jax.random.PRNGKey(args.seed),
         )
@@ -120,7 +122,7 @@ def main(args):
         print(
             f"speculative: {rounds} target chunk-forwards for {adv} "
             f"positions (mean accepted chunk {adv / max(rounds, 1):.2f} "
-            f"of gamma={args.gamma})"
+            f"of gamma={gamma})"
         )
         return
 
@@ -134,6 +136,7 @@ def main(args):
                 ("sampling flags (deterministic search)",
                  args.temperature > 0 or args.top_k > 0
                  or 0 < args.top_p < 1),
+                ("--gamma (speculative-only)", args.gamma is not None),
                 ("--quantize", args.quantize),
                 ("--quantized_cache", args.quantized_cache),
                 ("--fake_devices > 1 (sharded decode)",
@@ -238,8 +241,8 @@ if __name__ == "__main__":
                         "rejection sampling with --temperature (exactly "
                         "target-distributed either way); prints acceptance "
                         "stats")
-    parser.add_argument("--gamma", type=int, default=4,
-                        help="speculative proposal chunk length")
+    parser.add_argument("--gamma", type=int, default=None,
+                        help="speculative proposal chunk length (default 4)")
     parser.add_argument("--beam", type=int, default=0,
                         help="beam_search with this many beams (prints "
                         "top sequences + true log-prob scores)")

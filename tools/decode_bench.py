@@ -204,6 +204,7 @@ def spec_bench(args, model):
     from distributed_pytorch_tpu.generation import generate
     from distributed_pytorch_tpu.models.transformer import TransformerLM
     from distributed_pytorch_tpu.speculative import speculative_generate
+    from distributed_pytorch_tpu.training.distill import make_distill_step
 
     d_model_d = max(args.d_model // 4, 64)
     n_layers_d = max(args.n_layers // 4, 1)
@@ -240,22 +241,7 @@ def spec_bench(args, model):
 
     opt = optax.adam(1e-3)
     opt_state = opt.init(draft_params)
-
-    @jax.jit
-    def distill_step(dp, opt_state, batch):
-        t_probs = jax.nn.softmax(
-            model.apply({"params": params}, batch).astype(jnp.float32), -1
-        )
-
-        def kl(dp):
-            d_logp = jax.nn.log_softmax(
-                draft.apply({"params": dp}, batch).astype(jnp.float32), -1
-            )
-            return -jnp.mean(jnp.sum(t_probs * d_logp, axis=-1))
-
-        loss, grads = jax.value_and_grad(kl)(dp)
-        updates, opt_state = opt.update(grads, opt_state, dp)
-        return optax.apply_updates(dp, updates), opt_state, loss
+    distill_step = make_distill_step(model, draft, opt)
 
     rng = np.random.default_rng(0)
     kl = float("nan")
@@ -264,7 +250,7 @@ def spec_bench(args, model):
             rng.integers(0, args.vocab, (8, 32)), jnp.int32
         )
         draft_params, opt_state, loss = distill_step(
-            draft_params, opt_state, batch
+            draft_params, opt_state, batch, params
         )
         kl = float(loss)
 

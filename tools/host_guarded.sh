@@ -16,6 +16,17 @@ echo "[guard] guarded pid=$PID: $*" >&2
 # forever, orphaning the child in state T. Trap signals too, not just EXIT
 # (bash delivers the trap only after the current sleep finishes, <=20s),
 # and exit explicitly from the signal path or bash resumes the loop.
+ppid_of() {
+  # /proc/<pid>/stat embeds comm in parens and comm may contain spaces
+  # ("tmux: server"), so positional awk on the raw line is wrong; strip
+  # through the LAST ')' first — field 2 of the remainder is ppid.
+  local rest
+  rest=$(sed 's/^.*) //' "/proc/$1/stat" 2>/dev/null)
+  [ -n "$rest" ] || return 1
+  set -- $rest
+  echo "$2"
+}
+
 cleanup() { kill -CONT "$PID" 2>/dev/null; kill "$PID" 2>/dev/null; }
 trap cleanup EXIT
 trap 'cleanup; trap - EXIT; exit 143' INT TERM
@@ -35,7 +46,7 @@ while kill -0 "$PID" 2>/dev/null; do
   excl="$PID $$"
   anc=$$
   while [ "$anc" -gt 1 ] 2>/dev/null; do
-    anc=$(awk '{print $4}' "/proc/$anc/stat" 2>/dev/null) || break
+    anc=$(ppid_of "$anc") || break
     excl="$excl $anc"
   done
   # ...and our DESCENDANTS: $(...) substitutions fork subshells carrying
@@ -45,7 +56,7 @@ while kill -0 "$PID" 2>/dev/null; do
     local p=$1
     case " $excl " in *" $p "*) return 0 ;; esac
     while [ "$p" -gt 1 ] 2>/dev/null; do
-      p=$(awk '{print $4}' "/proc/$p/stat" 2>/dev/null) || return 1
+      p=$(ppid_of "$p") || return 1
       [ "$p" = "$$" ] && return 0
     done
     return 1

@@ -92,6 +92,22 @@ def top_p_filter(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
     return truncate_logits(logits, 0, top_p)
 
 
+def batch_sharding_placer(mesh: Mesh, data_axis: str, batch: int):
+    """``(place, batch_sh, replicated)`` — THE decode placement rule,
+    shared by :func:`generate` and ``speculative.speculative_generate`` so
+    the heuristic lives once: abstract arrays leading with the batch dim
+    (tokens, KV caches and their scales) shard ``P(data_axis)``; scalars
+    (``cache_index``) and anything else replicate."""
+    batch_sh = NamedSharding(mesh, P(data_axis))
+    replicated = NamedSharding(mesh, P())
+
+    def place(s):
+        sh = batch_sh if s.ndim > 0 and s.shape[0] == batch else replicated
+        return jnp.zeros(s.shape, s.dtype, device=sh)
+
+    return place, batch_sh, replicated
+
+
 def bucketed_prefill_len(prompt_lengths) -> int:
     """Static prefill length, computed HOST-SIDE before any device placement
     (a batch-sharded array could span non-addressable devices). Clamped to
@@ -237,15 +253,9 @@ def generate(
     prefill_len = bucketed_prefill_len(prompt_lengths)
 
     if mesh is not None:
-        batch_sh = NamedSharding(mesh, P(data_axis))
-        replicated = NamedSharding(mesh, P())
-
-        def place(s):
-            # Cache arrays lead with the batch dim; scalars (cache_index)
-            # replicate.
-            sh = batch_sh if s.ndim > 0 and s.shape[0] == batch else replicated
-            return jnp.zeros(s.shape, s.dtype, device=sh)
-
+        place, batch_sh, replicated = batch_sharding_placer(
+            mesh, data_axis, batch
+        )
         cache = jax.tree_util.tree_map(place, abstract)
         tokens0 = jax.device_put(tokens0, batch_sh)
         prompt_lengths = jax.device_put(prompt_lengths, batch_sh)

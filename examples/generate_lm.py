@@ -69,8 +69,9 @@ def main(args):
 
     if args.speculative:
         # No silent flag drops: speculation (greedy or sampled — the
-        # temperature/top_k/top_p flags pass through) runs the
-        # full-precision single-device path.
+        # temperature/top_k/top_p flags pass through) runs full-precision,
+        # single-device or data-mesh-sharded (multi-device batches shard
+        # below like plain decode).
         dropped = [
             name
             for name, active in (
@@ -78,13 +79,12 @@ def main(args):
                 ("--length_penalty", args.length_penalty != 0),
                 ("--quantize", args.quantize),
                 ("--quantized_cache", args.quantized_cache),
-                ("--fake_devices > 1 (sharded decode)", args.fake_devices > 1),
             )
             if active
         ]
         if dropped:
             raise SystemExit(
-                f"--speculative is single-device full-precision decode; "
+                f"--speculative is full-precision decode; "
                 f"incompatible with {', '.join(dropped)}"
             )
         # Speculative decode against a width/depth-reduced draft sharing
@@ -103,12 +103,18 @@ def main(args):
         draft_params = draft.init(
             jax.random.PRNGKey(args.seed + 1), jnp.zeros((1, 8), jnp.int32)
         )["params"]
+        spec_mesh = None
+        if jax.device_count() > 1 and args.batch % jax.device_count() == 0:
+            from distributed_pytorch_tpu.parallel.mesh import make_mesh
+
+            spec_mesh = make_mesh()
         gamma = 4 if args.gamma is None else args.gamma
         out, stats = speculative_generate(
             model, params, draft, draft_params, prompt, args.new_tokens,
             gamma=gamma, return_stats=True,
             temperature=args.temperature, top_k=args.top_k,
             top_p=args.top_p, rng=jax.random.PRNGKey(args.seed),
+            mesh=spec_mesh,
         )
         out = np.asarray(out)
         rounds = int(stats["rounds"])

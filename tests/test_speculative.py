@@ -212,6 +212,34 @@ class TestSampledSpeculative:
             np.testing.assert_array_equal(out[row, :L], tokens[row, :L])
 
 
+class TestMeshSharded:
+    def test_dp_mesh_output_matches_single_device(self):
+        """Batch-sharded speculative decode (tokens + both caches
+        P('data'), params replicated) must be token-for-token identical
+        to the unsharded run — greedy and sampled."""
+        from distributed_pytorch_tpu.parallel.mesh import make_mesh
+
+        model = lm()
+        params, tokens = init(model, batch=8)
+        draft = lm(d_model=8, n_layers=1, n_heads=1, d_ff=16)
+        draft_params, _ = init(draft, key=7)
+        mesh = make_mesh()
+        for kw in (
+            dict(gamma=3),
+            dict(gamma=3, temperature=0.8, rng=jax.random.PRNGKey(4)),
+        ):
+            ref, ref_stats = speculative_generate(
+                model, params, draft, draft_params, jnp.asarray(tokens), 9,
+                return_stats=True, **kw,
+            )
+            out, stats = speculative_generate(
+                model, params, draft, draft_params, jnp.asarray(tokens), 9,
+                mesh=mesh, return_stats=True, **kw,
+            )
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+            assert int(stats["rounds"]) == int(ref_stats["rounds"])
+
+
 class TestValidation:
     def test_vocab_mismatch_rejected(self):
         model = lm()

@@ -94,10 +94,11 @@ def top_p_filter(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
 
 def batch_sharding_placer(mesh: Mesh, data_axis: str, batch: int):
     """``(place, batch_sh, replicated)`` — THE decode placement rule,
-    shared by :func:`generate` and ``speculative.speculative_generate`` so
-    the heuristic lives once: abstract arrays leading with the batch dim
-    (tokens, KV caches and their scales) shard ``P(data_axis)``; scalars
-    (``cache_index``) and anything else replicate."""
+    shared by :func:`generate`, :func:`beam_search`, and
+    ``speculative.speculative_generate`` so the heuristic lives once:
+    abstract arrays leading with the batch dim (tokens, KV caches and
+    their scales) shard ``P(data_axis)``; scalars (``cache_index``) and
+    anything else replicate."""
     batch_sh = NamedSharding(mesh, P(data_axis))
     replicated = NamedSharding(mesh, P())
 
@@ -364,6 +365,8 @@ def beam_search(
     *,
     beam_size: int = 4,
     length_penalty: float = 0.0,
+    mesh: Optional[Mesh] = None,
+    data_axis: str = "data",
 ):
     """Fixed-length beam search over the KV-cache decode path: maintain the
     ``beam_size`` highest-log-probability continuations per batch row, one
@@ -387,6 +390,12 @@ def beam_search(
     left-pad ragged batches instead. No EOS handling: this framework's
     models are tokenizer-free LMs; fixed-horizon search keeps shapes
     static (and XLA happy).
+
+    With ``mesh``, the flattened ``[B*beam]`` dim shards ``P(data_axis)``
+    (cache + tokens; params replicated). The per-step reorder gather's
+    indices never cross a batch row's beam block, so when ``beam_size``
+    beams land on one shard the gather stays device-local; either way the
+    output is token-identical to the single-device run (pinned by test).
     """
     if beam_size < 1:
         raise ValueError(f"beam_size must be >= 1, got {beam_size}")
@@ -404,9 +413,6 @@ def beam_search(
         jax.random.PRNGKey(0),
         jnp.zeros((batch, total_len), jnp.int32),
     )["cache"]
-    cache = jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), abstract
-    )
     tokens0 = jnp.concatenate(
         [
             jnp.repeat(jnp.asarray(prompt, jnp.int32), beam_size, axis=0),
@@ -414,6 +420,19 @@ def beam_search(
         ],
         axis=1,
     )
+    if mesh is not None:
+        # The prefill cache is [B]-sized (batch dim), the token buffer
+        # [B*beam]; both lead with the dim that shards.
+        place_b, batch_sh, replicated = batch_sharding_placer(
+            mesh, data_axis, batch
+        )
+        cache = jax.tree_util.tree_map(place_b, abstract)
+        tokens0 = jax.device_put(tokens0, batch_sh)
+        params = jax.device_put(params, replicated)
+    else:
+        cache = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), abstract
+        )
     run = _compiled_beam_run(
         decode_model, total_len, prompt_len, beam_size,
         float(length_penalty),

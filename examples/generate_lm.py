@@ -30,6 +30,25 @@ import argparse
 import numpy as np
 
 
+def maybe_data_mesh(args, jax):
+    """The shared mesh-gating rule for every decode branch: shard when
+    multi-device AND the batch divides; otherwise say so out loud (a
+    silent single-device fallback would contradict the --fake_devices
+    help's sharding promise)."""
+    if jax.device_count() <= 1:
+        return None
+    if args.batch % jax.device_count() != 0:
+        print(
+            f"[generate_lm] batch {args.batch} does not divide over "
+            f"{jax.device_count()} devices - decoding SINGLE-device",
+            flush=True,
+        )
+        return None
+    from distributed_pytorch_tpu.parallel.mesh import make_mesh
+
+    return make_mesh()
+
+
 def main(args):
     import jax
     import jax.numpy as jnp
@@ -103,11 +122,7 @@ def main(args):
         draft_params = draft.init(
             jax.random.PRNGKey(args.seed + 1), jnp.zeros((1, 8), jnp.int32)
         )["params"]
-        spec_mesh = None
-        if jax.device_count() > 1 and args.batch % jax.device_count() == 0:
-            from distributed_pytorch_tpu.parallel.mesh import make_mesh
-
-            spec_mesh = make_mesh()
+        spec_mesh = maybe_data_mesh(args, jax)
         gamma = 4 if args.gamma is None else args.gamma
         out, stats = speculative_generate(
             model, params, draft, draft_params, prompt, args.new_tokens,
@@ -145,19 +160,17 @@ def main(args):
                 ("--gamma (speculative-only)", args.gamma is not None),
                 ("--quantize", args.quantize),
                 ("--quantized_cache", args.quantized_cache),
-                ("--fake_devices > 1 (sharded decode)",
-                 args.fake_devices > 1),
             )
             if active
         ]
         if blocked:
             raise SystemExit(
-                f"--beam is single-device full-precision deterministic "
-                f"search; incompatible with {', '.join(blocked)}"
+                f"--beam is full-precision deterministic search; incompatible with {', '.join(blocked)}"
             )
+        beam_mesh = maybe_data_mesh(args, jax)
         out, scores = beam_search(
             model, params, prompt, args.new_tokens, beam_size=args.beam,
-            length_penalty=args.length_penalty,
+            length_penalty=args.length_penalty, mesh=beam_mesh,
         )
         out, scores = np.asarray(out), np.asarray(scores)
         for row in range(min(args.batch, 2)):
@@ -173,11 +186,7 @@ def main(args):
         )
         return
 
-    mesh = None
-    if jax.device_count() > 1 and args.batch % jax.device_count() == 0:
-        from distributed_pytorch_tpu.parallel.mesh import make_mesh
-
-        mesh = make_mesh()
+    mesh = maybe_data_mesh(args, jax)
     out = generate(
         model,
         params,

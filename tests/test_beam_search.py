@@ -124,6 +124,25 @@ class TestBeamSearch:
             np.asarray(norm), np.asarray(raw) / 5.0, rtol=1e-6
         )
 
+    def test_dp_mesh_output_matches_single_device(self):
+        """Beam search batch-sharded over a data mesh ([B*beam] dim
+        P('data')) must reproduce the single-device tokens and scores."""
+        from distributed_pytorch_tpu.parallel.mesh import make_mesh
+
+        model = lm()
+        params, tokens = init(model, batch=8)
+        ref_t, ref_s = beam_search(
+            model, params, jnp.asarray(tokens), 5, beam_size=4
+        )
+        mesh = make_mesh()
+        out_t, out_s = beam_search(
+            model, params, jnp.asarray(tokens), 5, beam_size=4, mesh=mesh
+        )
+        np.testing.assert_array_equal(np.asarray(out_t), np.asarray(ref_t))
+        np.testing.assert_allclose(
+            np.asarray(out_s), np.asarray(ref_s), rtol=1e-6
+        )
+
     def test_beam_size_validated(self):
         model = lm()
         params, tokens = init(model)

@@ -143,6 +143,23 @@ class TestBeamSearch:
             np.asarray(out_s), np.asarray(ref_s), rtol=1e-6
         )
 
+    def test_composes_with_gqa_and_window(self):
+        """The beam reorder gathers EVERY batch-leading cache leaf — GQA's
+        reduced-head caches and windowed decode must compose unchanged
+        (beam-1 == greedy is the exactness probe)."""
+        model = lm(n_kv_heads=1, attention_window=3)
+        params, tokens = init(model, batch=2, seq=5)
+        ref = np.asarray(generate(model, params, jnp.asarray(tokens), 6))
+        out, scores = beam_search(
+            model, params, jnp.asarray(tokens), 6, beam_size=1
+        )
+        np.testing.assert_array_equal(np.asarray(out)[:, 0], ref)
+        wide, _ = beam_search(
+            model, params, jnp.asarray(tokens), 6, beam_size=3
+        )
+        assert np.all(np.isfinite(np.asarray(scores)))
+        assert wide.shape == (2, 3, 11)
+
     def test_beam_size_validated(self):
         model = lm()
         params, tokens = init(model)

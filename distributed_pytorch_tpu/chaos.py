@@ -1,0 +1,418 @@
+"""Deterministic fault injection for the elastic stack — the chaos harness.
+
+Every robustness claim this repo makes (restart-the-world, snapshot resume,
+store-blip survival, corrupt-checkpoint fallback) is only as good as the
+failures it has actually been subjected to. This module makes those failures
+*injectable, seeded, and declarative*, so the drills in ``tests/test_chaos.py``
+and ``tools/chaos_smoke.sh`` are reproducible experiments rather than
+anecdotes — the same philosophy as TorchTitan's failure drills, sized to run
+on CPU in seconds.
+
+Two pieces:
+
+* :class:`FaultPlan` — a declarative list of :class:`Fault` entries (kill
+  worker N at step S, hang it, corrupt the next snapshot write, partition the
+  store), activated process-wide by the ``TPURUN_FAULT_PLAN`` env var (inline
+  JSON or a path to a JSON file). The Trainer calls :func:`on_step` every
+  batch and the checkpoint writer calls :func:`on_snapshot_write` after every
+  durable write; both are exact no-ops when no plan is armed.
+* :class:`FaultProxy` — a TCP shim between store clients and the real
+  rendezvous store. It forwards bytes transparently until told to
+  ``partition()``: existing connections are severed mid-stream and new ones
+  refused until ``heal()``. The elastic agent routes its own store traffic
+  through a local proxy automatically whenever the armed plan carries
+  ``store_partition`` faults, so a drill needs no orchestration beyond the
+  env var.
+
+This module deliberately imports nothing heavy (no jax/numpy): pure-python
+drill workers can use it without paying a framework import.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import socket
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+ENV_VAR = "TPURUN_FAULT_PLAN"
+
+_KINDS = ("kill", "hang", "exit", "corrupt_snapshot", "store_partition")
+
+
+@dataclass
+class Fault:
+    """One declarative fault. Matching is AND over the set fields:
+
+    * ``process_id`` — fires only in the worker whose ``PROCESS_ID`` env var
+      matches (None = any process);
+    * ``restart`` — fires only at this ``TPURUN_RESTART_COUNT`` (None = any
+      generation; default 0 so a kill does not re-fire after the restart it
+      caused);
+    * ``at_step`` — 1-based count of :func:`on_step` calls in this process
+      (the Trainer calls it once per train batch);
+    * ``at_save`` — 1-based count of :func:`on_snapshot_write` calls in this
+      process (one per durable checkpoint/snapshot write);
+    * ``at_time`` — seconds after :meth:`FaultProxy.start` (store faults).
+
+    Kinds: ``kill`` (SIGKILL self — uncatchable, the external ``kill -9``
+    twin), ``hang`` (sleep ``duration`` seconds, or effectively forever when
+    0 — alive but silent, the SIGSTOP/wedged-collective twin), ``exit``
+    (clean nonzero exit with ``exit_code``), ``corrupt_snapshot`` (truncate
+    or bit-flip the just-written checkpoint file, per ``mode``), and
+    ``store_partition`` (drop store connections for ``duration`` seconds —
+    consumed by :class:`FaultProxy`, not by workers).
+    """
+
+    kind: str
+    process_id: Optional[int] = None
+    restart: Optional[int] = 0
+    at_step: Optional[int] = None
+    at_save: Optional[int] = None
+    at_time: Optional[float] = None
+    duration: float = 0.0
+    mode: str = "flip"  # corrupt_snapshot: "flip" | "truncate"
+    exit_code: int = 13
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; one of {_KINDS}")
+        if self.mode not in ("flip", "truncate"):
+            raise ValueError(f"unknown corrupt mode {self.mode!r}")
+
+
+def corrupt_file(path: str, mode: str = "flip", seed: int = 0) -> None:
+    """Deterministically damage ``path`` in place.
+
+    ``truncate`` keeps the first half (a torn write / full-disk partial);
+    ``flip`` XOR-flips 8 seeded byte positions (bit-rot). Both are
+    reproducible from ``seed`` so a drill's corruption is identical across
+    runs.
+    """
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    if mode == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(max(1, size // 2))
+        return
+    rng = random.Random(seed)
+    # Flip bytes across the back half too, where npz array payloads live —
+    # a corruption confined to the zip directory would understate the test.
+    offsets = sorted(rng.sample(range(size), min(8, size)))
+    with open(path, "r+b") as f:
+        for off in offsets:
+            f.seek(off)
+            byte = f.read(1)
+            f.seek(off)
+            f.write(bytes([byte[0] ^ 0xFF]))
+
+
+class FaultPlan:
+    """A seeded, declarative set of faults plus the per-process firing state.
+
+    Counters (steps, saves) are per-process and start at zero on every
+    (re)start, which is exactly what makes plans deterministic across a
+    restart-the-world: "kill process 1 at step 21 of generation 0" means the
+    same thing on every run.
+    """
+
+    def __init__(self, faults: List[Fault], seed: int = 0):
+        self.faults = list(faults)
+        self.seed = int(seed)
+        self._steps = 0
+        self._saves = 0
+        self._fired: set = set()
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- parsing
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        """Inline JSON (starts with ``{``) or a path to a JSON file."""
+        spec = spec.strip()
+        if spec.startswith("{"):
+            doc = json.loads(spec)
+        else:
+            with open(spec) as f:
+                doc = json.load(f)
+        faults = [Fault(**entry) for entry in doc.get("faults", [])]
+        return cls(faults, seed=doc.get("seed", 0))
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        spec = os.environ.get(ENV_VAR)
+        return cls.from_spec(spec) if spec else None
+
+    def to_spec(self) -> str:
+        """Inline-JSON form, suitable for a child process's env."""
+        return json.dumps(
+            {
+                "seed": self.seed,
+                "faults": [
+                    {k: v for k, v in vars(f).items() if v is not None}
+                    for f in self.faults
+                ],
+            }
+        )
+
+    # ------------------------------------------------------------ matching
+    @staticmethod
+    def _identity_matches(fault: Fault) -> bool:
+        if fault.process_id is not None:
+            pid = os.environ.get("PROCESS_ID")
+            if pid is None or int(pid) != fault.process_id:
+                return False
+        if fault.restart is not None:
+            if int(os.environ.get("TPURUN_RESTART_COUNT", "0")) != fault.restart:
+                return False
+        return True
+
+    def store_partitions(self) -> List[Fault]:
+        return [f for f in self.faults if f.kind == "store_partition"]
+
+    # -------------------------------------------------------------- firing
+    def on_step(self) -> None:
+        """Advance the per-process step counter and fire any due
+        kill/hang/exit fault. Called by the Trainer once per train batch;
+        pure-python drill workers call it directly."""
+        with self._lock:
+            self._steps += 1
+            step = self._steps
+        for i, fault in enumerate(self.faults):
+            if fault.kind not in ("kill", "hang", "exit"):
+                continue
+            if i in self._fired or fault.at_step != step:
+                continue
+            if not self._identity_matches(fault):
+                continue
+            self._fired.add(i)
+            self._fire(fault)
+
+    def on_snapshot_write(self, path: str) -> None:
+        """Advance the per-process save counter and corrupt ``path`` if a
+        ``corrupt_snapshot`` fault is due. Called by the checkpoint writer
+        right after each durable write."""
+        with self._lock:
+            self._saves += 1
+            save = self._saves
+        for i, fault in enumerate(self.faults):
+            if fault.kind != "corrupt_snapshot":
+                continue
+            if i in self._fired or fault.at_save != save:
+                continue
+            if not self._identity_matches(fault):
+                continue
+            self._fired.add(i)
+            print(
+                f"[chaos] corrupting snapshot write #{save} at {path} "
+                f"(mode={fault.mode}, seed={self.seed + i})",
+                flush=True,
+            )
+            corrupt_file(path, mode=fault.mode, seed=self.seed + i)
+
+    def _fire(self, fault: Fault) -> None:
+        if fault.kind == "kill":
+            print(f"[chaos] SIGKILL self at step {self._steps}", flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif fault.kind == "exit":
+            print(
+                f"[chaos] exit({fault.exit_code}) at step {self._steps}",
+                flush=True,
+            )
+            os._exit(fault.exit_code)
+        elif fault.kind == "hang":
+            duration = fault.duration if fault.duration > 0 else 86400.0
+            print(
+                f"[chaos] hanging for {duration:.0f}s at step {self._steps}",
+                flush=True,
+            )
+            time.sleep(duration)
+
+
+# ------------------------------------------------------- process-wide plan
+
+_UNSET = object()
+_plan = _UNSET
+
+
+def get_plan() -> Optional[FaultPlan]:
+    """The process-wide plan from ``TPURUN_FAULT_PLAN``, parsed once and
+    cached (the Trainer consults this every batch)."""
+    global _plan
+    if _plan is _UNSET:
+        _plan = FaultPlan.from_env()
+    return _plan
+
+
+def _reset() -> None:
+    """Drop the cached plan (tests re-arm the env var within one process)."""
+    global _plan
+    _plan = _UNSET
+
+
+def on_step() -> None:
+    plan = get_plan()
+    if plan is not None:
+        plan.on_step()
+
+
+def on_snapshot_write(path: str) -> None:
+    plan = get_plan()
+    if plan is not None:
+        plan.on_snapshot_write(path)
+
+
+# ------------------------------------------------------------- FaultProxy
+
+
+class FaultProxy:
+    """TCP shim for store-partition injection.
+
+    Listens on an ephemeral local port and pipes each accepted connection to
+    the real store. ``partition()`` severs every active connection mid-stream
+    and refuses new ones (exactly what a switch failure looks like to a
+    client: ECONNRESET now, ECONNREFUSED-or-hang next) until ``heal()``.
+    The hardened ``KVStoreClient`` must ride this out within its retry
+    deadline; that contract is what ``tests/test_chaos.py`` pins down.
+    """
+
+    def __init__(
+        self,
+        upstream_host: str,
+        upstream_port: int,
+        *,
+        listen_host: str = "127.0.0.1",
+        delay: float = 0.0,
+    ):
+        self.upstream = (upstream_host, upstream_port)
+        self.delay = delay
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((listen_host, 0))
+        self._listener.listen(64)
+        self.host, self.port = self._listener.getsockname()
+        self._partitioned = threading.Event()
+        self._stop = threading.Event()
+        self._conns: set = set()
+        self._lock = threading.Lock()
+        self._timers: List[threading.Timer] = []
+        self._accept_thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "FaultProxy":
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._timers:
+            t.cancel()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._close_all()
+
+    def __enter__(self) -> "FaultProxy":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------- faults
+    def partition(self, duration: Optional[float] = None) -> None:
+        """Sever every live connection and refuse new ones; auto-heal after
+        ``duration`` seconds when given."""
+        self._partitioned.set()
+        self._close_all()
+        if duration is not None:
+            timer = threading.Timer(duration, self.heal)
+            timer.daemon = True
+            self._timers.append(timer)
+            timer.start()
+
+    def heal(self) -> None:
+        self._partitioned.clear()
+
+    def apply_plan(self, plan: FaultPlan) -> None:
+        """Schedule the plan's ``store_partition`` faults relative to now."""
+        for fault in plan.store_partitions():
+            timer = threading.Timer(
+                fault.at_time or 0.0, self.partition, args=(fault.duration,)
+            )
+            timer.daemon = True
+            self._timers.append(timer)
+            timer.start()
+
+    # ----------------------------------------------------------- plumbing
+    def _close_all(self) -> None:
+        with self._lock:
+            conns, self._conns = set(self._conns), set()
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            if self._stop.is_set() or self._partitioned.is_set():
+                conn.close()
+                continue
+            try:
+                up = socket.create_connection(self.upstream, timeout=5)
+            except OSError:
+                conn.close()
+                continue
+            up.settimeout(None)  # connect-only timeout; pumps must block
+            for s in (conn, up):
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                with self._lock:
+                    self._conns.add(s)
+            for src, dst in ((conn, up), (up, conn)):
+                threading.Thread(
+                    target=self._pump, args=(src, dst), daemon=True
+                ).start()
+
+    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
+        try:
+            while True:
+                data = src.recv(4096)
+                if not data or self._partitioned.is_set():
+                    break
+                if self.delay:
+                    time.sleep(self.delay)
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                with self._lock:
+                    self._conns.discard(s)
+                # shutdown() before close(): the partner pump is blocked in
+                # recv() on one of these fds and holds a kernel reference, so
+                # a bare close() would neither wake it nor send FIN — the
+                # proxied client would then block forever on a reply that can
+                # no longer arrive.
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass

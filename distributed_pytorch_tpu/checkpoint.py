@@ -17,21 +17,59 @@ Writes are atomic (tmp file + ``os.replace``) and, under multi-process runs,
 performed by process 0 only with a cross-host barrier after the write — fixing
 the reference's multi-writer shared-FS race (``multinode_torchrun.py:68``
 gates on *local* rank 0, so every node wrote the same file).
+
+Integrity & self-healing (TorchTitan-style "checkpoints you can trust"):
+
+* every save embeds per-array checksums plus a whole-manifest checksum in the
+  metadata entry; loads verify both and raise :class:`SnapshotIntegrityError`
+  on any mismatch — a torn write or bit-rot is *detected*, never silently
+  trained on;
+* snapshot saves rotate the previous file to ``<path>.prev`` before writing,
+  so there is always a one-interval-older fallback;
+* :func:`load_snapshot_with_fallback` (used by the Trainer) quarantines a
+  corrupt candidate as ``<path>.corrupt``, warns loudly, and falls back to
+  ``.prev`` — corruption costs one save interval, never the run.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import tempfile
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from distributed_pytorch_tpu.chaos import on_snapshot_write as _chaos_on_snapshot_write
 from distributed_pytorch_tpu.parallel.bootstrap import barrier, is_main_process
 
 _META_KEY = "__checkpoint_meta__"
+_INTEGRITY_KEY = "__integrity__"
+
+try:  # CRC32C (Castagnoli) when a native impl exists; stdlib CRC32 otherwise.
+    # The manifest records which algorithm wrote it, so verification always
+    # recomputes with the matching one — and no new dependency either way.
+    import crc32c as _crc32c_mod
+
+    _CRC_ALGO = "crc32c"
+
+    def _crc(data: bytes) -> int:
+        return _crc32c_mod.crc32c(data)
+
+except ImportError:
+    import zlib
+
+    _CRC_ALGO = "crc32"
+
+    def _crc(data: bytes) -> int:
+        return zlib.crc32(data) & 0xFFFFFFFF
+
+
+class SnapshotIntegrityError(RuntimeError):
+    """A checkpoint failed checksum verification (torn write, truncation, or
+    disk bit-rot). Callers with a fallback chain quarantine and move on."""
 
 
 def _path_str(path) -> str:
@@ -93,18 +131,111 @@ def _atomic_write(path: str, write_fn, *, mode: str = "wb") -> None:
         raise
 
 
+def _with_integrity(arrays: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Embed per-array checksums + a manifest checksum into the metadata
+    entry. The manifest checksum covers the (key -> crc) map itself, so a
+    corruption that hits the metadata entry is as detectable as one that
+    hits array bytes."""
+    meta_arr = arrays.get(_META_KEY)
+    meta = (
+        json.loads(bytes(meta_arr.tobytes()).decode("utf-8"))
+        if meta_arr is not None
+        else {}
+    )
+    manifest = {
+        key: _crc(np.ascontiguousarray(value).tobytes())
+        for key, value in arrays.items()
+        if key != _META_KEY
+    }
+    meta[_INTEGRITY_KEY] = {
+        "algo": _CRC_ALGO,
+        "arrays": manifest,
+        "manifest": _crc(json.dumps(manifest, sort_keys=True).encode("utf-8")),
+    }
+    out = dict(arrays)
+    out[_META_KEY] = np.frombuffer(
+        json.dumps(meta).encode("utf-8"), dtype=np.uint8
+    )
+    return out
+
+
+def _verify_integrity(npz, meta: Dict, *, source: str) -> None:
+    """Raise :class:`SnapshotIntegrityError` if any checksum recorded at save
+    time no longer matches. Files written before integrity existed (or by a
+    different checksum impl) pass through unverified — npz's own zip CRCs
+    still apply to them."""
+    integ = meta.get(_INTEGRITY_KEY)
+    if not integ or integ.get("algo") != _CRC_ALGO:
+        return
+    manifest = integ.get("arrays", {})
+    if _crc(json.dumps(manifest, sort_keys=True).encode("utf-8")) != integ.get(
+        "manifest"
+    ):
+        raise SnapshotIntegrityError(f"{source}: checksum manifest is corrupt")
+    for key, expect in manifest.items():
+        if key not in npz:
+            raise SnapshotIntegrityError(
+                f"{source}: array {key!r} listed in manifest is missing"
+            )
+        got = _crc(np.ascontiguousarray(npz[key]).tobytes())
+        if got != expect:
+            raise SnapshotIntegrityError(
+                f"{source}: array {key!r} checksum mismatch "
+                f"(expected {expect:#010x}, got {got:#010x}) — torn write "
+                "or disk corruption"
+            )
+
+
 def _write_npz(path: str, arrays: Dict[str, np.ndarray]) -> None:
-    _atomic_write(path, lambda f: np.savez(f, **arrays))
+    _atomic_write(path, lambda f: np.savez(f, **_with_integrity(arrays)))
+    # Chaos hook: a "corrupt the next snapshot write" fault fires here, right
+    # after the file became durable — exactly where real bit-rot would land.
+    _chaos_on_snapshot_write(path)
 
 
-def save_checkpoint(path: str, tree: Any, metadata: Optional[Dict] = None) -> None:
+def _rotate_previous(path: str) -> None:
+    """Keep the previous file as ``<path>.prev`` so a corrupt new write still
+    leaves a one-interval-older snapshot to fall back to."""
+    if os.path.exists(path):
+        os.replace(path, path + ".prev")
+
+
+def quarantine(path: str) -> Optional[str]:
+    """Move a corrupt checkpoint aside as ``<path>.corrupt`` (suffixing
+    ``.1``, ``.2``, ... on collision) so it can be inspected post-mortem but
+    can never be loaded again. Returns the new path, or None if the file was
+    already gone (another process of the same job quarantined it first)."""
+    if not os.path.exists(path):
+        return None
+    dest = path + ".corrupt"
+    n = 0
+    while os.path.exists(dest):
+        n += 1
+        dest = f"{path}.corrupt.{n}"
+    try:
+        os.replace(path, dest)
+    except OSError:
+        return None
+    return dest
+
+
+def save_checkpoint(
+    path: str,
+    tree: Any,
+    metadata: Optional[Dict] = None,
+    *,
+    keep_previous: bool = False,
+) -> None:
     """Atomically write ``tree`` (+ JSON-able ``metadata``) to ``path`` (.npz).
 
     Process-0-only under multi-process runs; all processes return only after the
-    write is durable (barrier).
+    write is durable (barrier). ``keep_previous`` rotates an existing file to
+    ``<path>.prev`` first (snapshot saves do this; see module docstring).
     """
     arrays = _gather_arrays(tree, metadata)
     if is_main_process():
+        if keep_previous:
+            _rotate_previous(path)
         _write_npz(path, arrays)
     barrier("checkpoint_write")
 
@@ -126,7 +257,14 @@ class AsyncCheckpointer:
         self._barrier_due = False
         self._error: Optional[BaseException] = None
 
-    def save(self, path: str, tree: Any, metadata: Optional[Dict] = None) -> None:
+    def save(
+        self,
+        path: str,
+        tree: Any,
+        metadata: Optional[Dict] = None,
+        *,
+        keep_previous: bool = False,
+    ) -> None:
         self.wait()
         arrays = _gather_arrays(tree, metadata)
         self._barrier_due = True
@@ -135,6 +273,8 @@ class AsyncCheckpointer:
 
         def write():
             try:
+                if keep_previous:
+                    _rotate_previous(path)
                 _write_npz(path, arrays)
             except BaseException as e:  # surfaced on the next wait()
                 self._error = e
@@ -145,8 +285,11 @@ class AsyncCheckpointer:
         self._thread.start()
 
     def save_snapshot(self, path: str, state: Any, epochs_run: int) -> None:
-        """Async variant of :func:`save_snapshot` (same metadata schema)."""
-        self.save(path, state, metadata=_snapshot_meta(epochs_run))
+        """Async variant of :func:`save_snapshot` (same metadata schema and
+        the same ``.prev`` rotation)."""
+        self.save(
+            path, state, metadata=_snapshot_meta(epochs_run), keep_previous=True
+        )
 
     def wait(self) -> None:
         """Block until the in-flight write is durable on every process."""
@@ -173,7 +316,9 @@ def load_checkpoint(path: str, template: Any) -> Tuple[Any, Dict]:
     """
     with np.load(path) as data:
         meta = json.loads(bytes(data[_META_KEY].tobytes()).decode("utf-8"))
+        _verify_integrity(data, meta, source=f"checkpoint {path}")
         tree = _align_to_template(data, template, source=f"checkpoint {path}")
+    meta.pop(_INTEGRITY_KEY, None)  # plumbing, not caller-facing metadata
     return tree, meta
 
 
@@ -226,9 +371,12 @@ def save_snapshot(path: str, state: Any, epochs_run: int) -> None:
     """Elastic-training snapshot: full TrainState + progress marker.
 
     Twin of ``Trainer._save_snapshot`` (reference ``multigpu_torchrun.py:57-62``,
-    which stores ``{MODEL_STATE, EPOCHS_RUN}``).
+    which stores ``{MODEL_STATE, EPOCHS_RUN}``). Rotates the previous snapshot
+    to ``<path>.prev`` so resume always has a fallback candidate.
     """
-    save_checkpoint(path, state, metadata=_snapshot_meta(epochs_run))
+    save_checkpoint(
+        path, state, metadata=_snapshot_meta(epochs_run), keep_previous=True
+    )
 
 
 def load_snapshot(path: str, template: Any) -> Tuple[Any, int]:
@@ -238,6 +386,46 @@ def load_snapshot(path: str, template: Any) -> Tuple[Any, int]:
     """
     state, meta = load_checkpoint(path, template)
     return state, int(meta.get("epochs_run", 0))
+
+
+def load_snapshot_with_fallback(
+    path: str, template: Any
+) -> Optional[Tuple[Any, int, str]]:
+    """Self-healing snapshot resume: try ``path``, then ``<path>.prev``.
+
+    A candidate that exists but fails to load — checksum mismatch, torn zip,
+    missing leaves — is quarantined (renamed ``.corrupt``) with a loud
+    warning, and the chain moves on. Returns ``(state, epochs_run,
+    used_path)`` from the first loadable candidate, or ``None`` when no
+    candidate exists at all (silent: a first run) or every candidate was
+    corrupt (loud: the caller starts fresh knowing data was lost).
+
+    On shared-filesystem multi-process runs every process walks the same
+    chain; the quarantine rename is first-writer-wins and the losers simply
+    see the file gone and continue down the chain.
+    """
+    candidates = [c for c in (path, path + ".prev") if os.path.exists(c)]
+    if not candidates:
+        return None
+    for cand in candidates:
+        try:
+            state, epochs = load_snapshot(cand, template)
+            return state, epochs, cand
+        except Exception as e:
+            dest = quarantine(cand)
+            print(
+                f"[checkpoint] snapshot {cand} failed to load "
+                f"({type(e).__name__}: {e}); quarantined to {dest}",
+                file=sys.stderr,
+                flush=True,
+            )
+    print(
+        f"[checkpoint] WARNING: no loadable snapshot for {path} — every "
+        "candidate was corrupt and quarantined; training will start FRESH",
+        file=sys.stderr,
+        flush=True,
+    )
+    return None
 
 
 # -------------------------------------------------------- orbax interop
@@ -553,13 +741,34 @@ class CheckpointManager:
 
     # ----------------------------------------------------------- restore
     def restore(self, template: Any) -> Tuple[Any, Dict]:
-        """Latest checkpoint -> ``(tree, metadata)``; raises if none."""
-        path = self.latest_path()
-        if path is None:
+        """Latest *loadable* checkpoint -> ``(tree, metadata)``; raises if
+        none exists. A corrupt latest is quarantined (``.corrupt``) with a
+        loud warning and the next-newest is tried — the rotation itself is
+        the fallback chain, same recovery contract as
+        :func:`load_snapshot_with_fallback`."""
+        ordered = self._recent()  # oldest -> newest among the kept set
+        if not ordered:
             raise FileNotFoundError(
                 f"no {self.PREFIX}*.npz under {self.directory}"
             )
-        return load_checkpoint(path, template)
+        last_err: Optional[Exception] = None
+        for step in reversed(ordered):
+            path = self._path(step)
+            try:
+                return load_checkpoint(path, template)
+            except Exception as e:
+                last_err = e
+                dest = quarantine(path)
+                print(
+                    f"[checkpoint] {path} failed to load "
+                    f"({type(e).__name__}: {e}); quarantined to {dest}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+        raise FileNotFoundError(
+            f"no loadable {self.PREFIX}*.npz under {self.directory} "
+            f"(all candidates corrupt; last error: {last_err})"
+        )
 
     def restore_best(self, template: Any) -> Tuple[Any, Dict]:
         """Best-metric checkpoint -> ``(tree, metadata)``; raises if no

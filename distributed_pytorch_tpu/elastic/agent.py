@@ -65,6 +65,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from distributed_pytorch_tpu.chaos import FaultProxy, get_plan as _get_fault_plan
 from distributed_pytorch_tpu.elastic.store import KVStoreClient, KVStoreServer
 
 GEN_KEY = "tpurun/generation"  # bumped on every failure -> restart-the-world
@@ -119,6 +120,13 @@ class ElasticConfig:
     # often once training starts (the Trainer does so every batch). The clock
     # starts at spawn, so set it above worst-case startup + compile time.
     worker_heartbeat_timeout: float = 0.0
+    # The blip/dead boundary for the rendezvous store: transport failures are
+    # retried transparently inside KVStoreClient for this many seconds (a
+    # store restart or network partition shorter than this is INVISIBLE to
+    # the agent); only after the deadline does a ConnectionError surface, and
+    # the agent then treats the rendezvous host as dead (WorldCompleted /
+    # abort, the pre-existing paths).
+    store_retry_deadline: float = 30.0
     env: Dict[str, str] = field(default_factory=dict)
 
     @property
@@ -278,7 +286,26 @@ class ElasticAgent:
         self.server: Optional[KVStoreServer] = None
         if cfg.node_rank == 0:
             self.server = KVStoreServer(cfg.rdzv_port)
-        self.store = KVStoreClient(cfg.rdzv_host, cfg.rdzv_port)
+        # Chaos: when the armed FaultPlan carries store_partition faults,
+        # route this agent's store traffic through a local FaultProxy so the
+        # partition can be injected without touching the real store. The
+        # server (above) still binds the real rdzv port for the other agents.
+        self._chaos_proxy: Optional[FaultProxy] = None
+        store_host, store_port = cfg.rdzv_host, cfg.rdzv_port
+        plan = _get_fault_plan()
+        if plan is not None and plan.store_partitions():
+            self._chaos_proxy = FaultProxy(cfg.rdzv_host, cfg.rdzv_port).start()
+            self._chaos_proxy.apply_plan(plan)
+            store_host, store_port = self._chaos_proxy.host, self._chaos_proxy.port
+            print(
+                f"[tpurun] chaos: store traffic via FaultProxy "
+                f"{store_host}:{store_port}",
+                flush=True,
+            )
+        self._store_endpoint = (store_host, store_port)
+        self.store = KVStoreClient(
+            store_host, store_port, retry_deadline=cfg.store_retry_deadline
+        )
         self._stop_hb = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
         self._group: Optional[WorkerGroup] = None
@@ -297,8 +324,13 @@ class ElasticAgent:
             beat += 1
             try:
                 if client is None:
+                    # retry_deadline=0: a beat is time-sensitive — better to
+                    # drop it and reconnect next interval than block the
+                    # loop retrying (this loop IS the liveness signal).
                     client = KVStoreClient(
-                        self.cfg.rdzv_host, self.cfg.rdzv_port, connect_timeout=5.0
+                        *self._store_endpoint,
+                        connect_timeout=5.0,
+                        retry_deadline=0.0,
                     )
                 client.set(f"{HB_PREFIX}{self.cfg.node_rank}", str(beat))
             except (ConnectionError, OSError):
@@ -647,6 +679,9 @@ class ElasticAgent:
             self.store.close()
             if self.server is not None:
                 self.server.close()
+            if self._chaos_proxy is not None:
+                self._chaos_proxy.stop()
+                self._chaos_proxy = None
 
 
 def _parse_endpoint(endpoint: str) -> tuple:
@@ -711,6 +746,14 @@ def make_parser() -> argparse.ArgumentParser:
         "allow for startup + first compile",
     )
     p.add_argument(
+        "--store-retry-deadline",
+        type=float,
+        default=30.0,
+        help="seconds the store client transparently retries a transport "
+        "failure (reconnect + backoff) before the agent concludes the "
+        "rendezvous host is dead; 0 disables retry (fail fast)",
+    )
+    p.add_argument(
         "--standalone",
         action="store_true",
         help="single-node shorthand: nnodes=1, store on an ephemeral local port",
@@ -772,6 +815,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         heartbeat_interval=args.heartbeat_interval,
         heartbeat_timeout=args.heartbeat_timeout,
         worker_heartbeat_timeout=args.worker_heartbeat_timeout,
+        store_retry_deadline=args.store_retry_deadline,
     )
     agent = ElasticAgent(cfg, [sys.executable, args.script] + args.script_args)
 

@@ -6,17 +6,44 @@ rendezvous host (``--rdzv_endpoint head:29500`` in reference
 ``slurm/sbatch_run.sh:21-22``), every elastic agent connects as a client, and
 all coordination — join counting, failure-generation broadcast, barriers —
 happens through these few primitives.
+
+Robustness contract of :class:`KVStoreClient` (the "hardened" client):
+
+* any transport failure — refused connect, reset, timeout mid-reply — drops
+  the socket AND clears the receive buffer, so a half-read reply can never be
+  parsed as the answer to the *next* request (the poisoned-buffer bug);
+* idempotent ops (``PING``/``GET``/``WAIT``/``WAITGE``/``KEYS``) are retried
+  transparently with capped exponential backoff + jitter until
+  ``retry_deadline`` seconds have elapsed, reconnecting between attempts;
+* mutating ops (``SET``/``ADD``/``DEL``) are only retried because each carries
+  a client-generated request id that the server deduplicates: "applied but the
+  reply was lost on the wire" replays the recorded reply instead of
+  re-applying (an un-deduped retried ``ADD`` would corrupt every rendezvous
+  counter);
+* ``retry_deadline=0`` restores fail-fast single-attempt behaviour — the
+  agent's heartbeat thread wants a dropped beat over a blocked one.
+
+The distinction the agent builds on top: a store that answers again within
+``retry_deadline`` was a *blip* (invisible to callers); one that does not is
+treated as *rendezvous host dead* — the eventual ``ConnectionError`` surfaces
+through the agent's existing fatal/world-completed paths.
 """
 
 from __future__ import annotations
 
+import os
+import random
 import socket
 import subprocess
 import time
 import urllib.parse
-from typing import List, Optional
+from typing import Callable, List, Optional, Tuple
 
 from distributed_pytorch_tpu.native import kvstore_binary
+
+# Backoff: 0.05, 0.1, 0.2, ... capped at 1s, each scaled by jitter in [0.5, 1).
+_BACKOFF_BASE = 0.05
+_BACKOFF_CAP = 1.0
 
 
 def _encode(s: str) -> str:
@@ -50,6 +77,8 @@ class KVStoreServer:
             except subprocess.TimeoutExpired:
                 self._proc.kill()
                 self._proc.wait()
+        if self._proc.stdout is not None:
+            self._proc.stdout.close()  # the readiness PIPE otherwise leaks an fd
 
     def __enter__(self) -> "KVStoreServer":
         return self
@@ -59,25 +88,65 @@ class KVStoreServer:
 
 
 class KVStoreClient:
-    """Blocking line-protocol client. One TCP connection per client; methods
-    are synchronous and return decoded values."""
+    """Blocking line-protocol client with transparent reconnect. Methods are
+    synchronous and return decoded values; see the module docstring for the
+    retry/dedup contract."""
 
-    def __init__(self, host: str, port: int, *, connect_timeout: float = 60.0):
-        deadline = time.monotonic() + connect_timeout
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        connect_timeout: float = 60.0,
+        retry_deadline: float = 30.0,
+    ):
+        self.host = host
+        self.port = port
+        self.retry_deadline = retry_deadline
+        self._sock: Optional[socket.socket] = None
+        self._buf = b""
+        # Request ids must be unique across every client that ever talks to
+        # one server (pids recycle, agents restart): pid + random tag + counter.
+        self._req_tag = f"{os.getpid():x}.{random.getrandbits(48):012x}"
+        self._req_n = 0
+        self._jitter = random.Random(self._req_tag)
+        self._connect(connect_timeout)
+
+    # ---------------------------------------------------------- transport
+    def _connect(self, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
         last_err: Optional[Exception] = None
-        while time.monotonic() < deadline:
+        while True:
             try:
-                self._sock = socket.create_connection((host, port), timeout=5)
-                self._sock.settimeout(None)  # requests manage their own timeouts
-                self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock = socket.create_connection((self.host, self.port), timeout=5)
+                sock.settimeout(None)  # requests manage their own timeouts
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._sock = sock
                 self._buf = b""
                 return
             except OSError as e:  # server may not be up yet (agent races store)
                 last_err = e
+                if time.monotonic() + 0.1 >= deadline:
+                    raise ConnectionError(
+                        f"cannot reach kvstore at {self.host}:{self.port}: {last_err}"
+                    ) from last_err
                 time.sleep(0.1)
-        raise ConnectionError(f"cannot reach kvstore at {host}:{port}: {last_err}")
 
-    def _request(self, *tokens: str, timeout: Optional[float] = None) -> List[str]:
+    def _drop_connection(self) -> None:
+        """Poisoned-buffer reset: after any transport error the stream may
+        hold a partial or stale frame — discard both socket and buffer so the
+        next request starts on a clean stream."""
+        self._buf = b""
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _request_once(self, tokens: List[str], timeout: Optional[float]) -> List[str]:
+        if self._sock is None:
+            self._connect(2.0)  # short: the outer retry loop owns the deadline
         line = " ".join(tokens) + "\n"
         self._sock.settimeout(timeout)
         try:
@@ -87,61 +156,137 @@ class KVStoreClient:
                 if not chunk:
                     raise ConnectionError("kvstore connection closed")
                 self._buf += chunk
-        finally:
             self._sock.settimeout(None)
+        except Exception:
+            self._drop_connection()
+            raise
         raw, self._buf = self._buf.split(b"\n", 1)
         parts = raw.decode().split(" ")
         if parts[0] == "ERR":
             raise RuntimeError(f"kvstore error: {' '.join(parts[1:])}")
         return parts
 
+    def _request(
+        self,
+        build: Callable[[], Tuple[List[str], Optional[float]]],
+        *,
+        mutating: bool = False,
+        retry: bool = True,
+    ) -> List[str]:
+        """Run one logical request through the retry loop.
+
+        ``build`` produces (tokens, per-attempt timeout) fresh on every
+        attempt so WAIT-style ops can shrink their server-side timeout to the
+        time remaining. A mutating request gets ONE id for its lifetime —
+        reused across retries, which is what makes the retry safe.
+        """
+        reqid: Optional[str] = None
+        if mutating:
+            self._req_n += 1
+            reqid = f"{self._req_tag}.{self._req_n}"
+        start = time.monotonic()
+        attempt = 0
+        while True:
+            tokens, timeout = build()
+            if reqid is not None:
+                tokens = tokens + [reqid]
+            try:
+                return self._request_once(tokens, timeout)
+            except (ConnectionError, OSError) as e:
+                if not retry or self.retry_deadline <= 0:
+                    raise
+                elapsed = time.monotonic() - start
+                remaining = self.retry_deadline - elapsed
+                if remaining <= 0:
+                    raise ConnectionError(
+                        f"kvstore at {self.host}:{self.port} unreachable for "
+                        f"{elapsed:.1f}s (retry deadline {self.retry_deadline}s): {e}"
+                    ) from e
+                backoff = min(_BACKOFF_CAP, _BACKOFF_BASE * (2 ** attempt))
+                backoff *= 0.5 + 0.5 * self._jitter.random()
+                attempt += 1
+                time.sleep(min(backoff, remaining))
+
+    def _simple(
+        self,
+        *tokens: str,
+        mutating: bool = False,
+        retry: bool = True,
+        timeout: Optional[float] = None,
+    ) -> List[str]:
+        return self._request(
+            lambda: (list(tokens), timeout), mutating=mutating, retry=retry
+        )
+
+    # ----------------------------------------------------------- protocol
     def ping(self) -> bool:
-        return self._request("PING")[0] == "PONG"
+        return self._simple("PING")[0] == "PONG"
 
     def set(self, key: str, value: str) -> None:
-        self._request("SET", _encode(key), _encode(value))
+        self._simple("SET", _encode(key), _encode(value), mutating=True)
 
     def get(self, key: str) -> Optional[str]:
-        parts = self._request("GET", _encode(key))
+        parts = self._simple("GET", _encode(key))
         return _decode(parts[1]) if parts[0] == "VAL" else None
 
     def add(self, key: str, delta: int = 1) -> int:
-        return int(self._request("ADD", _encode(key), str(delta))[1])
+        return int(self._simple("ADD", _encode(key), str(delta), mutating=True)[1])
 
     def wait(self, key: str, timeout: Optional[float] = None) -> Optional[str]:
-        """Block until ``key`` exists; None on timeout."""
-        args = ["WAIT", _encode(key)]
-        if timeout is not None:
-            args.append(str(int(timeout * 1000)))
-        parts = self._request(*args, timeout=None if timeout is None else timeout + 5)
+        """Block until ``key`` exists; None on timeout. Survives reconnects:
+        each retry re-issues WAIT with only the time still remaining."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        def build() -> Tuple[List[str], Optional[float]]:
+            if deadline is None:
+                return ["WAIT", _encode(key)], None
+            rem = max(0.0, deadline - time.monotonic())
+            return ["WAIT", _encode(key), str(int(rem * 1000))], rem + 5
+
+        parts = self._request(build)
         return _decode(parts[1]) if parts[0] == "VAL" else None
 
-    def wait_ge(self, key: str, target: int, timeout: Optional[float] = None) -> Optional[int]:
-        """Block until int value of ``key`` >= target; None on timeout."""
-        args = ["WAITGE", _encode(key), str(target)]
-        if timeout is not None:
-            args.append(str(int(timeout * 1000)))
-        parts = self._request(*args, timeout=None if timeout is None else timeout + 5)
+    def wait_ge(
+        self, key: str, target: int, timeout: Optional[float] = None
+    ) -> Optional[int]:
+        """Block until int value of ``key`` >= target; None on timeout.
+        Survives reconnects the same way as :meth:`wait`."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        def build() -> Tuple[List[str], Optional[float]]:
+            args = ["WAITGE", _encode(key), str(target)]
+            if deadline is None:
+                return args, None
+            rem = max(0.0, deadline - time.monotonic())
+            return args + [str(int(rem * 1000))], rem + 5
+
+        parts = self._request(build)
         return int(parts[1]) if parts[0] == "VAL" else None
 
     def delete(self, key: str) -> None:
-        self._request("DEL", _encode(key))
+        self._simple("DEL", _encode(key), mutating=True)
 
     def keys(self, prefix: str = "") -> List[str]:
-        parts = self._request("KEYS", _encode(prefix)) if prefix else self._request("KEYS")
+        parts = (
+            self._simple("KEYS", _encode(prefix)) if prefix else self._simple("KEYS")
+        )
         return [_decode(p) for p in parts[1:]]
 
     def shutdown_server(self) -> None:
         try:
-            self._request("SHUTDOWN")
+            # Bounded: the reply races the server's own exit (and any proxy in
+            # between), and a lost reply must not wedge agent shutdown.
+            self._simple("SHUTDOWN", retry=False, timeout=5.0)
         except (ConnectionError, OSError):
             pass  # server exiting mid-reply is fine
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
     def __enter__(self) -> "KVStoreClient":
         return self

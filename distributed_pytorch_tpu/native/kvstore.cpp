@@ -11,14 +11,21 @@
 // percent-encodes arbitrary strings).
 //
 //   PING                 -> PONG
-//   SET <key> <value>    -> OK                  (set + wake waiters)
+//   SET <key> <value> [id] -> OK                (set + wake waiters)
 //   GET <key>            -> VAL <value> | NONE
-//   ADD <key> <delta>    -> VAL <int>           (atomic add, missing key = 0)
+//   ADD <key> <delta> [id] -> VAL <int>         (atomic add, missing key = 0)
 //   WAIT <key> [ms]      -> VAL <value> | TIMEOUT   (block until key exists)
 //   WAITGE <key> <n> [ms]-> VAL <int> | TIMEOUT (block until int value >= n)
-//   DEL <key>            -> OK
+//   DEL <key> [id]       -> OK
 //   KEYS <prefix>        -> VAL <k1> <k2> ...   (snapshot; may be empty)
 //   SHUTDOWN             -> OK (then the server exits)
+//
+// Mutating ops take an optional trailing request id: a client that lost the
+// reply (connection reset between apply and ack) retries with the SAME id,
+// and the server replays the recorded reply instead of re-applying. Without
+// this, a retried ADD would double-increment rendezvous counters. Ids live in
+// a bounded FIFO map — old entries are evicted once the client has long since
+// given up retrying them.
 //
 // Threading: one detached thread per connection; a single mutex +
 // condition_variable guards the map (coordination traffic is tiny — a few
@@ -35,8 +42,10 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <mutex>
+#include <unordered_map>
 #include <sstream>
 #include <string>
 #include <thread>
@@ -50,16 +59,40 @@ std::map<std::string, std::string> g_store;
 bool g_shutdown = false;
 int g_listen_fd = -1;
 
+// Replay memory for deduplicated mutating ops. Guarded by g_mu.
+std::unordered_map<std::string, std::string> g_dedup;
+std::deque<std::string> g_dedup_order;  // FIFO eviction order
+constexpr size_t kDedupCap = 8192;
+
+// Both helpers require g_mu held.
+const std::string* dedup_lookup(const std::string& id) {
+  auto it = g_dedup.find(id);
+  return it == g_dedup.end() ? nullptr : &it->second;
+}
+
+void dedup_record(const std::string& id, const std::string& resp) {
+  if (g_dedup.emplace(id, resp).second) {
+    g_dedup_order.push_back(id);
+    if (g_dedup_order.size() > kDedupCap) {
+      g_dedup.erase(g_dedup_order.front());
+      g_dedup_order.pop_front();
+    }
+  }
+}
+
 std::string handle_command(const std::vector<std::string>& tok) {
   if (tok.empty()) return "ERR empty";
   const std::string& cmd = tok[0];
 
   if (cmd == "PING") return "PONG";
 
-  if (cmd == "SET" && tok.size() == 3) {
+  if (cmd == "SET" && (tok.size() == 3 || tok.size() == 4)) {
     std::lock_guard<std::mutex> lk(g_mu);
+    if (tok.size() == 4)
+      if (const std::string* prior = dedup_lookup(tok[3])) return *prior;
     g_store[tok[1]] = tok[2];
     g_cv.notify_all();
+    if (tok.size() == 4) dedup_record(tok[3], "OK");
     return "OK";
   }
 
@@ -69,16 +102,20 @@ std::string handle_command(const std::vector<std::string>& tok) {
     return it == g_store.end() ? "NONE" : "VAL " + it->second;
   }
 
-  if (cmd == "ADD" && tok.size() == 3) {
+  if (cmd == "ADD" && (tok.size() == 3 || tok.size() == 4)) {
     long delta = strtol(tok[2].c_str(), nullptr, 10);
     std::lock_guard<std::mutex> lk(g_mu);
+    if (tok.size() == 4)
+      if (const std::string* prior = dedup_lookup(tok[3])) return *prior;
     long cur = 0;
     auto it = g_store.find(tok[1]);
     if (it != g_store.end()) cur = strtol(it->second.c_str(), nullptr, 10);
     cur += delta;
     g_store[tok[1]] = std::to_string(cur);
     g_cv.notify_all();
-    return "VAL " + std::to_string(cur);
+    std::string resp = "VAL " + std::to_string(cur);
+    if (tok.size() == 4) dedup_record(tok[3], resp);
+    return resp;
   }
 
   if (cmd == "WAIT" && (tok.size() == 2 || tok.size() == 3)) {
@@ -112,9 +149,12 @@ std::string handle_command(const std::vector<std::string>& tok) {
     return "VAL " + std::to_string(value());
   }
 
-  if (cmd == "DEL" && tok.size() == 2) {
+  if (cmd == "DEL" && (tok.size() == 2 || tok.size() == 3)) {
     std::lock_guard<std::mutex> lk(g_mu);
+    if (tok.size() == 3)
+      if (const std::string* prior = dedup_lookup(tok[2])) return *prior;
     g_store.erase(tok[1]);
+    if (tok.size() == 3) dedup_record(tok[2], "OK");
     return "OK";
   }
 

@@ -67,6 +67,15 @@ def setup_distributed(
         # metadata server (the torchrun-env machinery has no analog here).
         jax.distributed.initialize()
     else:
+        # Multi-process on the forced-CPU test rig: XLA's CPU client refuses
+        # cross-process computations unless a collectives transport is
+        # selected (gloo ships in jaxlib). Must be set before the backend
+        # initializes; never touched on real TPU.
+        if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+            try:
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except Exception:
+                pass  # older jax: CPU multiprocess either works or is absent
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

@@ -30,8 +30,9 @@ import numpy as np
 import optax
 from jax.sharding import Mesh
 
+from distributed_pytorch_tpu.chaos import on_step as _chaos_on_step
 from distributed_pytorch_tpu.checkpoint import (
-    load_snapshot,
+    load_snapshot_with_fallback,
     save_checkpoint,
     save_snapshot,
 )
@@ -49,6 +50,30 @@ from distributed_pytorch_tpu.training.train_step import (
     make_train_step,
 )
 from distributed_pytorch_tpu.utils.data import ShardedLoader
+
+
+def _put_host_state(state, sharding):
+    """Place a host-loaded (numpy) state tree onto a possibly multi-process
+    sharding.
+
+    ``jax.device_put(host_array, multi_process_sharding)`` runs a
+    cross-process value-equality collective, which the CPU backend does not
+    implement (and which is redundant here: every process read the same
+    snapshot file). ``make_array_from_callback`` assembles the global array
+    from locally-computed shards with no collective, so snapshot resume works
+    on any backend. ``sharding`` may be a single Sharding or a state-shaped
+    tree of them.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(state, sharding)
+
+    def put(x, s):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, s, lambda idx: x[idx])
+
+    if isinstance(sharding, jax.sharding.Sharding):
+        return jax.tree_util.tree_map(lambda x: put(x, sharding), state)
+    return jax.tree_util.tree_map(put, state, sharding)
 
 
 class Trainer:
@@ -188,10 +213,11 @@ class Trainer:
             self.state = jax.device_put(self.state, replicated_sharding(mesh))
 
         # Snapshot probe-on-init: the elasticity contract
-        # (reference multigpu_torchrun.py:30-32).
+        # (reference multigpu_torchrun.py:30-32). _load_snapshot handles the
+        # whole fallback chain, including "only <path>.prev exists" (a crash
+        # between rotation and write) and "latest is corrupt".
         if snapshot_path is not None:
-            if os.path.exists(snapshot_path):
-                self._load_snapshot(snapshot_path)
+            self._load_snapshot(snapshot_path)
 
         self.train_step = make_train_step(
             model.apply, optimizer, loss_fn, mesh=mesh, grad_accum=grad_accum,
@@ -208,17 +234,25 @@ class Trainer:
     # ---------------------------------------------------------------- persistence
 
     def _load_snapshot(self, path: str) -> None:
-        state, self.epochs_run = load_snapshot(path, self.state)
+        loaded = load_snapshot_with_fallback(path, self.state)
+        if loaded is None:
+            # Nothing loadable: either a first run (silent) or every
+            # candidate was corrupt (load_snapshot_with_fallback already
+            # warned loudly and quarantined) — train from scratch.
+            return
+        state, self.epochs_run, used = loaded
         if self.state_sharding is not None:
-            state = jax.device_put(state, self.state_sharding)
+            state = _put_host_state(state, self.state_sharding)
         elif self.mesh is not None:
-            state = jax.device_put(state, replicated_sharding(self.mesh))
+            state = _put_host_state(state, replicated_sharding(self.mesh))
         else:
             state = jax.device_put(state)
         self.state = state
         if is_main_process():
+            note = "" if used == path else f" (fell back to {used})"
             print(
-                f"Resuming training from snapshot at Epoch {self.epochs_run}",
+                f"Resuming training from snapshot at Epoch {self.epochs_run}"
+                f"{note}",
                 flush=True,
             )
 
@@ -288,6 +322,9 @@ class Trainer:
 
     def _run_batch(self, batch) -> float:
         """One optimizer step (twin of ``_run_batch``, ``single_gpu.py:21-26``)."""
+        # Chaos hook: deterministic "kill/hang worker N at step S" fires here
+        # (exact no-op unless TPURUN_FAULT_PLAN is armed).
+        _chaos_on_step()
         self.state, loss = self.train_step(self.state, batch)
         self._touch_heartbeat()
         return loss

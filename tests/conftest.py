@@ -29,3 +29,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process / subprocess integration tests"
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection tests (the chaos harness); "
+        "fast CPU-only injections run in tier-1, long drills are also "
+        "marked slow",
+    )

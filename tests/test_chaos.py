@@ -1,0 +1,797 @@
+"""Chaos harness tests: deterministic fault injection (``chaos.py``), the
+hardened store client (reconnect/backoff/request-id dedup), and self-healing
+checksummed snapshots.
+
+Everything here is CPU-only and seeded. The fast tests (unmarked beyond
+``chaos``) run in tier-1; the end-to-end drill at the bottom — the ISSUE's
+acceptance drill: worker kill + 2s store partition + snapshot corruption in
+one seeded plan — is also marked ``slow``.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu import chaos
+from distributed_pytorch_tpu.chaos import Fault, FaultPlan, FaultProxy
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_chaos_plan():
+    """The module caches the parsed plan per process; tests that arm the env
+    var need a clean slate on both sides."""
+    chaos._reset()
+    yield
+    chaos._reset()
+
+
+# ----------------------------------------------------------------- FaultPlan
+
+
+class TestFaultPlan:
+    def test_parse_inline_and_file_roundtrip(self, tmp_path):
+        spec = {
+            "seed": 7,
+            "faults": [
+                {"kind": "kill", "process_id": 1, "at_step": 3},
+                {"kind": "corrupt_snapshot", "at_save": 2, "mode": "truncate"},
+            ],
+        }
+        inline = FaultPlan.from_spec(json.dumps(spec))
+        path = tmp_path / "plan.json"
+        path.write_text(json.dumps(spec))
+        from_file = FaultPlan.from_spec(str(path))
+        for plan in (inline, from_file):
+            assert plan.seed == 7
+            assert [f.kind for f in plan.faults] == ["kill", "corrupt_snapshot"]
+            assert plan.faults[0].at_step == 3
+        # to_spec -> from_spec is stable (what the agent hands to workers)
+        again = FaultPlan.from_spec(inline.to_spec())
+        assert [vars(f) for f in again.faults] == [vars(f) for f in inline.faults]
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            Fault(kind="meteor")
+
+    def test_kill_fires_at_exact_step_in_matching_process_only(self, tmp_path):
+        script = textwrap.dedent(
+            """
+            import os
+            from distributed_pytorch_tpu.chaos import FaultPlan
+            plan = FaultPlan.from_spec(os.environ["TPURUN_FAULT_PLAN"])
+            for i in range(6):
+                plan.on_step()
+                print("step", i + 1, flush=True)
+            """
+        )
+        plan = json.dumps(
+            {"faults": [{"kind": "kill", "process_id": 1, "at_step": 3}]}
+        )
+
+        def run(process_id):
+            return subprocess.run(
+                [sys.executable, "-c", script],
+                env={
+                    **os.environ,
+                    "PYTHONPATH": REPO,
+                    "TPURUN_FAULT_PLAN": plan,
+                    "PROCESS_ID": process_id,
+                },
+                capture_output=True,
+                text=True,
+                timeout=60,
+            )
+
+        hit = run("1")
+        assert hit.returncode == -9  # SIGKILL: uncatchable, like kill -9
+        assert "[chaos] SIGKILL self at step 3" in hit.stdout
+        # The loop never reached its own step-3 print (fault fires first).
+        assert "\nstep 3" not in hit.stdout
+        miss = run("0")  # same plan, wrong process: no fault
+        assert miss.returncode == 0 and "step 6" in miss.stdout
+
+    def test_restart_generation_matching(self, monkeypatch):
+        monkeypatch.setenv("TPURUN_RESTART_COUNT", "1")
+        fired = []
+        plan = FaultPlan([Fault(kind="hang", at_step=1, restart=0, duration=0.2)])
+        plan._fire = lambda f: fired.append(f)  # observe without sleeping
+        plan.on_step()
+        assert fired == []  # restart=0 fault must not fire at restart 1
+        plan2 = FaultPlan([Fault(kind="hang", at_step=1, restart=1, duration=0.2)])
+        plan2._fire = lambda f: fired.append(f)
+        plan2.on_step()
+        assert len(fired) == 1
+
+    def test_hang_sleeps_for_duration_then_resumes(self):
+        plan = FaultPlan([Fault(kind="hang", at_step=2, duration=0.3)])
+        start = time.monotonic()
+        plan.on_step()
+        assert time.monotonic() - start < 0.2  # step 1: no fault
+        plan.on_step()
+        assert time.monotonic() - start >= 0.3  # step 2: slept
+        plan.on_step()  # fire-once: step 3 does not sleep again
+        assert time.monotonic() - start < 0.7
+
+    def test_corrupt_file_is_deterministic(self, tmp_path):
+        payload = bytes(range(256)) * 64
+        a, b = tmp_path / "a.bin", tmp_path / "b.bin"
+        a.write_bytes(payload)
+        b.write_bytes(payload)
+        chaos.corrupt_file(str(a), mode="flip", seed=5)
+        chaos.corrupt_file(str(b), mode="flip", seed=5)
+        assert a.read_bytes() == b.read_bytes() != payload
+        chaos.corrupt_file(str(a), mode="truncate")
+        assert len(a.read_bytes()) == len(payload) // 2
+
+
+# ---------------------------------------------------------------- FaultProxy
+
+
+class TestFaultProxy:
+    @pytest.fixture()
+    def store(self):
+        from distributed_pytorch_tpu.elastic.store import KVStoreServer
+
+        port = free_port()
+        with KVStoreServer(port) as server:
+            yield server, port
+
+    def test_forwards_then_partitions_then_heals(self, store):
+        from distributed_pytorch_tpu.elastic.store import KVStoreClient
+
+        _, port = store
+        with FaultProxy("127.0.0.1", port) as proxy:
+            client = KVStoreClient(
+                proxy.host, proxy.port, retry_deadline=10.0
+            )
+            client.set("k", "v")
+            assert client.get("k") == "v"
+
+            proxy.partition()
+            fail_fast = KVStoreClient(
+                proxy.host, proxy.port, connect_timeout=2.0, retry_deadline=0.0
+            )
+            with pytest.raises((ConnectionError, OSError)):
+                fail_fast.get("k")
+            fail_fast.close()
+
+            proxy.heal()
+            # The retrying client rides out the partition transparently.
+            assert client.get("k") == "v"
+            client.close()
+
+    def test_client_survives_timed_partition_mid_wait_ge(self, store):
+        """A 1s partition injected while wait_ge is in flight: the hardened
+        client reconnects and re-issues, and the op still completes once the
+        target is reached through the REAL store."""
+        from distributed_pytorch_tpu.elastic.store import KVStoreClient
+
+        _, port = store
+        with FaultProxy("127.0.0.1", port) as proxy:
+            client = KVStoreClient(proxy.host, proxy.port, retry_deadline=15.0)
+            result = {}
+
+            def waiter():
+                result["v"] = client.wait_ge("joined", 2, timeout=20.0)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.2)  # wait_ge is blocking server-side now
+            proxy.partition(duration=1.0)
+            time.sleep(0.3)
+            with KVStoreClient("127.0.0.1", port) as direct:  # bypass proxy
+                direct.add("joined", 2)
+            t.join(timeout=15)
+            assert result.get("v") == 2
+            client.close()
+
+    def test_apply_plan_schedules_partition(self, store):
+        from distributed_pytorch_tpu.elastic.store import KVStoreClient
+
+        _, port = store
+        plan = FaultPlan(
+            [Fault(kind="store_partition", at_time=0.2, duration=0.5)]
+        )
+        with FaultProxy("127.0.0.1", port) as proxy:
+            proxy.apply_plan(plan)
+            client = KVStoreClient(proxy.host, proxy.port, retry_deadline=10.0)
+            client.set("a", "1")
+            time.sleep(0.4)  # now inside the scheduled partition window
+            assert proxy._partitioned.is_set()
+            assert client.get("a") == "1"  # retried through heal
+            client.close()
+
+
+# ------------------------------------------------------- store client hardening
+
+
+class TestStoreClientHardening:
+    def test_buffer_reset_after_timeout_mid_reply(self):
+        """Satellite #1 regression: a server that stalls after sending HALF a
+        reply must not poison the next request. The old client kept the
+        partial frame in ``_buf`` and would have parsed ``VAL ha`` as the
+        next reply; the hardened client drops socket + buffer on the timeout
+        and answers the next request from a clean stream."""
+        from distributed_pytorch_tpu.elastic.store import KVStoreClient
+
+        listener = socket.socket()
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(2)
+        port = listener.getsockname()[1]
+
+        def serve():
+            # Connection 1: read the request, send a partial reply, stall.
+            conn1, _ = listener.accept()
+            conn1.recv(1024)
+            conn1.sendall(b"VAL poison")  # no newline: a torn reply
+            # Connection 2 (the client's reconnect): behave correctly.
+            conn2, _ = listener.accept()
+            conn2.recv(1024)
+            conn2.sendall(b"VAL clean\n")
+            time.sleep(1.0)
+            for c in (conn1, conn2):
+                c.close()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        client = KVStoreClient("127.0.0.1", port, retry_deadline=0.0)
+        with pytest.raises(OSError):  # socket.timeout mid-reply
+            client._simple("GET", "k", timeout=0.5)
+        assert client._buf == b""  # the poisoned frame is GONE
+        assert client._sock is None
+        assert client.get("k") == "clean"  # fresh stream, clean parse
+        client.close()
+        listener.close()
+
+    def test_survives_server_restart_mid_wait_ge(self):
+        """Acceptance criterion: kill and relaunch the real store process
+        while a wait_ge is in flight; the client reconnects, re-issues, and
+        later requests parse cleanly (no data loss, no misparsed replies)."""
+        from distributed_pytorch_tpu.elastic.store import (
+            KVStoreClient,
+            KVStoreServer,
+        )
+
+        port = free_port()
+        server = KVStoreServer(port)
+        client = KVStoreClient("127.0.0.1", port, retry_deadline=15.0)
+        result = {}
+
+        def waiter():
+            result["v"] = client.wait_ge("done", 2, timeout=20.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.3)  # the WAITGE is blocking inside the server
+        server._proc.kill()  # hard kill: no goodbye byte on the wire
+        server._proc.wait()
+        server.close()
+        relaunched = KVStoreServer(port)
+        try:
+            with KVStoreClient("127.0.0.1", port) as other:
+                other.add("done", 2)
+            t.join(timeout=15)
+            assert result.get("v") == 2
+            # The surviving client's stream is clean for subsequent traffic.
+            client.set("x", "y")
+            assert client.get("x") == "y"
+        finally:
+            client.close()
+            with KVStoreClient("127.0.0.1", port) as admin:
+                admin.shutdown_server()
+            relaunched.close()
+
+    def test_mutating_retry_replays_instead_of_reapplying(self):
+        """The dedup contract at the wire level: the same request id replays
+        the recorded reply; a fresh id re-applies."""
+        from distributed_pytorch_tpu.elastic.store import (
+            KVStoreClient,
+            KVStoreServer,
+        )
+
+        port = free_port()
+        with KVStoreServer(port):
+            raw = socket.create_connection(("127.0.0.1", port))
+            raw.sendall(b"ADD ctr 5 rid-a\n")
+            assert raw.recv(64) == b"VAL 5\n"
+            raw.sendall(b"ADD ctr 5 rid-a\n")  # the lost-reply retry
+            assert raw.recv(64) == b"VAL 5\n"  # replayed, NOT re-applied
+            raw.sendall(b"GET ctr\n")
+            assert raw.recv(64) == b"VAL 5\n"
+            raw.sendall(b"ADD ctr 5 rid-b\n")  # distinct id: a real add
+            assert raw.recv(64) == b"VAL 10\n"
+            raw.close()
+            with KVStoreClient("127.0.0.1", port) as admin:
+                admin.shutdown_server()
+
+    def test_client_sends_request_ids_on_mutations_only(self):
+        """SET/ADD/DEL carry a dedup token; GET stays bare (idempotent ops
+        need no replay memory on the server)."""
+        from distributed_pytorch_tpu.elastic.store import KVStoreClient
+
+        listener = socket.socket()
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        port = listener.getsockname()[1]
+        lines = []
+
+        def serve():
+            conn, _ = listener.accept()
+            buf = b""
+            while len(lines) < 3:
+                buf += conn.recv(1024)
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    lines.append(line.decode())
+                    reply = b"VAL 1\n" if line.startswith((b"ADD", b"GET")) else b"OK\n"
+                    conn.sendall(reply)
+            conn.close()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        client = KVStoreClient("127.0.0.1", port, retry_deadline=0.0)
+        client.set("k", "v")
+        client.add("c", 1)
+        client.get("k")
+        t.join(timeout=5)
+        client.close()
+        listener.close()
+        assert len(lines[0].split()) == 4  # SET key value reqid
+        assert len(lines[1].split()) == 4  # ADD key delta reqid
+        assert len(lines[2].split()) == 2  # GET key — bare
+        assert lines[0].split()[3] != lines[1].split()[3]  # ids are unique
+
+    def test_retry_deadline_bounds_unreachable_host(self):
+        """Blip vs dead: a store that never answers surfaces ConnectionError
+        only after (roughly) retry_deadline — the agent's 'rendezvous host
+        dead' signal."""
+        from distributed_pytorch_tpu.elastic.store import (
+            KVStoreClient,
+            KVStoreServer,
+        )
+
+        port = free_port()
+        server = KVStoreServer(port)
+        client = KVStoreClient("127.0.0.1", port, retry_deadline=1.5)
+        server._proc.kill()
+        server._proc.wait()
+        server.close()
+        start = time.monotonic()
+        with pytest.raises(ConnectionError, match="retry deadline"):
+            client.get("k")
+        elapsed = time.monotonic() - start
+        assert 1.0 <= elapsed < 10.0
+        client.close()
+
+    def test_server_close_closes_stdout_pipe(self):
+        """Satellite #2: the readiness PIPE must not leak an fd per store
+        lifecycle."""
+        from distributed_pytorch_tpu.elastic.store import KVStoreServer
+
+        server = KVStoreServer(free_port())
+        pipe = server._proc.stdout
+        assert pipe is not None and not pipe.closed
+        server.close()
+        assert pipe.closed
+
+
+# ------------------------------------------------------ snapshot self-healing
+
+
+def _tree(value: float):
+    return {
+        "w": np.full((8, 8), value, np.float32),
+        "b": np.full((8,), value, np.float32),
+    }
+
+
+class TestSnapshotIntegrity:
+    def test_roundtrip_keeps_meta_clean(self, tmp_path):
+        from distributed_pytorch_tpu.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        path = str(tmp_path / "c.npz")
+        save_checkpoint(path, _tree(1.0), metadata={"epoch": 4})
+        tree, meta = load_checkpoint(path, _tree(0.0))
+        assert meta == {"epoch": 4}  # integrity plumbing stripped
+        np.testing.assert_array_equal(tree["w"], _tree(1.0)["w"])
+
+    def test_bitflip_and_truncation_fail_loudly(self, tmp_path):
+        from distributed_pytorch_tpu.checkpoint import load_snapshot, save_snapshot
+
+        for mode in ("flip", "truncate"):
+            path = str(tmp_path / f"{mode}.npz")
+            save_snapshot(path, _tree(1.0), epochs_run=1)
+            chaos.corrupt_file(path, mode=mode, seed=11)
+            with pytest.raises(Exception):  # zip CRC or SnapshotIntegrityError
+                load_snapshot(path, _tree(0.0))
+
+    def test_manifest_catches_tampering_the_zip_crc_misses(self, tmp_path):
+        """Rewrite the npz with one array's bytes changed but internally
+        consistent zip CRCs (what a buggy writer or post-hoc edit produces):
+        only the embedded manifest can catch this."""
+        from distributed_pytorch_tpu.checkpoint import (
+            SnapshotIntegrityError,
+            load_snapshot,
+            save_snapshot,
+        )
+
+        path = str(tmp_path / "t.npz")
+        save_snapshot(path, _tree(1.0), epochs_run=1)
+        with np.load(path) as data:
+            arrays = {k: np.array(data[k]) for k in data.files}
+        arrays["w"][0, 0] += 1.0  # tamper one value
+        np.savez(path, **arrays)  # fresh, self-consistent zip CRCs
+        with pytest.raises(SnapshotIntegrityError, match="checksum mismatch"):
+            load_snapshot(path, _tree(0.0))
+
+    def test_rotation_keeps_previous_snapshot(self, tmp_path):
+        from distributed_pytorch_tpu.checkpoint import load_snapshot, save_snapshot
+
+        path = str(tmp_path / "s.npz")
+        save_snapshot(path, _tree(1.0), epochs_run=1)
+        save_snapshot(path, _tree(2.0), epochs_run=2)
+        _, epochs_prev = load_snapshot(path + ".prev", _tree(0.0))
+        _, epochs_cur = load_snapshot(path, _tree(0.0))
+        assert (epochs_prev, epochs_cur) == (1, 2)
+
+    def test_fallback_quarantines_corrupt_latest(self, tmp_path, capfd):
+        from distributed_pytorch_tpu.checkpoint import (
+            load_snapshot_with_fallback,
+            save_snapshot,
+        )
+
+        path = str(tmp_path / "s.npz")
+        save_snapshot(path, _tree(1.0), epochs_run=1)
+        save_snapshot(path, _tree(2.0), epochs_run=2)
+        chaos.corrupt_file(path, mode="flip", seed=1)
+        state, epochs, used = load_snapshot_with_fallback(path, _tree(0.0))
+        assert epochs == 1 and used == path + ".prev"
+        np.testing.assert_array_equal(state["w"], _tree(1.0)["w"])
+        assert os.path.exists(path + ".corrupt")
+        assert "quarantined" in capfd.readouterr().err
+
+    def test_all_corrupt_returns_none_with_loud_warning(self, tmp_path, capfd):
+        from distributed_pytorch_tpu.checkpoint import (
+            load_snapshot_with_fallback,
+            save_snapshot,
+        )
+
+        path = str(tmp_path / "s.npz")
+        save_snapshot(path, _tree(1.0), epochs_run=1)
+        save_snapshot(path, _tree(2.0), epochs_run=2)
+        chaos.corrupt_file(path, mode="truncate")
+        chaos.corrupt_file(path + ".prev", mode="truncate")
+        assert load_snapshot_with_fallback(path, _tree(0.0)) is None
+        err = capfd.readouterr().err
+        assert "start FRESH" in err
+
+    def test_missing_snapshot_is_silent(self, tmp_path, capfd):
+        from distributed_pytorch_tpu.checkpoint import load_snapshot_with_fallback
+
+        assert (
+            load_snapshot_with_fallback(str(tmp_path / "nope.npz"), _tree(0.0))
+            is None
+        )
+        err = capfd.readouterr().err  # a first run is not an incident
+        assert "WARNING" not in err and "quarantined" not in err
+
+    def test_manager_restore_falls_back_past_corrupt_latest(self, tmp_path, capfd):
+        from distributed_pytorch_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path / "c"), keep=3)
+        mgr.save(_tree(1.0), step=1, epochs_run=1)
+        time.sleep(0.02)  # distinct mtimes: recency order must be stable
+        mgr.save(_tree(2.0), step=2, epochs_run=2)
+        latest = os.path.join(str(tmp_path / "c"), "ckpt_0000000002.npz")
+        chaos.corrupt_file(latest, mode="truncate")
+        tree, meta = mgr.restore(_tree(0.0))
+        assert meta["epochs_run"] == 1
+        np.testing.assert_array_equal(tree["w"], _tree(1.0)["w"])
+        assert os.path.exists(latest + ".corrupt")
+        assert "quarantined" in capfd.readouterr().err
+
+    def test_plan_corrupts_snapshot_write_via_env(self, tmp_path, monkeypatch):
+        """End-to-end checkpointer hook: an armed corrupt_snapshot fault
+        damages the SECOND write; the first (rotated to .prev) remains the
+        recovery point."""
+        from distributed_pytorch_tpu.checkpoint import (
+            load_snapshot_with_fallback,
+            save_snapshot,
+        )
+
+        monkeypatch.setenv(
+            chaos.ENV_VAR,
+            json.dumps(
+                {"faults": [{"kind": "corrupt_snapshot", "at_save": 2,
+                             "restart": None, "mode": "flip"}]}
+            ),
+        )
+        chaos._reset()
+        path = str(tmp_path / "s.npz")
+        save_snapshot(path, _tree(1.0), epochs_run=1)
+        save_snapshot(path, _tree(2.0), epochs_run=2)  # fault fires here
+        state, epochs, used = load_snapshot_with_fallback(path, _tree(0.0))
+        assert epochs == 1 and used == path + ".prev"
+
+
+# --------------------------------------------------- Trainer corrupt-resume
+
+
+class TestTrainerCorruptResume:
+    """Satellite #3: the Trainer-level contract — quarantine the corrupt
+    latest, resume from the previous rotated snapshot with a visible notice,
+    and never silently start fresh while a valid older snapshot exists."""
+
+    def _trainer(self, tmp_path, **kwargs):
+        import optax
+
+        from distributed_pytorch_tpu.models import ToyRegressor
+        from distributed_pytorch_tpu.training.trainer import Trainer
+        from distributed_pytorch_tpu.utils.data import (
+            MaterializedDataset,
+            ShardedLoader,
+        )
+
+        return Trainer(
+            ToyRegressor(),
+            ShardedLoader(MaterializedDataset(64), 16),
+            optax.sgd(1e-2),
+            save_every=1,
+            snapshot_path=str(tmp_path / "snap.npz"),
+            checkpoint_path=str(tmp_path / "ckpt.npz"),
+            **kwargs,
+        )
+
+    def test_resume_falls_back_to_previous_rotated_snapshot(
+        self, tmp_path, capfd
+    ):
+        trainer = self._trainer(tmp_path)
+        trainer.train(2)  # snap.npz (epochs 2) + snap.npz.prev (epochs 1)
+        snap = str(tmp_path / "snap.npz")
+        chaos.corrupt_file(snap, mode="flip", seed=2)
+        capfd.readouterr()  # drop the training chatter
+
+        resumed = self._trainer(tmp_path)
+        out = capfd.readouterr()
+        assert resumed.epochs_run == 1  # .prev, not fresh
+        assert os.path.exists(snap + ".corrupt")
+        assert "quarantined" in out.err
+        assert "fell back to" in out.out
+        # And training continues to completion from the fallback point.
+        resumed.train(3)
+        final = self._trainer(tmp_path)
+        assert final.epochs_run == 3
+
+    def test_all_corrupt_starts_fresh_loudly(self, tmp_path, capfd):
+        trainer = self._trainer(tmp_path)
+        trainer.train(2)
+        chaos.corrupt_file(str(tmp_path / "snap.npz"), mode="truncate")
+        chaos.corrupt_file(str(tmp_path / "snap.npz.prev"), mode="truncate")
+        capfd.readouterr()
+        fresh = self._trainer(tmp_path)
+        assert fresh.epochs_run == 0
+        assert "start FRESH" in capfd.readouterr().err
+
+    def test_prev_only_resumes_after_crash_between_rotate_and_write(
+        self, tmp_path
+    ):
+        """A crash in the window between rotation and the new write leaves
+        only <path>.prev on disk; probe-on-init must still resume from it."""
+        trainer = self._trainer(tmp_path)
+        trainer.train(2)
+        os.unlink(str(tmp_path / "snap.npz"))  # the interrupted write
+        resumed = self._trainer(tmp_path)
+        assert resumed.epochs_run == 1
+
+
+# -------------------------------------------------------- agent-level drills
+
+
+AGENT_TIMEOUT = 180
+
+
+def run_tpurun(tmp_path, worker_src, *args, timeout=AGENT_TIMEOUT, extra_env=None):
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent(worker_src))
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "distributed_pytorch_tpu.elastic", *args, str(worker)],
+        env=env,
+        cwd=tmp_path,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+class TestAgentStoreBlip:
+    def test_two_agents_survive_store_partition(self, tmp_path):
+        """Satellite #4, agent level: a 2s store partition (injected by the
+        FaultProxy each agent wires up from the armed plan) mid-run is a
+        BLIP — both agents retry through it, no generation bump, exit 0."""
+        port = free_port()
+        plan = json.dumps(
+            {"faults": [{"kind": "store_partition", "restart": None,
+                         "at_time": 1.0, "duration": 2.0}]}
+        )
+        worker_src = """
+        import os, time
+        time.sleep(5)  # long enough that the partition happens mid-run
+        open(f"done.{os.environ['PROCESS_ID']}", "w").write("ok")
+        """
+        results = {}
+
+        def launch(rank):
+            results[rank] = run_tpurun(
+                tmp_path,
+                worker_src,
+                "--nnodes", "2",
+                "--node-rank", str(rank),
+                "--nproc-per-node", "1",
+                "--rdzv-endpoint", f"127.0.0.1:{port}",
+                "--max-restarts", "1",
+                "--store-retry-deadline", "20",
+                extra_env={"TPURUN_FAULT_PLAN": plan},
+            )
+
+        threads = [
+            threading.Thread(target=launch, args=(r,)) for r in (0, 1)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=AGENT_TIMEOUT)
+        for rank in (0, 1):
+            res = results[rank]
+            assert res.returncode == 0, res.stdout + res.stderr
+            assert "restart" not in res.stdout  # a blip, not a failure
+            assert "FaultProxy" in res.stdout  # the proxy was actually used
+        assert sorted(p.name for p in tmp_path.glob("done.*")) == [
+            "done.0",
+            "done.1",
+        ]
+
+
+DRILL_WORKER = """
+'''The acceptance drill's worker: a REAL rung-4 training process. All fault
+injection comes from the seeded TPURUN_FAULT_PLAN in the environment — the
+worker body contains no sabotage.'''
+import os, runpy, sys
+
+pid = os.environ["PROCESS_ID"]
+restart = os.environ["TPURUN_RESTART_COUNT"]
+open(f"gen.{pid}.{restart}", "w").write("ok")
+
+sys.argv = [
+    "multihost_pod.py", "3", "1",
+    "--snapshot_path", "drill.npz",
+    "--fake_devices", "2",
+]
+runpy.run_path(os.environ["POD_EXAMPLE"], run_name="__main__")
+"""
+
+# The seeded acceptance plan. Per-process epochs are 16 steps (2048 samples /
+# 2 shards / batch 64); snapshots save every epoch.
+#  gen 0: worker 1 SIGKILLed at step 21 (6 steps into epoch 1)
+#  gen 1: resumes from the epoch-1 snapshot; process 0's first save there
+#         (epochs_run=2) is bit-flipped right after the write; worker 1 is
+#         killed again at step 21 (5 steps into epoch 2); a 2s store
+#         partition also hits each agent's store client at t=3s
+#  gen 2: the corrupt latest is quarantined, resume falls back to .prev
+#         (epochs_run=1), training re-runs epochs 1-2 and completes.
+DRILL_PLAN = {
+    "seed": 42,
+    "faults": [
+        {"kind": "kill", "process_id": 1, "restart": 0, "at_step": 21},
+        {"kind": "corrupt_snapshot", "process_id": 0, "restart": 1,
+         "at_save": 1, "mode": "flip"},
+        {"kind": "kill", "process_id": 1, "restart": 1, "at_step": 21},
+        {"kind": "store_partition", "restart": None, "at_time": 3.0,
+         "duration": 2.0},
+    ],
+}
+
+
+class TestSeededDrill:
+    @pytest.mark.slow
+    def test_kill_partition_corruption_drill_completes_deterministically(
+        self, tmp_path
+    ):
+        """ISSUE acceptance: a seeded FaultPlan combining worker kill, a 2s
+        store partition, and snapshot corruption completes training with the
+        correct final epoch count on CPU in < 60s, and the surviving epoch
+        losses match an uninterrupted run bit-for-bit (rtol 1e-6)."""
+        start = time.monotonic()
+        result = run_tpurun(
+            tmp_path,
+            DRILL_WORKER,
+            "--standalone",
+            "--nproc-per-node", "2",
+            "--max-restarts", "2",
+            "--store-retry-deadline", "20",
+            timeout=AGENT_TIMEOUT,
+            extra_env={
+                "POD_EXAMPLE": os.path.join(REPO, "examples", "multihost_pod.py"),
+                "TPURUN_FAULT_PLAN": json.dumps(DRILL_PLAN),
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                "JAX_PLATFORMS": "cpu",
+            },
+        )
+        drill_elapsed = time.monotonic() - start
+        assert result.returncode == 0, result.stdout + result.stderr
+        assert drill_elapsed < 60, f"drill took {drill_elapsed:.1f}s"
+
+        # Three generations ran (two restarts used).
+        markers = {p.name for p in tmp_path.glob("gen.*")}
+        assert {"gen.0.0", "gen.0.1", "gen.0.2"} <= markers
+        assert "restart 2/2" in result.stdout
+        # Generation 2 resumed via the fallback chain, not fresh.
+        assert "fell back to" in result.stdout
+        assert (tmp_path / "drill.npz.corrupt").exists()
+        # The final epoch count is correct: all 3 epochs trained.
+        losses = {}
+        for line in result.stdout.splitlines():
+            if line.startswith("{"):
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "epoch_loss" in rec:
+                    losses[int(rec["epoch"])] = rec["epoch_loss"]
+        assert set(losses) == {0, 1, 2}, f"epochs seen: {sorted(losses)}"
+
+        # Determinism: identical to the same workload with no faults at all
+        # (one process, 4 virtual chips, same global batch of 128).
+        clean = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "examples", "multihost_pod.py"),
+                "3", "1",
+                "--snapshot_path", str(tmp_path / "clean.npz"),
+                "--fake_devices", "4",
+            ],
+            cwd=tmp_path,
+            env={
+                **os.environ,
+                "PYTHONPATH": REPO,
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            },
+            capture_output=True,
+            text=True,
+            timeout=AGENT_TIMEOUT,
+        )
+        assert clean.returncode == 0, clean.stdout + clean.stderr
+        clean_losses = {}
+        for line in clean.stdout.splitlines():
+            if line.startswith("{"):
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "epoch_loss" in rec:
+                    clean_losses[int(rec["epoch"])] = rec["epoch_loss"]
+        for epoch, loss in clean_losses.items():
+            np.testing.assert_allclose(losses[epoch], loss, rtol=1e-6)

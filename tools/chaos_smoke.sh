@@ -1,0 +1,127 @@
+#!/usr/bin/env bash
+# Seeded 2-node chaos drill on CPU: worker kill, hung worker, corrupt
+# snapshot, and a 2s store partition — one FaultPlan, one run, deterministic.
+#
+#   bash tools/chaos_smoke.sh
+#
+# What it proves (the full failure-model matrix of docs/ARCHITECTURE.md in
+# one pass):
+#   * generation 0: worker 1 is SIGKILLed at step 21 -> restart-the-world;
+#   * generation 1: process 0's first snapshot write (epochs_run=2) is
+#     bit-flipped right after landing on disk, and worker 1 HANGS at step 21
+#     (alive but silent) -> the --worker-heartbeat-timeout detector fires;
+#   * each agent's store traffic is cut for 2s at t=3 (FaultProxy) -> ridden
+#     out inside --store-retry-deadline, no spurious restart;
+#   * generation 2: the corrupt latest snapshot is quarantined (.corrupt),
+#     resume falls back to <snapshot>.prev, training completes all 3 epochs.
+#
+# Unlike the <60s pytest drill (tests/test_chaos.py::TestSeededDrill), this
+# includes the HANG fault: detecting a hang needs a worker-heartbeat window
+# larger than JAX startup, so this script trades the tight wall-clock bound
+# for coverage of the hung-worker path.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+REPO="$PWD"
+
+WORK="$(mktemp -d /tmp/chaos_smoke.XXXXXX)"
+trap 'rm -rf "$WORK"' EXIT
+echo "[chaos_smoke] workdir: $WORK"
+
+PORT=$(python - <<'EOF'
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0)); print(s.getsockname()[1]); s.close()
+EOF
+)
+
+cat > "$WORK/worker.py" <<'EOF'
+import os, runpy, sys
+pid = os.environ["PROCESS_ID"]
+restart = os.environ["TPURUN_RESTART_COUNT"]
+open(f"gen.{pid}.{restart}", "w").write("ok")
+sys.argv = ["multihost_pod.py", "3", "1",
+            "--snapshot_path", "smoke.npz", "--fake_devices", "2"]
+runpy.run_path(os.environ["POD_EXAMPLE"], run_name="__main__")
+EOF
+
+# 16 steps per epoch per process (2048 samples / 2 shards / batch 64):
+# step 21 is mid-epoch-2 in gen 0, and mid-epoch-2-again after the gen-1
+# resume from the epoch-1 snapshot.
+FAULT_PLAN='{
+  "seed": 42,
+  "faults": [
+    {"kind": "kill", "process_id": 1, "restart": 0, "at_step": 21},
+    {"kind": "corrupt_snapshot", "process_id": 0, "restart": 1,
+     "at_save": 1, "mode": "flip"},
+    {"kind": "hang", "process_id": 1, "restart": 1, "at_step": 21,
+     "duration": 600},
+    {"kind": "store_partition", "restart": null, "at_time": 3.0,
+     "duration": 2.0}
+  ]
+}'
+
+COMMON_ENV=(
+  "PYTHONPATH=$REPO"
+  "POD_EXAMPLE=$REPO/examples/multihost_pod.py"
+  "TPURUN_FAULT_PLAN=$FAULT_PLAN"
+  "JAX_PLATFORMS=cpu"
+  "XLA_FLAGS=--xla_force_host_platform_device_count=2"
+)
+
+cd "$WORK"
+pids=()
+for RANK in 0 1; do
+  env "${COMMON_ENV[@]}" python -u -m distributed_pytorch_tpu.elastic \
+    --nnodes 2 --node-rank "$RANK" --nproc-per-node 1 \
+    --rdzv-endpoint "127.0.0.1:$PORT" \
+    --max-restarts 2 \
+    --worker-heartbeat-timeout 30 \
+    --store-retry-deadline 20 \
+    worker.py > "agent$RANK.log" 2>&1 &
+  pids+=($!)
+done
+
+rc=0
+for p in "${pids[@]}"; do
+  wait "$p" || rc=$?
+done
+for RANK in 0 1; do
+  echo "--- agent$RANK.log"
+  cat "agent$RANK.log"
+done
+if [ "$rc" -ne 0 ]; then
+  echo "[chaos_smoke] FAIL: agent exited with $rc"
+  exit 1
+fi
+
+fail() { echo "[chaos_smoke] FAIL: $1"; exit 1; }
+ALL="$(cat agent0.log agent1.log)"
+
+grep -q "SIGKILL self"              <<<"$ALL" || fail "gen-0 kill never fired"
+grep -q "corrupting snapshot write" <<<"$ALL" || fail "snapshot corruption never fired"
+grep -q "hanging for"               <<<"$ALL" || fail "gen-1 hang never fired"
+grep -q "restart 2/2"               <<<"$ALL" || fail "expected two restarts"
+grep -q "fell back to"              <<<"$ALL" || fail "resume did not use the .prev fallback"
+grep -q "quarantined"               <<<"$ALL" || fail "corrupt snapshot was not quarantined"
+[ -e smoke.npz.corrupt ]                      || fail "no .corrupt quarantine file"
+[ -e gen.0.2 ]                                || fail "generation 2 never started"
+
+# All three epochs trained, exactly once each in the surviving timeline.
+python - <<'EOF'
+import json, sys
+losses = {}
+for name in ("agent0.log", "agent1.log"):
+    for line in open(name):
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "epoch_loss" in rec:
+                losses[int(rec["epoch"])] = rec["epoch_loss"]
+if set(losses) != {0, 1, 2}:
+    sys.exit(f"epochs trained: {sorted(losses)} (wanted 0,1,2)")
+print(f"[chaos_smoke] epochs 0-2 complete; final epoch loss {losses[2]:.6f}")
+EOF
+
+echo "[chaos_smoke] PASS"

@@ -42,7 +42,15 @@ from typing import List, Optional
 
 ENV_VAR = "TPURUN_FAULT_PLAN"
 
-_KINDS = ("kill", "hang", "exit", "corrupt_snapshot", "store_partition")
+_KINDS = (
+    "kill",
+    "hang",
+    "exit",
+    "preempt",
+    "drain",
+    "corrupt_snapshot",
+    "store_partition",
+)
 
 
 @dataclass
@@ -63,10 +71,15 @@ class Fault:
     Kinds: ``kill`` (SIGKILL self — uncatchable, the external ``kill -9``
     twin), ``hang`` (sleep ``duration`` seconds, or effectively forever when
     0 — alive but silent, the SIGSTOP/wedged-collective twin), ``exit``
-    (clean nonzero exit with ``exit_code``), ``corrupt_snapshot`` (truncate
-    or bit-flip the just-written checkpoint file, per ``mode``), and
-    ``store_partition`` (drop store connections for ``duration`` seconds —
-    consumed by :class:`FaultProxy`, not by workers).
+    (clean nonzero exit with ``exit_code``), ``preempt`` (SIGTERM the parent
+    agent — a maintenance event / spot reclaim notice; when ``duration`` > 0
+    a background timer escalates to SIGKILL on the agent after that many
+    seconds, modelling the platform's hard grace deadline), ``drain``
+    (alias ``drain_at_step``: touch this worker's own ``TPURUN_DRAIN_FILE``
+    then SIGTERM self — a drain request delivered straight to the worker),
+    ``corrupt_snapshot`` (truncate or bit-flip the just-written checkpoint
+    file, per ``mode``), and ``store_partition`` (drop store connections for
+    ``duration`` seconds — consumed by :class:`FaultProxy`, not by workers).
     """
 
     kind: str
@@ -80,6 +93,8 @@ class Fault:
     exit_code: int = 13
 
     def __post_init__(self):
+        if self.kind == "drain_at_step":
+            self.kind = "drain"
         if self.kind not in _KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}; one of {_KINDS}")
         if self.mode not in ("flip", "truncate"):
@@ -184,7 +199,7 @@ class FaultPlan:
             self._steps += 1
             step = self._steps
         for i, fault in enumerate(self.faults):
-            if fault.kind not in ("kill", "hang", "exit"):
+            if fault.kind not in ("kill", "hang", "exit", "preempt", "drain"):
                 continue
             if i in self._fired or fault.at_step != step:
                 continue
@@ -232,6 +247,36 @@ class FaultPlan:
                 flush=True,
             )
             time.sleep(duration)
+        elif fault.kind == "drain":
+            drain_file = os.environ.get("TPURUN_DRAIN_FILE")
+            if drain_file:
+                with open(drain_file, "w") as f:
+                    f.write("chaos\n")
+            print(f"[chaos] drain request (self) at step {self._steps}", flush=True)
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif fault.kind == "preempt":
+            ppid = os.getppid()
+            print(
+                f"[chaos] preempting agent pid {ppid} at step {self._steps}"
+                + (f" (SIGKILL after {fault.duration:.0f}s)" if fault.duration > 0 else ""),
+                flush=True,
+            )
+            if fault.duration > 0:
+                # The platform's hard deadline: grace elapses, the plug is
+                # pulled regardless of drain progress.
+                def _escalate(target=ppid):
+                    try:
+                        os.kill(target, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+
+                timer = threading.Timer(fault.duration, _escalate)
+                timer.daemon = True
+                timer.start()
+            try:
+                os.kill(ppid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
 
 
 # ------------------------------------------------------- process-wide plan

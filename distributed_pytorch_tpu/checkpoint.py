@@ -284,11 +284,22 @@ class AsyncCheckpointer:
         self._thread = threading.Thread(target=write, daemon=True)
         self._thread.start()
 
-    def save_snapshot(self, path: str, state: Any, epochs_run: int) -> None:
+    def save_snapshot(
+        self,
+        path: str,
+        state: Any,
+        epochs_run: int,
+        *,
+        step_in_epoch: int = 0,
+        extra_meta: Optional[Dict] = None,
+    ) -> None:
         """Async variant of :func:`save_snapshot` (same metadata schema and
         the same ``.prev`` rotation)."""
         self.save(
-            path, state, metadata=_snapshot_meta(epochs_run), keep_previous=True
+            path,
+            state,
+            metadata=_snapshot_meta(epochs_run, step_in_epoch, extra_meta),
+            keep_previous=True,
         )
 
     def wait(self) -> None:
@@ -361,44 +372,73 @@ def _align_to_template(
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
-def _snapshot_meta(epochs_run: int) -> Dict:
+def _snapshot_meta(
+    epochs_run: int,
+    step_in_epoch: int = 0,
+    extra: Optional[Dict] = None,
+) -> Dict:
     """The snapshot metadata schema — single definition shared by the sync
-    and async save paths (load_snapshot reads the same key)."""
-    return {"epochs_run": int(epochs_run)}
+    and async save paths (load_snapshot reads the same keys).
+
+    ``step_in_epoch`` > 0 marks a MID-epoch (just-in-time drain) snapshot:
+    ``epochs_run`` epochs are complete and ``step_in_epoch`` batches of epoch
+    ``epochs_run`` have already been applied to the state. ``extra`` merges
+    arbitrary JSON-able resume context (the Trainer stores the loader's order
+    state and the partial-epoch loss sums there)."""
+    meta = {"epochs_run": int(epochs_run), "step_in_epoch": int(step_in_epoch)}
+    if extra:
+        meta.update(extra)
+    return meta
 
 
-def save_snapshot(path: str, state: Any, epochs_run: int) -> None:
+def save_snapshot(
+    path: str,
+    state: Any,
+    epochs_run: int,
+    *,
+    step_in_epoch: int = 0,
+    extra_meta: Optional[Dict] = None,
+) -> None:
     """Elastic-training snapshot: full TrainState + progress marker.
 
     Twin of ``Trainer._save_snapshot`` (reference ``multigpu_torchrun.py:57-62``,
     which stores ``{MODEL_STATE, EPOCHS_RUN}``). Rotates the previous snapshot
     to ``<path>.prev`` so resume always has a fallback candidate.
+    ``step_in_epoch``/``extra_meta`` make the snapshot step-granular (the
+    preemption drain path — see :func:`_snapshot_meta`).
     """
     save_checkpoint(
-        path, state, metadata=_snapshot_meta(epochs_run), keep_previous=True
+        path,
+        state,
+        metadata=_snapshot_meta(epochs_run, step_in_epoch, extra_meta),
+        keep_previous=True,
     )
 
 
-def load_snapshot(path: str, template: Any) -> Tuple[Any, int]:
-    """Restore a snapshot; returns ``(state, epochs_run)``.
+def load_snapshot(path: str, template: Any) -> Tuple[Any, Dict]:
+    """Restore a snapshot; returns ``(state, meta)`` where ``meta`` carries at
+    least ``epochs_run`` and ``step_in_epoch`` (see :func:`_snapshot_meta`;
+    both default to 0 for snapshots written before the schema carried them).
 
     Twin of ``Trainer._load_snapshot`` (reference ``multigpu_torchrun.py:36-40``).
     """
     state, meta = load_checkpoint(path, template)
-    return state, int(meta.get("epochs_run", 0))
+    meta.setdefault("epochs_run", 0)
+    meta.setdefault("step_in_epoch", 0)
+    return state, meta
 
 
 def load_snapshot_with_fallback(
     path: str, template: Any
-) -> Optional[Tuple[Any, int, str]]:
+) -> Optional[Tuple[Any, Dict, str]]:
     """Self-healing snapshot resume: try ``path``, then ``<path>.prev``.
 
     A candidate that exists but fails to load — checksum mismatch, torn zip,
     missing leaves — is quarantined (renamed ``.corrupt``) with a loud
-    warning, and the chain moves on. Returns ``(state, epochs_run,
-    used_path)`` from the first loadable candidate, or ``None`` when no
-    candidate exists at all (silent: a first run) or every candidate was
-    corrupt (loud: the caller starts fresh knowing data was lost).
+    warning, and the chain moves on. Returns ``(state, meta, used_path)``
+    from the first loadable candidate, or ``None`` when no candidate exists
+    at all (silent: a first run) or every candidate was corrupt (loud: the
+    caller starts fresh knowing data was lost).
 
     On shared-filesystem multi-process runs every process walks the same
     chain; the quarantine rename is first-writer-wins and the losers simply
@@ -409,8 +449,8 @@ def load_snapshot_with_fallback(
         return None
     for cand in candidates:
         try:
-            state, epochs = load_snapshot(cand, template)
-            return state, epochs, cand
+            state, meta = load_snapshot(cand, template)
+            return state, meta, cand
         except Exception as e:
             dest = quarantine(cand)
             print(

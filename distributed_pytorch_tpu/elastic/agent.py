@@ -29,6 +29,18 @@ that machinery, TPU-native:
   ``--max-restarts``. Training survives because the Trainer's snapshot
   contract (probe-on-init, epoch-offset resume — reference
   ``multigpu_torchrun.py:30-40,57-65``) makes workers idempotent.
+* **Preemption drain** — SIGTERM on an agent (a maintenance event / spot
+  reclaim notice) starts a graceful drain instead of a teardown: the agent
+  publishes ``drain/<gen>`` in the store, touches each worker's
+  ``TPURUN_DRAIN_FILE`` and soft-signals SIGTERM; the Trainer finishes the
+  in-flight step, takes a just-in-time STEP-granular snapshot (all ranks
+  agree on the stop step via a per-batch collective, so no survivor ever
+  issues a collective against a vanished peer), and exits with
+  ``--preempt-exit-code``. The monitor classifies that exit — and any
+  drain-marked generation bump — as a *preemption*: the world restarts
+  WITHOUT spending ``--max-restarts`` budget, and the reclaimed node's agent
+  exits after its workers drain (survivors re-form via MIN:MAX scale-down).
+  Workers get ``--drain-grace`` seconds before SIGKILL.
 * **Elastic world size** — ``--nnodes MIN:MAX`` (the torchrun elastic form,
   reference launcher surface ``slurm/sbatch_run.sh:17-23``): when a node is
   lost for good, the next rendezvous waits ``--scale-down-grace`` seconds
@@ -87,6 +99,12 @@ JOIN_PREFIX = "tpurun/join/"  # join/<gen> counts agents present at <gen>
 MEMBER_PREFIX = "tpurun/member/"  # member/<gen>/<orig_rank> -> "1" (who joined)
 WORLD_PREFIX = "tpurun/world/"  # world/<gen> -> "0,2,..." settled membership
 HB_PREFIX = "tpurun/hb/"  # hb/<node_rank> -> monotonically increasing beat
+# drain/<gen> -> "node<rank>": generation <gen> is ending by PREEMPTION, not
+# failure. Set by the SIGTERM-caught agent BEFORE it bumps the generation, so
+# every peer (a) forwards the soft drain signal to its own workers — the
+# in-band drain barrier that stops all ranks at the same step — and (b)
+# classifies the coming restart as a preemption (restart budget intact).
+DRAIN_PREFIX = "tpurun/drain/"
 
 
 @dataclass
@@ -127,6 +145,15 @@ class ElasticConfig:
     # the agent then treats the rendezvous host as dead (WorldCompleted /
     # abort, the pre-existing paths).
     store_retry_deadline: float = 30.0
+    # Preemption drain: on SIGTERM the agent publishes drain/<gen>, touches
+    # each worker's TPURUN_DRAIN_FILE, and soft-signals SIGTERM; workers have
+    # this many seconds to finish the in-flight step and snapshot before the
+    # group is killed (size it to the platform's reclaim grace minus margin).
+    drain_grace: float = 30.0
+    # The distinguished exit code a draining worker uses (exported to workers
+    # as TPURUN_DRAIN_EXIT_CODE). The monitor classifies this exit as a
+    # preemption — restart-the-world WITHOUT decrementing --max-restarts.
+    preempt_exit_code: int = 121
     env: Dict[str, str] = field(default_factory=dict)
 
     @property
@@ -181,21 +208,57 @@ class WorkerGroup:
             import tempfile
 
             self.hb_dir = tempfile.mkdtemp(prefix="tpurun_hb_")
+        # Drain contract: each worker gets a TPURUN_DRAIN_FILE path; the
+        # agent touching it (request_drain) is the soft preemption notice the
+        # Trainer polls every batch, and TPURUN_DRAIN_EXIT_CODE is the
+        # distinguished code a drained worker exits with. The file ALSO
+        # disambiguates SIGTERM for the worker: SIGTERM with the file touched
+        # means "snapshot and go"; bare SIGTERM (a failure teardown) means
+        # "die now" — so failure restarts stay fast.
+        import tempfile as _tempfile
+
+        self.drain_dir = _tempfile.mkdtemp(prefix="tpurun_drain_")
+        self.drain_files: List[str] = []
+        self._drain_sent = False
         for local_rank in range(cfg.nproc_per_node):
             env = dict(os.environ)
             env.update(cfg.env)
+            drain_file = os.path.join(self.drain_dir, f"drain_{local_rank}")
+            self.drain_files.append(drain_file)
             env.update(
                 COORDINATOR_ADDRESS=cfg.coordinator_address,
                 NUM_PROCESSES=str(world_size),
                 PROCESS_ID=str(dense_rank * cfg.nproc_per_node + local_rank),
                 LOCAL_RANK=str(local_rank),
                 TPURUN_RESTART_COUNT=str(restart_count),
+                TPURUN_DRAIN_FILE=drain_file,
+                TPURUN_DRAIN_EXIT_CODE=str(cfg.preempt_exit_code),
             )
             if self.hb_dir is not None:
                 hb_file = os.path.join(self.hb_dir, f"hb_{local_rank}")
                 env["TPURUN_HEARTBEAT_FILE"] = hb_file
                 self.hb_files.append(hb_file)
             self.procs.append(subprocess.Popen(cmd, env=env))
+
+    def request_drain(self) -> None:
+        """Deliver the soft preemption notice to every live worker: touch its
+        drain file FIRST (so the worker's SIGTERM handler reads this as a
+        drain, not a teardown), then SIGTERM. Idempotent."""
+        if self._drain_sent:
+            return
+        self._drain_sent = True
+        for drain_file in self.drain_files:
+            try:
+                with open(drain_file, "w") as f:
+                    f.write("drain")
+            except OSError:
+                pass
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()  # SIGTERM; the drain file makes it soft
+                except OSError:
+                    pass
 
     def hung_worker(self, timeout: float) -> Optional[int]:
         """Local rank of a live worker whose heartbeat file went stale.
@@ -237,22 +300,42 @@ class WorkerGroup:
         return all(p.poll() == 0 for p in self.procs)
 
     def terminate(self, grace: float = 10.0) -> None:
+        """SIGTERM every live worker, then ESCALATE to SIGKILL for any still
+        alive past the shared ``grace`` deadline. The escalation is
+        load-bearing: a worker mid-drain-snapshot (or wedged inside one, or
+        one that installed a SIGTERM handler and got stuck) must not block
+        agent teardown forever. SIGKILL cannot be caught, so the post-kill
+        ``wait`` always returns — but it is still bounded defensively (a
+        zombie reparented by a dying init, an uninterruptible-D-state worker)
+        rather than allowed to wedge the whole restart loop."""
         for p in self.procs:
             if p.poll() is None:
-                p.terminate()
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
         deadline = time.monotonic() + grace
         for p in self.procs:
             timeout = max(0.0, deadline - time.monotonic())
             try:
                 p.wait(timeout=timeout)
             except subprocess.TimeoutExpired:
-                p.kill()
-                p.wait()
-        if self.hb_dir is not None:
-            import shutil
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+                try:
+                    p.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    pass  # unreapable (kernel-stuck); do not block teardown
+        import shutil
 
+        if self.hb_dir is not None:
             shutil.rmtree(self.hb_dir, ignore_errors=True)
             self.hb_dir = None
+        if self.drain_dir is not None:
+            shutil.rmtree(self.drain_dir, ignore_errors=True)
+            self.drain_dir = None
 
 
 class _Retry(Exception):
@@ -312,6 +395,21 @@ class ElasticAgent:
         self._joined_generations: set = set()
         # rank -> (last beat value, local monotonic time it changed)
         self._peer_beats: Dict[int, tuple] = {}
+        # Set by the SIGTERM handler (main()): THIS node is being reclaimed.
+        # Signal handlers must not touch the store client (the main thread
+        # may be mid-request on the same socket), so the handler only sets
+        # the event; the monitor loop performs the store publish + worker
+        # drain on its next 0.2s pass.
+        self._drain_requested = threading.Event()
+
+    def request_drain(self) -> bool:
+        """Begin a graceful preemption drain (signal-handler safe: flag only).
+        Returns False if a drain was already in progress — the caller should
+        then escalate to an immediate exit (second SIGTERM = die now)."""
+        if self._drain_requested.is_set():
+            return False
+        self._drain_requested.set()
+        return True
 
     # ------------------------------------------------------------- heartbeat
     def _heartbeat_loop(self) -> None:
@@ -486,6 +584,13 @@ class ElasticAgent:
         cfg = self.cfg
         self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
         self._hb_thread.start()
+        # Two counters, deliberately separate: ``spawns`` feeds the workers'
+        # TPURUN_RESTART_COUNT (the spawn GENERATION — chaos plans and any
+        # restart-keyed worker logic must see it advance on every respawn,
+        # free or not), while ``restarts`` is the --max-restarts BUDGET and
+        # only advances on real failures — a preemption drain restarts the
+        # world for free.
+        spawns = 0
         restarts = 0
         try:
             while True:
@@ -531,7 +636,7 @@ class ElasticAgent:
                         flush=True,
                     )
                 group = self._group = WorkerGroup(
-                    cfg, self.cmd, restarts, members=members
+                    cfg, self.cmd, spawns, members=members
                 )
                 failure = self._monitor(group, generation, members)
                 if failure is None:
@@ -560,10 +665,41 @@ class ElasticAgent:
                             pass  # store already gone -> world is done anyway
                         return 0
                     # else: someone failed after we finished -> fall through to restart
+                    failure = "restart requested elsewhere"
+                # Classify BEFORE terminate: a drain-marked generation ended
+                # by preemption, not failure, however this agent noticed it
+                # (drain exit code, generation bump, or its own SIGTERM).
+                preempt = failure.startswith("preempt")
+                if not preempt:
+                    try:
+                        preempt = bool(
+                            self.store.get(f"{DRAIN_PREFIX}{generation}")
+                        )
+                    except (ConnectionError, OSError):
+                        pass
                 group.terminate()
                 if self.store.get(FATAL_KEY):
                     print("[tpurun] aborting: world marked fatal", file=sys.stderr)
                     return 1
+                if self._drain_requested.is_set():
+                    # THIS node is the one being reclaimed: workers drained
+                    # (or were reaped at the grace deadline) — exit instead
+                    # of respawning, so the survivors can re-form without us
+                    # (the MIN:MAX scale-down path).
+                    print(
+                        "[tpurun] drain complete; exiting (node preempted)",
+                        flush=True,
+                    )
+                    return 143  # 128 + SIGTERM: conventional reclaim exit
+                spawns += 1
+                if preempt:
+                    print(
+                        f"[tpurun] preempt detected (gen {generation}): "
+                        f"{failure}; restart budget intact "
+                        f"({restarts}/{cfg.max_restarts} used)",
+                        flush=True,
+                    )
+                    continue
                 restarts += 1
                 if restarts > cfg.max_restarts:
                     self.store.set(FATAL_KEY, f"node{cfg.node_rank}-restarts-exhausted")
@@ -574,8 +710,7 @@ class ElasticAgent:
                     return 1
                 print(
                     f"[tpurun] failure detected (gen {generation}): "
-                    f"{failure or 'restart requested elsewhere'}; "
-                    f"restart {restarts}/{cfg.max_restarts}",
+                    f"{failure}; restart {restarts}/{cfg.max_restarts}",
                     flush=True,
                 )
         finally:
@@ -592,21 +727,80 @@ class ElasticAgent:
 
         On local failure, bumps the generation so every other agent restarts
         too (torchrun's restart-the-world semantics).
+
+        Preemption drain: a SIGTERM on THIS agent (``_drain_requested``) or a
+        ``drain/<gen>`` mark from a preempted peer starts a drain — the soft
+        notice is forwarded to the local workers (``request_drain``), which
+        finish the in-flight step, snapshot, and exit with the distinguished
+        drain code within ``--drain-grace``. A drain exit returns a
+        ``"preempt: ..."`` failure string so ``run()`` restarts the world
+        WITHOUT spending budget; the drain mark is published before the
+        generation bump so peers classify identically.
         """
         cfg = self.cfg
         last_peer_check = 0.0
         n_peers = len(members) if members is not None else cfg.nnodes
         self._seed_peer_clocks(members)
+        drain_key = f"{DRAIN_PREFIX}{generation}"
+        drain_signaled = False
+        drain_deadline: Optional[float] = None
         while True:
             code = group.poll()
             if code is not None:
+                if code == cfg.preempt_exit_code:
+                    # Drain exit: publish the mark BEFORE the bump so every
+                    # peer sees "preemption", then restart-the-world.
+                    self.store.set(drain_key, f"node{cfg.node_rank}")
+                    self.store.add(GEN_KEY, 1)
+                    return f"preempt: local worker drained (exit {code})"
                 self.store.add(GEN_KEY, 1)
                 return f"local worker exited with {code}"
             if group.all_done():
                 return None
+            if self._drain_requested.is_set() and not drain_signaled:
+                # This node is being reclaimed: publish, then soft-signal.
+                drain_signaled = True
+                drain_deadline = time.monotonic() + cfg.drain_grace
+                print(
+                    f"[tpurun] drain: SIGTERM received; workers have "
+                    f"{cfg.drain_grace:.0f}s to snapshot and exit",
+                    flush=True,
+                )
+                self.store.set(drain_key, f"node{cfg.node_rank}")
+                group.request_drain()
+            if not drain_signaled and self.store.get(drain_key):
+                # A peer is being reclaimed: forward the soft notice so our
+                # ranks join the drain at the same step (the Trainer's
+                # per-batch allgather agreement) instead of later issuing a
+                # collective against the vanished peer.
+                drain_signaled = True
+                drain_deadline = time.monotonic() + cfg.drain_grace
+                print(
+                    f"[tpurun] drain: peer preemption published for gen "
+                    f"{generation}; draining local workers",
+                    flush=True,
+                )
+                group.request_drain()
+            if drain_deadline is not None and time.monotonic() > drain_deadline:
+                # Wedged mid-drain (e.g. stuck in a snapshot barrier against
+                # a peer already gone): reap and still classify as preempt —
+                # the node WAS preempted; the resume falls back to the last
+                # durable snapshot.
+                self.store.add(GEN_KEY, 1)
+                return "preempt: drain grace expired (workers killed)"
             current_gen = int(self.store.get(GEN_KEY) or 0)
             if current_gen != generation:
-                return "remote failure (generation bumped)"
+                if self.store.get(drain_key):
+                    # The preempted node finished draining and bumped; our
+                    # workers are mid-drain — keep monitoring (bounded by
+                    # drain_deadline) so their just-in-time snapshot lands
+                    # instead of being torn apart by an instant teardown.
+                    if not drain_signaled:
+                        drain_signaled = True
+                        drain_deadline = time.monotonic() + cfg.drain_grace
+                        group.request_drain()
+                else:
+                    return "remote failure (generation bumped)"
             if self.store.get(FATAL_KEY):
                 return "fatal"
             now = time.monotonic()
@@ -754,6 +948,23 @@ def make_parser() -> argparse.ArgumentParser:
         "rendezvous host is dead; 0 disables retry (fail fast)",
     )
     p.add_argument(
+        "--drain-grace",
+        type=float,
+        default=30.0,
+        help="seconds workers get to finish the in-flight step and take a "
+        "just-in-time snapshot after a preemption SIGTERM, before the group "
+        "is killed (size to the platform's reclaim grace minus a margin)",
+    )
+    p.add_argument(
+        "--preempt-exit-code",
+        type=int,
+        default=121,
+        help="the distinguished exit code of a gracefully drained worker "
+        "(exported as TPURUN_DRAIN_EXIT_CODE); the agent classifies it as a "
+        "preemption and restarts the world WITHOUT spending --max-restarts "
+        "budget",
+    )
+    p.add_argument(
         "--standalone",
         action="store_true",
         help="single-node shorthand: nnodes=1, store on an ephemeral local port",
@@ -816,6 +1027,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         heartbeat_timeout=args.heartbeat_timeout,
         worker_heartbeat_timeout=args.worker_heartbeat_timeout,
         store_retry_deadline=args.store_retry_deadline,
+        drain_grace=args.drain_grace,
+        preempt_exit_code=args.preempt_exit_code,
     )
     agent = ElasticAgent(cfg, [sys.executable, args.script] + args.script_args)
 
@@ -823,7 +1036,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         agent.close()
         sys.exit(128 + signum)
 
-    signal.signal(signal.SIGTERM, _forward_signal)
+    def _graceful_drain(signum, frame):
+        # First SIGTERM: begin the preemption drain (publish + soft-signal
+        # happen on the monitor thread — a signal handler must not touch the
+        # store socket the main thread may be mid-request on). A SECOND
+        # SIGTERM escalates to the immediate teardown, exactly the pre-drain
+        # behavior (and what a reclaim's follow-up SIGKILL would force anyway).
+        if not agent.request_drain():
+            _forward_signal(signum, frame)
+
+    signal.signal(signal.SIGTERM, _graceful_drain)
     signal.signal(signal.SIGINT, _forward_signal)
     return agent.run()
 

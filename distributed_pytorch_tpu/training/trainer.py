@@ -22,6 +22,8 @@ only, with a cross-host barrier after the write.
 from __future__ import annotations
 
 import os
+import signal
+import threading
 from typing import Callable, Optional
 
 import jax
@@ -152,6 +154,15 @@ class Trainer:
                 checkpoint_path, keep=keep_checkpoints, mode="min"
             )
         self.epochs_run = 0
+        # Mid-epoch (drain snapshot) resume point, consumed by the first
+        # _run_epoch after a resume: skip the first _resume_step batches of
+        # epoch _resume_epoch and seed the epoch-loss mean with the partial
+        # sums accumulated before the drain (so the logged epoch_loss of a
+        # preempted-and-resumed epoch equals the un-preempted one).
+        self._resume_epoch = 0
+        self._resume_step = 0
+        self._resume_loss_sum = 0.0
+        self._resume_loss_count = 0
 
         if mesh is not None:
             data_size = mesh.shape.get("data", 1)
@@ -230,6 +241,27 @@ class Trainer:
         # worker (stuck in a collective whose peer died) is distinguishable
         # from a slow one. None outside tpurun — zero overhead.
         self._heartbeat_file = os.environ.get("TPURUN_HEARTBEAT_FILE")
+        # Preemption drain (tpurun's SIGTERM grace path): the agent touches
+        # TPURUN_DRAIN_FILE and soft-signals SIGTERM when the node is being
+        # reclaimed; either signal sets _drain_flag, and the batch loop then
+        # finishes the in-flight step, takes a just-in-time step-granular
+        # snapshot, and exits with the distinguished drain exit code so the
+        # agent classifies the death as a preemption (restart budget intact).
+        # Armed only when there is a snapshot to drain into.
+        self._drain_file = os.environ.get("TPURUN_DRAIN_FILE")
+        self._drain_exit_code = int(
+            os.environ.get("TPURUN_DRAIN_EXIT_CODE", "121")
+        )
+        self._drain_flag = False
+        self._drain_armed = snapshot_path is not None
+        if (
+            self._drain_armed
+            and threading.current_thread() is threading.main_thread()
+        ):
+            try:
+                signal.signal(signal.SIGTERM, self._on_sigterm)
+            except (ValueError, OSError):
+                pass  # embedded in a host that owns signals: file-poll only
 
     # ---------------------------------------------------------------- persistence
 
@@ -240,7 +272,8 @@ class Trainer:
             # candidate was corrupt (load_snapshot_with_fallback already
             # warned loudly and quarantined) — train from scratch.
             return
-        state, self.epochs_run, used = loaded
+        state, meta, used = loaded
+        self.epochs_run = int(meta.get("epochs_run", 0))
         if self.state_sharding is not None:
             state = _put_host_state(state, self.state_sharding)
         elif self.mesh is not None:
@@ -248,11 +281,33 @@ class Trainer:
         else:
             state = jax.device_put(state)
         self.state = state
+        # Mid-epoch (drain) snapshot: step_in_epoch batches of epoch
+        # epochs_run are already in the restored state. Resuming at the exact
+        # batch is only sound if the loader reproduces the drained run's
+        # batch order — otherwise (e.g. num_shards changed after a
+        # scale-down) replay the epoch from batch 0: re-applying a batch is
+        # safe for coverage, skipping one is not.
+        step = int(meta.get("step_in_epoch", 0))
+        step_note = ""
+        if step > 0:
+            if self.train_data.matches_order_state(meta.get("order")):
+                self._resume_epoch = self.epochs_run
+                self._resume_step = step
+                self._resume_loss_sum = float(meta.get("loss_sum", 0.0))
+                self._resume_loss_count = int(meta.get("loss_count", 0))
+                step_note = f", step {step}"
+            elif is_main_process():
+                print(
+                    f"[drain] snapshot was taken at step {step} of epoch "
+                    f"{self.epochs_run} under a different loader geometry; "
+                    f"replaying the epoch from step 0",
+                    flush=True,
+                )
         if is_main_process():
             note = "" if used == path else f" (fell back to {used})"
             print(
                 f"Resuming training from snapshot at Epoch {self.epochs_run}"
-                f"{note}",
+                f"{step_note}{note}",
                 flush=True,
             )
 
@@ -341,20 +396,103 @@ class Trainer:
         except OSError:
             pass
 
+    # ------------------------------------------------------------------ drain
+
+    def _on_sigterm(self, signum, frame) -> None:
+        """Preemption notice via direct signal delivery. Under tpurun a BARE
+        SIGTERM (drain file absent) is the agent tearing the group down for a
+        failure-restart — die immediately, as before this handler existed,
+        so restarts stay fast; only a SIGTERM accompanying a touched drain
+        file (or any SIGTERM outside tpurun) means "snapshot and go"."""
+        if self._drain_file is not None and not os.path.exists(self._drain_file):
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+            return
+        self._drain_flag = True
+
+    def _drain_due(self) -> bool:
+        """Poll the drain flag at a step boundary. Multi-process runs under
+        the agent agree on the flag COLLECTIVELY every batch: the notice can
+        reach ranks at different wall times, and a rank stopping to snapshot
+        (a barrier) while another still runs the train step (a collective)
+        would deadlock the world — the allgather is the in-band drain
+        barrier that makes every rank stop at the identical step."""
+        local = self._drain_flag or (
+            self._drain_file is not None and os.path.exists(self._drain_file)
+        )
+        if (
+            self._drain_armed
+            and self._drain_file is not None
+            and jax.process_count() > 1
+        ):
+            from jax.experimental import multihost_utils
+
+            flags = multihost_utils.process_allgather(
+                np.asarray(local, dtype=np.int32)
+            )
+            return bool(np.max(flags))
+        return local
+
+    def _drain_exit(self, epoch: int, steps_done: int, loss_sum: float,
+                    loss_count: int) -> None:
+        """Just-in-time snapshot at the current step, then exit with the
+        drain code (the agent classifies it as a preemption, not a failure).
+        Always a SYNCHRONOUS save — the process is about to die, so the
+        write must be durable before the grace window closes."""
+        self._touch_heartbeat()
+        if self.checkpointer is not None:
+            self.checkpointer.wait()  # order behind any in-flight async write
+        extra = {
+            "order": self.train_data.order_state(),
+            "loss_sum": float(loss_sum),
+            "loss_count": int(loss_count),
+        }
+        save_snapshot(
+            self.snapshot_path, self.state, epochs_run=epoch,
+            step_in_epoch=steps_done, extra_meta=extra,
+        )
+        self._touch_heartbeat()
+        if is_main_process():
+            print(
+                f"[drain] just-in-time snapshot at epoch {epoch}, step "
+                f"{steps_done} -> {self.snapshot_path}; exiting with code "
+                f"{self._drain_exit_code}",
+                flush=True,
+            )
+        # SystemExit (not os._exit) so train()'s finally still stops the
+        # profiler and closes metrics before the interpreter exits.
+        raise SystemExit(self._drain_exit_code)
+
+    # ---------------------------------------------------------------- epochs
+
     def _run_epoch(self, epoch: int) -> float:
         """One pass over this process's shard (twin of ``_run_epoch``,
         ``single_gpu.py:28-34``). Returns the mean loss over the epoch."""
         self.train_data.set_epoch(epoch)
         n_batches = len(self.train_data)
+        start = 0
+        carry_sum, carry_count = 0.0, 0
+        if self._resume_step and epoch == self._resume_epoch:
+            # Resuming from a mid-epoch drain snapshot: the first
+            # _resume_step batches are already in the state; their losses are
+            # carried so this epoch's logged mean spans the whole epoch.
+            start = self._resume_step
+            carry_sum = self._resume_loss_sum
+            carry_count = self._resume_loss_count
+        self._resume_step = 0  # one-shot: later epochs start at batch 0
         if is_main_process():
+            resume_note = f" (resuming at step {start})" if start else ""
             print(
                 f"[proc{jax.process_index()}] Epoch {epoch} | "
-                f"Batchsize: {self.train_data.batch_size} | Steps: {n_batches}",
+                f"Batchsize: {self.train_data.batch_size} | Steps: {n_batches}"
+                f"{resume_note}",
                 flush=True,
             )
         losses = []
         last_loss = None
-        for i, (xs, ys) in enumerate(self.train_data):
+        for i, (xs, ys) in enumerate(
+            self.train_data.iter_batches(start), start=start
+        ):
             batch = self._put_batch(xs, ys)
             loss = self._run_batch(batch)
             losses.append(loss)
@@ -365,7 +503,19 @@ class Trainer:
             if self.log_every and (i + 1) % self.log_every == 0:
                 last_loss = float(loss)
                 self.metrics.log(int(self.state.step), loss=last_loss, epoch=epoch)
-        epoch_loss = float(np.mean([float(l) for l in losses])) if losses else 0.0
+            if self._drain_armed and self._drain_due():
+                host_losses = [float(l) for l in losses]
+                self._drain_exit(
+                    epoch,
+                    steps_done=i + 1,
+                    loss_sum=carry_sum + float(np.sum(host_losses)),
+                    loss_count=carry_count + len(host_losses),
+                )
+        total = carry_sum + (
+            float(np.sum([float(l) for l in losses])) if losses else 0.0
+        )
+        count = carry_count + len(losses)
+        epoch_loss = total / count if count else 0.0
         self.metrics.log(int(self.state.step), epoch_loss=epoch_loss, epoch=epoch)
         return epoch_loss
 

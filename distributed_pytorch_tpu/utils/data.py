@@ -232,12 +232,41 @@ class ShardedLoader:
             rows.append(chunk)
         return rows
 
-    def __iter__(self) -> Iterator[Batch]:
-        for chunk in self.batch_index_table():
+    def order_state(self) -> dict:
+        """The parameters that determine this epoch's batch order — the
+        resumable-iteration contract behind mid-epoch (drain) snapshots. A
+        resumed process whose loader :meth:`matches_order_state` will, after
+        ``set_epoch(epoch)``, yield the IDENTICAL batch sequence, so
+        ``iter_batches(start_batch=k)`` continues exactly where a drained
+        run stopped."""
+        return {
+            "seed": int(self.seed),
+            "shuffle": bool(self.shuffle),
+            "num_shards": int(self.num_shards),
+            "batch_size": int(self.batch_size),
+            "dataset_size": int(len(self.dataset)),
+        }
+
+    def matches_order_state(self, state) -> bool:
+        """True iff a saved :meth:`order_state` describes this loader's batch
+        order (same sharding geometry, seed, and dataset) — i.e. a mid-epoch
+        ``start_batch`` recorded under that state is still meaningful here.
+        False after e.g. an elastic scale-down changed ``num_shards``: the
+        caller must replay the epoch from batch 0 instead."""
+        return isinstance(state, dict) and state == self.order_state()
+
+    def iter_batches(self, start_batch: int = 0) -> Iterator[Batch]:
+        """Iterate this epoch's batches, optionally skipping the first
+        ``start_batch`` of them (mid-epoch resume: batches already applied to
+        the restored state before a drain snapshot must not be replayed)."""
+        for chunk in self.batch_index_table()[start_batch:]:
             samples = [self.dataset[int(i)] for i in chunk]
             xs = np.stack([s[0] for s in samples])
             ys = np.stack([s[1] for s in samples])
             yield xs, ys
+
+    def __iter__(self) -> Iterator[Batch]:
+        return self.iter_batches()
 
 
 class NativeShardedLoader(ShardedLoader):
@@ -299,12 +328,12 @@ class NativeShardedLoader(ShardedLoader):
                     "__getitem__ transforms the stored arrays"
                 )
 
-    def __iter__(self) -> Iterator[Batch]:
+    def iter_batches(self, start_batch: int = 0) -> Iterator[Batch]:
         import ctypes
 
         from distributed_pytorch_tpu.native import prefetch_library
 
-        rows = self.batch_index_table()
+        rows = self.batch_index_table()[start_batch:]
         full = [r for r in rows if len(r) == self.batch_size]
         ragged = rows[len(full):]  # at most one short final batch
 

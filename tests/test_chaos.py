@@ -1,15 +1,16 @@
 """Chaos harness tests: deterministic fault injection (``chaos.py``), the
-hardened store client (reconnect/backoff/request-id dedup), and self-healing
-checksummed snapshots.
+hardened store client (reconnect/backoff/request-id dedup), self-healing
+checksummed snapshots, and the preemption drain protocol.
 
 Everything here is CPU-only and seeded. The fast tests (unmarked beyond
-``chaos``) run in tier-1; the end-to-end drill at the bottom — the ISSUE's
-acceptance drill: worker kill + 2s store partition + snapshot corruption in
-one seeded plan — is also marked ``slow``.
+``chaos``) run in tier-1; the end-to-end drills at the bottom — the seeded
+kill + partition + corruption + preemption drill and the SIGTERM-mid-epoch
+drain-and-resume parity drill — are also marked ``slow``.
 """
 
 import json
 import os
+import signal
 import socket
 import subprocess
 import sys
@@ -140,6 +141,137 @@ class TestFaultPlan:
         assert a.read_bytes() == b.read_bytes() != payload
         chaos.corrupt_file(str(a), mode="truncate")
         assert len(a.read_bytes()) == len(payload) // 2
+
+
+# -------------------------------------------------- drain / preempt faults
+
+
+class TestDrainPreemptFaults:
+    def test_drain_at_step_alias_normalized(self):
+        fault = Fault(kind="drain_at_step", at_step=5)
+        assert fault.kind == "drain"
+        # And it round-trips through the spec the agent hands to workers.
+        plan = FaultPlan.from_spec(FaultPlan([fault]).to_spec())
+        assert plan.faults[0].kind == "drain"
+
+    def test_drain_touches_file_and_sigterms_self(self, tmp_path):
+        """The in-worker drain fault: touch TPURUN_DRAIN_FILE first (so the
+        worker's SIGTERM handler reads 'snapshot and go'), then SIGTERM self.
+        A handler-less subprocess just dies -15; the file proves the order."""
+        drain_file = tmp_path / "drain_0"
+        script = textwrap.dedent(
+            """
+            import os
+            from distributed_pytorch_tpu.chaos import FaultPlan
+            plan = FaultPlan.from_spec(os.environ["TPURUN_FAULT_PLAN"])
+            for i in range(4):
+                plan.on_step()
+                print("step", i + 1, flush=True)
+            """
+        )
+        result = subprocess.run(
+            [sys.executable, "-c", script],
+            env={
+                **os.environ,
+                "PYTHONPATH": REPO,
+                "TPURUN_FAULT_PLAN": json.dumps(
+                    {"faults": [{"kind": "drain", "at_step": 2}]}
+                ),
+                "TPURUN_DRAIN_FILE": str(drain_file),
+            },
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert result.returncode == -15  # SIGTERM, default disposition
+        assert "[chaos] drain request (self) at step 2" in result.stdout
+        assert drain_file.read_text() == "chaos\n"  # written BEFORE the kill
+        assert "\nstep 2" not in result.stdout
+
+    def test_bare_sigterm_without_drain_file_still_kills_trainer(self, tmp_path):
+        """The disambiguation that keeps FAILURE restarts fast: under tpurun
+        (TPURUN_DRAIN_FILE exported) a SIGTERM with the file NOT touched is a
+        teardown, not a drain — the Trainer's handler re-raises the default
+        disposition and dies immediately instead of latching the flag."""
+        script = textwrap.dedent(
+            """
+            import os, signal
+            import optax
+            from distributed_pytorch_tpu.models import ToyRegressor
+            from distributed_pytorch_tpu.training.trainer import Trainer
+            from distributed_pytorch_tpu.utils.data import (
+                MaterializedDataset, ShardedLoader,
+            )
+            trainer = Trainer(
+                ToyRegressor(), ShardedLoader(MaterializedDataset(32), 16),
+                optax.sgd(1e-2), save_every=1, snapshot_path="s.npz",
+            )
+            os.kill(os.getpid(), signal.SIGTERM)
+            print("survived", flush=True)  # must never be reached
+            """
+        )
+        result = subprocess.run(
+            [sys.executable, "-c", script],
+            env={
+                **os.environ,
+                "PYTHONPATH": REPO,
+                "JAX_PLATFORMS": "cpu",
+                "TPURUN_DRAIN_FILE": str(tmp_path / "never_touched"),
+            },
+            cwd=tmp_path,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert result.returncode == -15, result.stdout + result.stderr
+        assert "survived" not in result.stdout
+
+    def test_preempt_sigterms_parent_then_escalates_to_sigkill(self, tmp_path):
+        """The preempt fault models a spot reclaim: SIGTERM the PARENT (the
+        agent) now, SIGKILL it after the grace window. Two-level subprocess:
+        the 'agent' installs a SIGTERM handler and refuses to die — only the
+        escalation can end it, and the marker proves SIGTERM came first."""
+        (tmp_path / "child.py").write_text(
+            textwrap.dedent(
+                """
+                import os, time
+                from distributed_pytorch_tpu.chaos import FaultPlan
+                plan = FaultPlan.from_spec(os.environ["TPURUN_FAULT_PLAN"])
+                plan.on_step()  # fires preempt at step 1
+                time.sleep(5)   # keep the escalation timer alive, as a live worker would
+                """
+            )
+        )
+        parent_script = textwrap.dedent(
+            """
+            import os, signal, subprocess, sys, time
+            signal.signal(
+                signal.SIGTERM,
+                lambda *a: open("parent_got_sigterm", "w").write("ok"),
+            )
+            child = subprocess.Popen([sys.executable, "child.py"])
+            child.wait()
+            time.sleep(60)  # refuse to exit: only SIGKILL can end this
+            """
+        )
+        result = subprocess.run(
+            [sys.executable, "-c", parent_script],
+            env={
+                **os.environ,
+                "PYTHONPATH": REPO,
+                "TPURUN_FAULT_PLAN": json.dumps(
+                    {"faults": [{"kind": "preempt", "at_step": 1, "duration": 1.0}]}
+                ),
+            },
+            cwd=tmp_path,
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert result.returncode == -9  # the escalation, not the SIGTERM
+        assert (tmp_path / "parent_got_sigterm").exists()  # soft signal landed first
+        assert "[chaos] preempting agent pid" in result.stdout
+        assert "SIGKILL after 1s" in result.stdout
 
 
 # ---------------------------------------------------------------- FaultProxy
@@ -451,9 +583,9 @@ class TestSnapshotIntegrity:
         path = str(tmp_path / "s.npz")
         save_snapshot(path, _tree(1.0), epochs_run=1)
         save_snapshot(path, _tree(2.0), epochs_run=2)
-        _, epochs_prev = load_snapshot(path + ".prev", _tree(0.0))
-        _, epochs_cur = load_snapshot(path, _tree(0.0))
-        assert (epochs_prev, epochs_cur) == (1, 2)
+        _, meta_prev = load_snapshot(path + ".prev", _tree(0.0))
+        _, meta_cur = load_snapshot(path, _tree(0.0))
+        assert (meta_prev["epochs_run"], meta_cur["epochs_run"]) == (1, 2)
 
     def test_fallback_quarantines_corrupt_latest(self, tmp_path, capfd):
         from distributed_pytorch_tpu.checkpoint import (
@@ -465,8 +597,8 @@ class TestSnapshotIntegrity:
         save_snapshot(path, _tree(1.0), epochs_run=1)
         save_snapshot(path, _tree(2.0), epochs_run=2)
         chaos.corrupt_file(path, mode="flip", seed=1)
-        state, epochs, used = load_snapshot_with_fallback(path, _tree(0.0))
-        assert epochs == 1 and used == path + ".prev"
+        state, meta, used = load_snapshot_with_fallback(path, _tree(0.0))
+        assert meta["epochs_run"] == 1 and used == path + ".prev"
         np.testing.assert_array_equal(state["w"], _tree(1.0)["w"])
         assert os.path.exists(path + ".corrupt")
         assert "quarantined" in capfd.readouterr().err
@@ -485,6 +617,10 @@ class TestSnapshotIntegrity:
         assert load_snapshot_with_fallback(path, _tree(0.0)) is None
         err = capfd.readouterr().err
         assert "start FRESH" in err
+        # BOTH bad files were quarantined for post-mortem, not left loadable.
+        assert os.path.exists(path + ".corrupt")
+        assert os.path.exists(path + ".prev.corrupt")
+        assert not os.path.exists(path) and not os.path.exists(path + ".prev")
 
     def test_missing_snapshot_is_silent(self, tmp_path, capfd):
         from distributed_pytorch_tpu.checkpoint import load_snapshot_with_fallback
@@ -531,8 +667,8 @@ class TestSnapshotIntegrity:
         path = str(tmp_path / "s.npz")
         save_snapshot(path, _tree(1.0), epochs_run=1)
         save_snapshot(path, _tree(2.0), epochs_run=2)  # fault fires here
-        state, epochs, used = load_snapshot_with_fallback(path, _tree(0.0))
-        assert epochs == 1 and used == path + ".prev"
+        state, meta, used = load_snapshot_with_fallback(path, _tree(0.0))
+        assert meta["epochs_run"] == 1 and used == path + ".prev"
 
 
 # --------------------------------------------------- Trainer corrupt-resume
@@ -592,6 +728,10 @@ class TestTrainerCorruptResume:
         fresh = self._trainer(tmp_path)
         assert fresh.epochs_run == 0
         assert "start FRESH" in capfd.readouterr().err
+        # Both corrupt files quarantined — the fresh start is loud AND leaves
+        # the evidence behind.
+        assert os.path.exists(str(tmp_path / "snap.npz") + ".corrupt")
+        assert os.path.exists(str(tmp_path / "snap.npz.prev") + ".corrupt")
 
     def test_prev_only_resumes_after_crash_between_rotate_and_write(
         self, tmp_path
@@ -674,6 +814,226 @@ class TestAgentStoreBlip:
         ]
 
 
+class TestPreemptClassification:
+    """The acceptance criterion 'a drain exit is never misclassified': the
+    agent's log shows ``preempt`` (budget intact) for drain exits and
+    ``failure`` (budget decremented) for real crashes."""
+
+    def test_drain_exit_restarts_for_free(self, tmp_path):
+        """A worker exiting with the drain code restarts the world WITHOUT
+        spending budget: --max-restarts 0 still reaches the second spawn."""
+        result = run_tpurun(
+            tmp_path,
+            """
+            import os, sys
+            restart = int(os.environ["TPURUN_RESTART_COUNT"])
+            open(f"gen.{restart}", "w").write("ok")
+            sys.exit(int(os.environ["TPURUN_DRAIN_EXIT_CODE"]) if restart == 0 else 0)
+            """,
+            "--standalone",
+            "--nproc-per-node", "1",
+            "--max-restarts", "0",
+        )
+        assert result.returncode == 0, result.stdout + result.stderr
+        assert "preempt detected" in result.stdout
+        assert "restart budget intact (0/0 used)" in result.stdout
+        assert "failure detected" not in result.stdout
+        assert (tmp_path / "gen.0").exists() and (tmp_path / "gen.1").exists()
+
+    def test_real_crash_still_decrements_budget(self, tmp_path):
+        """A SIGKILLed worker is a FAILURE: the restart is paid for."""
+        result = run_tpurun(
+            tmp_path,
+            """
+            import os, signal, sys
+            if int(os.environ["TPURUN_RESTART_COUNT"]) == 0:
+                os.kill(os.getpid(), signal.SIGKILL)
+            sys.exit(0)
+            """,
+            "--standalone",
+            "--nproc-per-node", "1",
+            "--max-restarts", "1",
+        )
+        assert result.returncode == 0, result.stdout + result.stderr
+        assert "failure detected" in result.stdout
+        assert "restart 1/1" in result.stdout
+        assert "preempt detected" not in result.stdout
+
+    def test_persistent_failure_exhausts_budget(self, tmp_path):
+        result = run_tpurun(
+            tmp_path,
+            "import sys\nsys.exit(7)\n",
+            "--standalone",
+            "--nproc-per-node", "1",
+            "--max-restarts", "0",
+        )
+        assert result.returncode == 1
+        assert "giving up after 0 restarts" in result.stderr
+        assert "preempt detected" not in result.stdout
+
+    def test_agent_sigterm_drains_workers_and_exits_143(self, tmp_path):
+        """The tentpole's agent half, end to end: SIGTERM the agent; it
+        forwards the soft notice (drain file + SIGTERM), the workers exit
+        with the drain code, and the agent exits 143 instead of respawning."""
+        worker = tmp_path / "worker.py"
+        worker.write_text(
+            textwrap.dedent(
+                """
+                import os, signal, sys, time
+                flag = {"term": False}
+                signal.signal(
+                    signal.SIGTERM, lambda *a: flag.__setitem__("term", True)
+                )
+                pid = os.environ["PROCESS_ID"]
+                drain_file = os.environ["TPURUN_DRAIN_FILE"]
+                open(f"ready.{pid}", "w").write("ok")
+                deadline = time.time() + 60
+                while time.time() < deadline:
+                    if flag["term"] or os.path.exists(drain_file):
+                        open(f"drained.{pid}", "w").write("ok")
+                        sys.exit(int(os.environ["TPURUN_DRAIN_EXIT_CODE"]))
+                    time.sleep(0.05)
+                sys.exit(3)  # never drained: a real failure
+                """
+            )
+        )
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "distributed_pytorch_tpu.elastic",
+                "--standalone",
+                "--nproc-per-node", "2",
+                "--max-restarts", "0",
+                "--drain-grace", "20",
+                str(worker),
+            ],
+            env=dict(os.environ, PYTHONPATH=REPO),
+            cwd=tmp_path,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        try:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if (tmp_path / "ready.0").exists() and (tmp_path / "ready.1").exists():
+                    break
+                assert proc.poll() is None, proc.communicate()
+                time.sleep(0.1)
+            else:
+                pytest.fail("workers never became ready")
+            proc.send_signal(signal.SIGTERM)
+            out, err = proc.communicate(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+        assert proc.returncode == 143, out + err
+        assert "[tpurun] drain: SIGTERM received" in out
+        assert "[tpurun] drain complete; exiting (node preempted)" in out
+        assert (tmp_path / "drained.0").exists()
+        assert (tmp_path / "drained.1").exists()
+
+
+class TestWorkerGroupTerminate:
+    def test_sigterm_ignorer_escalated_to_sigkill_within_grace(self, tmp_path):
+        """Satellite #1: terminate() must not hang on a worker that ignores
+        SIGTERM — past the grace deadline it escalates to SIGKILL."""
+        from distributed_pytorch_tpu.elastic.agent import (
+            ElasticConfig,
+            WorkerGroup,
+        )
+
+        marker = tmp_path / "ignoring"
+        script = (
+            "import signal, time\n"
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+            f"open({str(marker)!r}, 'w').write('ok')\n"
+            "time.sleep(600)\n"
+        )
+        group = WorkerGroup(
+            ElasticConfig(), [sys.executable, "-c", script], 0
+        )
+        try:
+            deadline = time.monotonic() + 30
+            while not marker.exists():
+                assert time.monotonic() < deadline, "worker never started"
+                time.sleep(0.05)
+            start = time.monotonic()
+            group.terminate(grace=1.0)
+            elapsed = time.monotonic() - start
+        finally:
+            for p in group.procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        assert group.procs[0].poll() == -9, "SIGTERM ignorer was not SIGKILLed"
+        assert elapsed < 8.0, f"terminate took {elapsed:.1f}s for grace=1.0"
+
+
+class TestAsyncCheckpointerKilledMidWrite:
+    def test_prev_survives_sigkill_between_rotate_and_write(self, tmp_path):
+        """Satellite #3: SIGKILL a process whose AsyncCheckpointer has rotated
+        the old snapshot to .prev but not finished the new write — the .prev
+        must remain loadable (the drain/resume recovery point)."""
+        script = textwrap.dedent(
+            """
+            import time
+            import numpy as np
+            from distributed_pytorch_tpu import checkpoint
+            from distributed_pytorch_tpu.checkpoint import (
+                AsyncCheckpointer,
+                save_snapshot,
+            )
+
+            tree1 = {"w": np.full((4,), 1.0, np.float32)}
+            tree2 = {"w": np.full((4,), 2.0, np.float32)}
+            save_snapshot("snap.npz", tree1, epochs_run=1)
+
+            def stalled_write(path, arrays):
+                # Rotation already happened on this (writer) thread; signal
+                # the parent, then model a write that never completes.
+                open("rotated", "w").write("ok")
+                time.sleep(600)
+
+            checkpoint._write_npz = stalled_write
+            ck = AsyncCheckpointer()
+            ck.save("snap.npz", tree2, metadata={"epochs_run": 2},
+                    keep_previous=True)
+            time.sleep(600)
+            """
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-c", script],
+            env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"},
+            cwd=tmp_path,
+        )
+        try:
+            deadline = time.monotonic() + 120
+            while not (tmp_path / "rotated").exists():
+                assert proc.poll() is None, "checkpoint writer died early"
+                assert time.monotonic() < deadline, "writer never reached rotate"
+                time.sleep(0.1)
+            proc.kill()  # mid-write: the torn state a real preemption leaves
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait()
+
+        from distributed_pytorch_tpu.checkpoint import (
+            load_snapshot_with_fallback,
+        )
+
+        path = str(tmp_path / "snap.npz")
+        result = load_snapshot_with_fallback(
+            path, {"w": np.zeros((4,), np.float32)}
+        )
+        assert result is not None, "no loadable snapshot survived the kill"
+        state, meta, used = result
+        assert used == path + ".prev"
+        assert meta["epochs_run"] == 1
+        np.testing.assert_array_equal(state["w"], np.full((4,), 1.0, np.float32))
+
+
 DRILL_WORKER = """
 '''The acceptance drill's worker: a REAL rung-4 training process. All fault
 injection comes from the seeded TPURUN_FAULT_PLAN in the environment — the
@@ -700,7 +1060,11 @@ runpy.run_path(os.environ["POD_EXAMPLE"], run_name="__main__")
 #         killed again at step 21 (5 steps into epoch 2); a 2s store
 #         partition also hits each agent's store client at t=3s
 #  gen 2: the corrupt latest is quarantined, resume falls back to .prev
-#         (epochs_run=1), training re-runs epochs 1-2 and completes.
+#         (epochs_run=1), training replays epoch 1 — and 5 steps in, worker 1
+#         is drain-preempted: both ranks agree on the step (the per-batch
+#         allgather), snapshot at (epoch 1, step 5), exit with the drain
+#         code. The agent classifies it as a PREEMPTION: free restart.
+#  gen 3: resumes mid-epoch at (epoch 1, step 5), finishes epochs 1-2.
 DRILL_PLAN = {
     "seed": 42,
     "faults": [
@@ -710,19 +1074,61 @@ DRILL_PLAN = {
         {"kind": "kill", "process_id": 1, "restart": 1, "at_step": 21},
         {"kind": "store_partition", "restart": None, "at_time": 3.0,
          "duration": 2.0},
+        {"kind": "drain_at_step", "process_id": 1, "restart": 2, "at_step": 5},
     ],
 }
 
 
+def epoch_losses(text):
+    """Parse the JSON metric lines a drill run prints; last write per epoch
+    wins (exactly what a resumed run produces)."""
+    losses = {}
+    for line in text.splitlines():
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "epoch_loss" in rec:
+                losses[int(rec["epoch"])] = rec["epoch_loss"]
+    return losses
+
+
+def run_clean_reference(tmp_path, name="clean.npz"):
+    """The un-faulted reference workload: one process, 4 virtual chips, same
+    global batch of 128 — bit-identical epoch losses to the faulted runs."""
+    return subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "examples", "multihost_pod.py"),
+            "3", "1",
+            "--snapshot_path", str(tmp_path / name),
+            "--fake_devices", "4",
+        ],
+        cwd=tmp_path,
+        env={
+            **os.environ,
+            "PYTHONPATH": REPO,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        },
+        capture_output=True,
+        text=True,
+        timeout=AGENT_TIMEOUT,
+    )
+
+
 class TestSeededDrill:
     @pytest.mark.slow
-    def test_kill_partition_corruption_drill_completes_deterministically(
+    def test_kill_partition_corruption_preemption_drill_deterministic(
         self, tmp_path
     ):
-        """ISSUE acceptance: a seeded FaultPlan combining worker kill, a 2s
-        store partition, and snapshot corruption completes training with the
-        correct final epoch count on CPU in < 60s, and the surviving epoch
-        losses match an uninterrupted run bit-for-bit (rtol 1e-6)."""
+        """ISSUE acceptance: a seeded FaultPlan composing worker kill, a 2s
+        store partition, snapshot corruption, AND a mid-epoch drain
+        preemption completes training with the correct final epoch count on
+        CPU, and the surviving epoch losses match an uninterrupted run
+        (rtol 1e-6). The drain restart is FREE: the --max-restarts 2 budget
+        is fully consumed by the two kills alone."""
         start = time.monotonic()
         result = run_tpurun(
             tmp_path,
@@ -741,57 +1147,107 @@ class TestSeededDrill:
         )
         drill_elapsed = time.monotonic() - start
         assert result.returncode == 0, result.stdout + result.stderr
-        assert drill_elapsed < 60, f"drill took {drill_elapsed:.1f}s"
+        assert drill_elapsed < 90, f"drill took {drill_elapsed:.1f}s"
 
-        # Three generations ran (two restarts used).
+        # FOUR generations ran on a budget of two: the two kills paid, the
+        # preemption was free.
         markers = {p.name for p in tmp_path.glob("gen.*")}
-        assert {"gen.0.0", "gen.0.1", "gen.0.2"} <= markers
+        assert {"gen.0.0", "gen.0.1", "gen.0.2", "gen.0.3"} <= markers
         assert "restart 2/2" in result.stdout
+        assert "preempt detected" in result.stdout
+        assert "restart budget intact" in result.stdout
         # Generation 2 resumed via the fallback chain, not fresh.
         assert "fell back to" in result.stdout
         assert (tmp_path / "drill.npz.corrupt").exists()
+        # The drain snapshotted at the agreed step and gen 3 resumed there.
+        assert "[drain] just-in-time snapshot at epoch 1, step 5" in result.stdout
+        assert "Resuming training from snapshot at Epoch 1, step 5" in result.stdout
         # The final epoch count is correct: all 3 epochs trained.
-        losses = {}
-        for line in result.stdout.splitlines():
-            if line.startswith("{"):
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if "epoch_loss" in rec:
-                    losses[int(rec["epoch"])] = rec["epoch_loss"]
+        losses = epoch_losses(result.stdout)
         assert set(losses) == {0, 1, 2}, f"epochs seen: {sorted(losses)}"
 
-        # Determinism: identical to the same workload with no faults at all
-        # (one process, 4 virtual chips, same global batch of 128).
-        clean = subprocess.run(
-            [
-                sys.executable,
-                os.path.join(REPO, "examples", "multihost_pod.py"),
-                "3", "1",
-                "--snapshot_path", str(tmp_path / "clean.npz"),
-                "--fake_devices", "4",
-            ],
-            cwd=tmp_path,
-            env={
-                **os.environ,
-                "PYTHONPATH": REPO,
-                "JAX_PLATFORMS": "cpu",
-                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-            },
-            capture_output=True,
-            text=True,
-            timeout=AGENT_TIMEOUT,
-        )
+        # Determinism: identical to the same workload with no faults at all.
+        clean = run_clean_reference(tmp_path)
         assert clean.returncode == 0, clean.stdout + clean.stderr
-        clean_losses = {}
-        for line in clean.stdout.splitlines():
-            if line.startswith("{"):
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if "epoch_loss" in rec:
-                    clean_losses[int(rec["epoch"])] = rec["epoch_loss"]
+        clean_losses = epoch_losses(clean.stdout)
+        assert set(clean_losses) == {0, 1, 2}
         for epoch, loss in clean_losses.items():
+            np.testing.assert_allclose(losses[epoch], loss, rtol=1e-6)
+
+    @pytest.mark.slow
+    def test_sigterm_mid_epoch_drains_and_resumed_run_matches_clean(
+        self, tmp_path
+    ):
+        """ISSUE acceptance, the external-reclaim flavor: the AGENT receives
+        SIGTERM mid-epoch (a chaos ``preempt`` fault with a 30s SIGKILL
+        grace, standing in for a spot reclaim notice). The workers snapshot
+        at the current step and exit with the drain code; the agent exits
+        143 without respawning. A SECOND launch resumes from the exact batch
+        and the combined loss trajectory matches an un-preempted run."""
+        reclaimed = run_tpurun(
+            tmp_path,
+            DRILL_WORKER,
+            "--standalone",
+            "--nproc-per-node", "2",
+            "--max-restarts", "0",
+            "--drain-grace", "30",
+            timeout=AGENT_TIMEOUT,
+            extra_env={
+                "POD_EXAMPLE": os.path.join(REPO, "examples", "multihost_pod.py"),
+                # Worker 0, 5 steps into epoch 1, SIGTERMs its agent (ppid)
+                # and arms the 30s SIGKILL escalation a real reclaim carries.
+                "TPURUN_FAULT_PLAN": json.dumps({
+                    "seed": 43,
+                    "faults": [{"kind": "preempt", "process_id": 0,
+                                "restart": 0, "at_step": 21, "duration": 30.0}],
+                }),
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                "JAX_PLATFORMS": "cpu",
+            },
+        )
+        assert reclaimed.returncode == 143, reclaimed.stdout + reclaimed.stderr
+        assert "[tpurun] drain: SIGTERM received" in reclaimed.stdout
+        assert "[drain] just-in-time snapshot at epoch 1" in reclaimed.stdout
+        assert "[tpurun] drain complete; exiting (node preempted)" in reclaimed.stdout
+        # Budget untouched on the way out: no failure path ran.
+        assert "failure detected" not in reclaimed.stdout
+        assert "giving up" not in reclaimed.stderr
+
+        # The just-in-time snapshot is step-granular and mid-epoch.
+        meta = json.loads(
+            bytes(
+                np.load(tmp_path / "drill.npz")["__checkpoint_meta__"].tobytes()
+            ).decode("utf-8")
+        )
+        assert meta["epochs_run"] == 1
+        assert 0 < meta["step_in_epoch"] < 16, meta
+
+        # Relaunch (the replacement capacity): resumes at the exact batch.
+        resumed = run_tpurun(
+            tmp_path,
+            DRILL_WORKER,
+            "--standalone",
+            "--nproc-per-node", "2",
+            "--max-restarts", "0",
+            timeout=AGENT_TIMEOUT,
+            extra_env={
+                "POD_EXAMPLE": os.path.join(REPO, "examples", "multihost_pod.py"),
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                "JAX_PLATFORMS": "cpu",
+            },
+        )
+        assert resumed.returncode == 0, resumed.stdout + resumed.stderr
+        assert (
+            f"Resuming training from snapshot at Epoch 1, step "
+            f"{meta['step_in_epoch']}" in resumed.stdout
+        )
+
+        # Loss-trajectory parity: epoch 0 from the reclaimed run, epochs 1-2
+        # from the resumed one, against the un-preempted reference.
+        losses = epoch_losses(reclaimed.stdout)
+        losses.update(epoch_losses(resumed.stdout))
+        assert set(losses) == {0, 1, 2}, f"epochs seen: {sorted(losses)}"
+        clean = run_clean_reference(tmp_path, name="clean2.npz")
+        assert clean.returncode == 0, clean.stdout + clean.stderr
+        for epoch, loss in epoch_losses(clean.stdout).items():
             np.testing.assert_allclose(losses[epoch], loss, rtol=1e-6)

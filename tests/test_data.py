@@ -124,6 +124,57 @@ def test_pad_final_batch_tiny_dataset_wraps():
     assert xs.shape[0] == 8
 
 
+def test_iter_batches_start_is_exact_tail():
+    """The mid-epoch resume contract: iter_batches(k) yields exactly the
+    batches a full pass yields from position k on (same order, same contents)."""
+    ds = MaterializedDataset(100)
+    loader = ShardedLoader(ds, 16, shuffle=True, seed=3, pad_final_batch=True)
+    loader.set_epoch(2)
+    full = list(loader)
+    tail = list(loader.iter_batches(3))
+    assert len(tail) == len(full) - 3
+    for (xs_a, ys_a), (xs_b, ys_b) in zip(full[3:], tail):
+        np.testing.assert_array_equal(xs_a, xs_b)
+        np.testing.assert_array_equal(ys_a, ys_b)
+    # Skipping everything (or more) is an empty, not an error.
+    assert list(loader.iter_batches(len(full))) == []
+    assert list(loader.iter_batches(len(full) + 5)) == []
+
+
+def test_order_state_matches_same_geometry_only():
+    ds = MaterializedDataset(64)
+    loader = ShardedLoader(ds, 8, shuffle=True, num_shards=2, shard_index=0, seed=5)
+    state = loader.order_state()
+    # A loader with the same geometry (any shard_index — the order state is
+    # about the GLOBAL permutation + sharding stride) matches.
+    twin = ShardedLoader(ds, 8, shuffle=True, num_shards=2, shard_index=1, seed=5)
+    assert twin.matches_order_state(state)
+    # Changed sharding geometry (elastic scale-down), seed, batch size, or
+    # dataset must NOT match — and neither must garbage.
+    assert not ShardedLoader(ds, 8, shuffle=True, num_shards=4, seed=5).matches_order_state(state)
+    assert not ShardedLoader(ds, 8, shuffle=True, num_shards=2, seed=6).matches_order_state(state)
+    assert not ShardedLoader(ds, 16, shuffle=True, num_shards=2, seed=5).matches_order_state(state)
+    assert not ShardedLoader(MaterializedDataset(32), 8, shuffle=True, num_shards=2, seed=5).matches_order_state(state)
+    assert not loader.matches_order_state(None)
+    assert not loader.matches_order_state("stale")
+
+
+def test_native_iter_batches_start_matches_python_loader():
+    from distributed_pytorch_tpu.utils.data import NativeShardedLoader
+
+    ds = MaterializedDataset(96)
+    py = ShardedLoader(ds, 16, shuffle=True, seed=9)
+    native = NativeShardedLoader(ds, 16, shuffle=True, seed=9)
+    py.set_epoch(1)
+    native.set_epoch(1)
+    py_tail = list(py.iter_batches(2))
+    native_tail = list(native.iter_batches(2))
+    assert len(py_tail) == len(native_tail)
+    for (xs_a, ys_a), (xs_b, ys_b) in zip(py_tail, native_tail):
+        np.testing.assert_array_equal(xs_a, xs_b)
+        np.testing.assert_array_equal(ys_a, ys_b)
+
+
 def test_native_loader_rejects_transforming_getitem():
     from distributed_pytorch_tpu.utils.data import NativeShardedLoader
 

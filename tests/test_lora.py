@@ -235,8 +235,8 @@ class TestTraining:
             state, _ = step(state, batch)
         path = str(tmp_path / "lora_snap.npz")
         save_snapshot(path, state, epochs_run=1)
-        restored, epochs_run = load_snapshot(path, fresh())
-        assert epochs_run == 1
+        restored, snap_meta = load_snapshot(path, fresh())
+        assert snap_meta["epochs_run"] == 1
         for _ in range(3):
             restored, _ = step(restored, batch)
         for a, b in zip(

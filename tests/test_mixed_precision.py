@@ -197,8 +197,8 @@ class TestSnapshotRoundTrip:
                 initial_scale=32.0, growth_interval=1
             )
         )
-        restored, epochs = load_snapshot(path, template)
-        assert epochs == 3
+        restored, meta = load_snapshot(path, template)
+        assert meta["epochs_run"] == 3
         assert float(restored.loss_scale.scale) == float(state.loss_scale.scale)
         assert int(restored.loss_scale.good_steps) == int(
             state.loss_scale.good_steps

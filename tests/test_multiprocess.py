@@ -440,7 +440,7 @@ for epoch in range(2):
 trainer._save_snapshot(1)
 from distributed_pytorch_tpu.checkpoint import load_snapshot
 import numpy as _np
-restored, epochs_run = load_snapshot(snap, trainer.state)
+restored, snap_meta = load_snapshot(snap, trainer.state)
 restored = jax.device_put(restored, trainer.state_sharding)
 def _local(tree):
     return [_np.asarray(m.addressable_shards[0].data)
@@ -452,7 +452,7 @@ values_match = all(
 )
 kmu = next(m for m in jax.tree_util.tree_leaves(restored.opt_state[0].mu) if m.ndim == 2)
 print(json.dumps({
-    "snapshot_epochs_run": int(epochs_run),
+    "snapshot_epochs_run": int(snap_meta["epochs_run"]),
     "restored_mu_sharded": not kmu.sharding.is_fully_replicated,
     "restored_mu_values_match": values_match,
 }), flush=True)
@@ -622,7 +622,7 @@ for epoch in range(2):
 # placement + values.
 trainer._save_snapshot(1)
 from distributed_pytorch_tpu.checkpoint import load_snapshot
-restored, epochs_run = load_snapshot(snap, trainer.state)
+restored, snap_meta = load_snapshot(snap, trainer.state)
 restored = jax.device_put(restored, trainer.state_sharding)
 def _local(tree):
     return [np.asarray(m.addressable_shards[0].data)
@@ -635,7 +635,7 @@ kernel = next(
     p for p in jax.tree_util.tree_leaves(trainer.state.params) if p.ndim == 2
 )
 print(json.dumps({
-    "snapshot_epochs_run": int(epochs_run),
+    "snapshot_epochs_run": int(snap_meta["epochs_run"]),
     "restored_params_values_match": values_match,
     "kernel_fully_replicated": bool(kernel.sharding.is_fully_replicated),
     "kernel_local_rows": int(kernel.addressable_shards[0].data.shape[0]),

@@ -96,8 +96,8 @@ def test_async_checkpointer_roundtrip(tmp_path):
     ck = AsyncCheckpointer()
     ck.save(path, state, metadata={"epochs_run": 7})
     ck.wait()
-    restored, epochs = load_snapshot(path, state)
-    assert epochs == 7
+    restored, meta = load_snapshot(path, state)
+    assert meta["epochs_run"] == 7
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
         state.params,

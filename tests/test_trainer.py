@@ -277,3 +277,159 @@ def test_trainer_rotating_checkpoints(tmp_path):
     restored, meta = mgr.restore(template)
     assert meta["epochs_run"] == 5
     assert "metric" in meta
+
+
+# ------------------------------------------------------------ graceful drain
+
+
+@pytest.fixture
+def _restore_sigterm():
+    import signal
+
+    prev = signal.getsignal(signal.SIGTERM)
+    yield
+    signal.signal(signal.SIGTERM, prev)
+
+
+def _drain_after(trainer, n_batches):
+    """Arm trainer to raise its drain flag after the Nth _run_batch call."""
+    orig = trainer._run_batch
+    calls = {"n": 0}
+
+    def wrapped(batch):
+        loss = orig(batch)
+        calls["n"] += 1
+        if calls["n"] == n_batches:
+            trainer._drain_flag = True
+        return loss
+
+    trainer._run_batch = wrapped
+
+
+def test_drain_mid_epoch_snapshot_and_exact_resume(tmp_path, capsys, _restore_sigterm):
+    """The tentpole contract, in-process: a drain request lands mid-epoch,
+    the trainer finishes the in-flight batch, snapshots at (epoch, step),
+    exits with the drain code — and a fresh Trainer resumes at that exact
+    batch, finishing with params identical to an uninterrupted run."""
+    from distributed_pytorch_tpu.checkpoint import load_snapshot
+
+    snap = str(tmp_path / "snapshot.npz")
+    t1 = Trainer(ToyRegressor(), _loader(), optax.sgd(1e-2), save_every=1,
+                 snapshot_path=snap)
+    # 8 batches/epoch; drain on the 11th batch = epoch 1, steps_done 3.
+    _drain_after(t1, 11)
+    with pytest.raises(SystemExit) as exc:
+        t1.train(3)
+    assert exc.value.code == 121  # default TPURUN_DRAIN_EXIT_CODE
+    out = capsys.readouterr().out
+    assert "[drain] just-in-time snapshot at epoch 1, step 3" in out
+
+    restored, meta = load_snapshot(snap, t1.state)
+    assert meta["epochs_run"] == 1
+    assert meta["step_in_epoch"] == 3
+    assert meta["order"] == t1.train_data.order_state()
+    assert meta["loss_count"] == 3
+
+    t2 = Trainer(ToyRegressor(), _loader(), optax.sgd(1e-2), save_every=1,
+                 snapshot_path=snap)
+    assert t2.epochs_run == 1
+    out = capsys.readouterr().out
+    assert "Resuming training from snapshot at Epoch 1, step 3" in out
+    t2.train(3)
+
+    t3 = Trainer(ToyRegressor(), _loader(), optax.sgd(1e-2), save_every=0,
+                 snapshot_path=None, checkpoint_path=str(tmp_path / "c.npz"))
+    t3.train(3)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(t2.state.params),
+        jax.tree_util.tree_leaves(t3.state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_drain_epoch_loss_parity_across_resume(tmp_path, capsys, _restore_sigterm):
+    """The interrupted epoch's reported mean loss (carry + tail) matches the
+    uninterrupted run's mean for the same epoch."""
+    import re
+
+    snap = str(tmp_path / "snapshot.npz")
+    t1 = Trainer(ToyRegressor(), _loader(), optax.sgd(1e-2), save_every=1,
+                 snapshot_path=snap)
+    _drain_after(t1, 5)  # epoch 0, steps_done 5 of 8
+    with pytest.raises(SystemExit):
+        t1.train(2)
+    capsys.readouterr()
+
+    t2 = Trainer(ToyRegressor(), _loader(), optax.sgd(1e-2), save_every=1,
+                 snapshot_path=snap)
+    resumed_loss = t2._run_epoch(0)
+
+    t3 = Trainer(ToyRegressor(), _loader(), optax.sgd(1e-2), save_every=0,
+                 snapshot_path=None, checkpoint_path=str(tmp_path / "c.npz"))
+    full_loss = t3._run_epoch(0)
+    np.testing.assert_allclose(resumed_loss, full_loss, rtol=1e-6)
+
+
+def test_drain_file_poll_and_exit_code_override(tmp_path, monkeypatch, capsys, _restore_sigterm):
+    """The agent-side signal: touching TPURUN_DRAIN_FILE drains the very next
+    batch, and TPURUN_DRAIN_EXIT_CODE overrides the exit status."""
+    drain_file = tmp_path / "drain.0"
+    monkeypatch.setenv("TPURUN_DRAIN_FILE", str(drain_file))
+    monkeypatch.setenv("TPURUN_DRAIN_EXIT_CODE", "77")
+    snap = str(tmp_path / "snapshot.npz")
+    t = Trainer(ToyRegressor(), _loader(), optax.sgd(1e-2), save_every=1,
+                snapshot_path=snap)
+    drain_file.write_text("drain\n")
+    with pytest.raises(SystemExit) as exc:
+        t.train(2)
+    assert exc.value.code == 77
+    assert "[drain] just-in-time snapshot at epoch 0, step 1" in capsys.readouterr().out
+
+
+def test_sigterm_with_drain_file_present_sets_flag(tmp_path, monkeypatch, _restore_sigterm):
+    """Under tpurun (TPURUN_DRAIN_FILE set), SIGTERM with the drain file
+    touched means 'snapshot and go' — the handler latches the flag instead
+    of killing the process."""
+    import os
+    import signal
+
+    drain_file = tmp_path / "drain.0"
+    drain_file.write_text("drain\n")
+    monkeypatch.setenv("TPURUN_DRAIN_FILE", str(drain_file))
+    t = Trainer(ToyRegressor(), _loader(), optax.sgd(1e-2), save_every=1,
+                snapshot_path=str(tmp_path / "s.npz"))
+    assert not t._drain_flag
+    os.kill(os.getpid(), signal.SIGTERM)
+    assert t._drain_flag  # delivered synchronously at the next bytecode
+
+
+def test_drain_without_snapshot_path_is_inert(tmp_path, _restore_sigterm):
+    """No snapshot_path -> nothing to drain to: the flag is ignored and the
+    run completes normally (matches a plain, non-elastic launch)."""
+    t = Trainer(ToyRegressor(), _loader(), optax.sgd(1e-2), save_every=0,
+                checkpoint_path=str(tmp_path / "c.npz"))
+    t._drain_flag = True
+    t.train(1)  # must not raise SystemExit
+    assert t.epochs_run == 1
+
+
+def test_drain_resume_geometry_mismatch_replays_epoch(tmp_path, capsys, _restore_sigterm):
+    """A snapshot taken mid-epoch under a different loader geometry (elastic
+    scale-down) cannot be resumed at the saved step: the epoch replays from
+    step 0, loudly."""
+    snap = str(tmp_path / "snapshot.npz")
+    t1 = Trainer(ToyRegressor(), _loader(), optax.sgd(1e-2), save_every=1,
+                 snapshot_path=snap)
+    _drain_after(t1, 3)
+    with pytest.raises(SystemExit):
+        t1.train(2)
+    capsys.readouterr()
+
+    t2 = Trainer(ToyRegressor(), _loader(batch=16), optax.sgd(1e-2), save_every=1,
+                 snapshot_path=snap)
+    out = capsys.readouterr().out
+    assert "different loader geometry" in out
+    assert "Resuming training from snapshot at Epoch 0" in out
+    assert t2._resume_step == 0
+    t2.train(1)  # replays epoch 0 from scratch, completes
+    assert t2.epochs_run == 1

@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # Seeded 2-node chaos drill on CPU: worker kill, hung worker, corrupt
-# snapshot, and a 2s store partition — one FaultPlan, one run, deterministic.
+# snapshot, a 2s store partition, and a mid-epoch drain preemption — one
+# FaultPlan, one run, deterministic.
 #
 #   bash tools/chaos_smoke.sh
 #
@@ -13,9 +14,16 @@
 #   * each agent's store traffic is cut for 2s at t=3 (FaultProxy) -> ridden
 #     out inside --store-retry-deadline, no spurious restart;
 #   * generation 2: the corrupt latest snapshot is quarantined (.corrupt),
-#     resume falls back to <snapshot>.prev, training completes all 3 epochs.
+#     resume falls back to <snapshot>.prev — and 5 steps into the replayed
+#     epoch worker 1 is DRAIN-preempted: both ranks agree on the stop step
+#     (per-batch allgather), take a just-in-time snapshot at (epoch 1,
+#     step 5), and exit with the drain code. Both agents classify the exit
+#     as a preemption: the restart is FREE (budget already exhausted at
+#     2/2 and the run still continues);
+#   * generation 3: resumes mid-epoch at the exact batch, completes all 3
+#     epochs.
 #
-# Unlike the <60s pytest drill (tests/test_chaos.py::TestSeededDrill), this
+# Unlike the <90s pytest drill (tests/test_chaos.py::TestSeededDrill), this
 # includes the HANG fault: detecting a hang needs a worker-heartbeat window
 # larger than JAX startup, so this script trades the tight wall-clock bound
 # for coverage of the hung-worker path.
@@ -56,7 +64,8 @@ FAULT_PLAN='{
     {"kind": "hang", "process_id": 1, "restart": 1, "at_step": 21,
      "duration": 600},
     {"kind": "store_partition", "restart": null, "at_time": 3.0,
-     "duration": 2.0}
+     "duration": 2.0},
+    {"kind": "drain_at_step", "process_id": 1, "restart": 2, "at_step": 5}
   ]
 }'
 
@@ -105,6 +114,14 @@ grep -q "fell back to"              <<<"$ALL" || fail "resume did not use the .p
 grep -q "quarantined"               <<<"$ALL" || fail "corrupt snapshot was not quarantined"
 [ -e smoke.npz.corrupt ]                      || fail "no .corrupt quarantine file"
 [ -e gen.0.2 ]                                || fail "generation 2 never started"
+grep -q "drain request (self)"      <<<"$ALL" || fail "gen-2 drain fault never fired"
+grep -q "just-in-time snapshot at epoch 1, step 5" <<<"$ALL" \
+                                              || fail "drain snapshot missed the agreed step"
+grep -q "preempt detected"          <<<"$ALL" || fail "drain exit misclassified (no preempt log)"
+grep -q "restart budget intact"     <<<"$ALL" || fail "preemption spent restart budget"
+grep -q "Resuming training from snapshot at Epoch 1, step 5" <<<"$ALL" \
+                                              || fail "gen-3 did not resume at the drained batch"
+[ -e gen.0.3 ] && [ -e gen.1.3 ]              || fail "generation 3 (the free restart) never started"
 
 # All three epochs trained, exactly once each in the surviving timeline.
 python - <<'EOF'

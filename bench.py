@@ -468,6 +468,98 @@ def bench_scaling(n_steps: int = 10, per_chip_batch: int = 8, seq_len: int = 512
     }
 
 
+def bench_serving(
+    n_requests: int = 24,
+    arrival_rate_hz: float = 20.0,
+    seed: int = 0,
+):
+    """Continuous-batching serving benchmark: Poisson arrivals against the
+    ``serving.InferenceEngine``, reporting throughput plus TTFT/TPOT/e2e
+    percentiles (the reservoirs in ``ServingMetrics``). The model is small
+    on purpose — the measurement is the ENGINE (scheduler overhead, slot
+    churn, compile-once decode), not the matmuls."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_pytorch_tpu.models.transformer import TransformerLM
+    from distributed_pytorch_tpu.serving import InferenceEngine, SamplingParams
+    from distributed_pytorch_tpu.serving.admission import ServingMetrics
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    model = TransformerLM(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, d_ff=256,
+        dtype=jnp.float32 if on_cpu else jnp.bfloat16,
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    eng = InferenceEngine(
+        model, params, max_slots=8, max_seq_len=64, page_size=8,
+        token_budget=64, max_prefill_chunk=32, max_queue=n_requests,
+    )
+
+    rng = np.random.default_rng(seed)
+    # Warm the compile caches off the clock — one request per power-of-two
+    # prefill bucket (a prompt of length c+1 prefills exactly one c-chunk)
+    # plus the shared decode step — then reset the accounting: TTFT must
+    # measure scheduling, not XLA compilation.
+    chunk = 1
+    while chunk <= 32:
+        warm = eng.submit(
+            rng.integers(0, 256, chunk + 1).tolist(),
+            SamplingParams(max_new_tokens=2),
+        )
+        eng.run()
+        assert eng.poll(warm).finished
+        chunk *= 2
+    eng.metrics = ServingMetrics()
+    eng.admission.accepted = 0
+
+    arrivals = np.cumsum(rng.exponential(1.0 / arrival_rate_hz, n_requests))
+    prompts = [
+        rng.integers(0, 256, int(rng.integers(4, 17))).tolist()
+        for _ in range(n_requests)
+    ]
+    start = time.perf_counter()
+    submitted = 0
+    ids = []
+    while submitted < n_requests or eng.scheduler.has_work:
+        now = time.perf_counter() - start
+        while submitted < n_requests and arrivals[submitted] <= now:
+            ids.append(
+                eng.submit(
+                    prompts[submitted], SamplingParams(max_new_tokens=16)
+                )
+            )
+            submitted += 1
+        if eng.scheduler.has_work:
+            eng.step()
+        elif submitted < n_requests:
+            time.sleep(min(arrivals[submitted] - now, 0.01))
+    assert all(eng.poll(r).finished for r in ids)
+
+    stats = eng.stats()
+    out = {
+        "mode": "serving_poisson",
+        "workload": f"serving_lm64_poisson{arrival_rate_hz:g}hz_n{n_requests}",
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "arrival_rate_hz": arrival_rate_hz,
+        "n_requests": n_requests,
+        "stats": {
+            k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in stats.items()
+        },
+    }
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_SERVING.json"
+    )
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    return out
+
+
 def attach_mfu(result: dict, peak: float) -> dict:
     per_chip = result["flops_per_step"] * result["steps_per_sec"] / result["n_chips"]
     result["model_tflops_per_sec_per_chip"] = round(per_chip / 1e12, 2)
@@ -598,6 +690,12 @@ def main():
         "toward O(T x W)) and write BENCH_WINDOW.json",
     )
     parser.add_argument(
+        "--serving", action="store_true",
+        help="benchmark the continuous-batching inference engine under "
+        "Poisson arrivals (throughput + TTFT/TPOT/e2e percentiles) and "
+        "write BENCH_SERVING.json",
+    )
+    parser.add_argument(
         "--fake_devices", type=int, default=0, metavar="N",
         help="run on N virtual CPU devices instead of the real backend "
         "(the --scaling rig until a multi-chip slice exists)",
@@ -616,17 +714,19 @@ def main():
         # import is authoritative.
         jax.config.update("jax_platforms", "cpu")
 
-    if args.scaling and args.window_sweep:
-        # Both are exclusive whole-run modes; silently preferring one would
+    if sum((args.scaling, args.window_sweep, args.serving)) > 1:
+        # All are exclusive whole-run modes; silently preferring one would
         # burn a chip window on the wrong measurement (the queue scripts
         # run these as separate precious steps).
-        parser.error("--scaling and --window_sweep are exclusive modes; "
-                     "run them as separate invocations")
+        parser.error("--scaling, --window_sweep and --serving are exclusive "
+                     "modes; run them as separate invocations")
     scaling_metric = "dp_weak_scaling_efficiency"
     if args.scaling:
         metric, unit = scaling_metric, "ratio_vs_1dev"
     elif args.window_sweep:
         metric, unit = "window1024_speedup_vs_full_t8192", "ratio"
+    elif args.serving:
+        metric, unit = "serving_throughput_tok_per_sec", "tok/s"
     else:
         metric, unit = "resnet50_bf16_train_steps_per_sec", "steps/s"
 
@@ -681,6 +781,29 @@ def run_benches(args, dev, peak):
                     "n_devices": last["n_devices"],
                     "awaiting_hardware": scaling["awaiting_hardware"],
                     "efficiency_meaningful": scaling["efficiency_meaningful"],
+                }
+            )
+        )
+        return
+
+    if args.serving:
+        # Exclusive mode: the continuous-batching engine under open-loop
+        # Poisson load. One JSON line; full percentiles in the file.
+        result = bench_serving()
+        s = result["stats"]
+        print(
+            json.dumps(
+                {
+                    "metric": "serving_throughput_tok_per_sec",
+                    "value": round(s["tokens_per_sec"], 2),
+                    "unit": "tok/s",
+                    "vs_baseline": 1.0,
+                    "requests_completed": s["requests_completed"],
+                    "ttft_s_p50": s["ttft_s_p50"],
+                    "ttft_s_p95": s["ttft_s_p95"],
+                    "tpot_s_p50": s["tpot_s_p50"],
+                    "e2e_s_p95": s["e2e_s_p95"],
+                    "preemptions": s["preemptions"],
                 }
             )
         )

@@ -92,6 +92,44 @@ def top_p_filter(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
     return truncate_logits(logits, 0, top_p)
 
 
+def make_sampler(temperature: float, top_k: int, top_p: float):
+    """Return ``sample(logits [B, V], rng) -> tokens [B]`` for a STATIC
+    sampling config: greedy argmax at ``temperature <= 0``, else categorical
+    over the temperature-scaled, top-k/top-p-truncated logits. This is THE
+    next-token rule — ``generate``'s loop body and the serving engine's
+    continuous-batching decode step both call it, so offline and served
+    sampling can never drift apart."""
+
+    def sample(logits, step_rng):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = truncate_logits(logits / temperature, top_k, top_p)
+        return jax.random.categorical(step_rng, scaled).astype(jnp.int32)
+
+    return sample
+
+
+def decode_token_step(decode_model, params, cache, current, **apply_kwargs):
+    """ONE decode-mode forward: apply ``decode_model`` on ``current``
+    ([B, T_step] token ids) against ``cache``, returning ``(last_logits,
+    cache)`` where ``last_logits`` is ``[B, V]`` at the final position.
+
+    This is the single-token step extracted from ``generate``'s loop body so
+    the serving engine (serving/engine.py) drives EXACTLY the same compiled
+    math — dequant-inside-the-step and all. Extra ``apply_kwargs``
+    (``block_tables``/``seq_lens``) flow to the model for the paged-cache
+    path; callers that only need the cache update may discard the logits
+    (XLA dead-code-eliminates the LM head when the output is unused)."""
+    dtype = getattr(decode_model, "dtype", jnp.bfloat16)
+    logits, updated = decode_model.apply(
+        {"params": dequantize_pytree(params, dtype), "cache": cache},
+        current,
+        mutable=["cache"],
+        **apply_kwargs,
+    )
+    return logits[:, -1, :], updated["cache"]
+
+
 def batch_sharding_placer(mesh: Mesh, data_axis: str, batch: int):
     """``(place, batch_sh, replicated)`` — THE decode placement rule,
     shared by :func:`generate`, :func:`beam_search`, and
@@ -126,8 +164,16 @@ def bucketed_prefill_len(prompt_lengths) -> int:
     at most 2x the prefill tokens while capping the variants at log2(T).
     Shared by :func:`generate` and ``speculative.speculative_generate`` so
     both paths bucket identically."""
-    prefill_len = max(1, int(np.min(np.asarray(prompt_lengths))))
-    return 1 << (prefill_len.bit_length() - 1)
+    min_len = int(np.min(np.asarray(prompt_lengths)))
+    if min_len < 0:
+        raise ValueError(f"prompt lengths must be >= 0, got {min_len}")
+    if min_len == 0:
+        # A zero-length row has NO common prefix: any batched prefill would
+        # feed that row's pad tokens as if they were prompt, corrupting its
+        # cache before the serial loop's keep-prompt logic can take over.
+        # Everything runs through the serial loop instead.
+        return 1
+    return 1 << (min_len.bit_length() - 1)
 
 
 def generate(
@@ -287,16 +333,10 @@ def _compiled_run(
     prefill length) so repeated generate() calls with the same shapes reuse
     the executable (flax modules are frozen dataclasses, hence hashable
     cache keys)."""
-
-    def sample(logits, step_rng):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        scaled = truncate_logits(logits / temperature, top_k, top_p)
-        return jax.random.categorical(step_rng, scaled).astype(jnp.int32)
+    sample = make_sampler(temperature, top_k, top_p)
 
     def run(params, tokens, cache, prompt_lengths, rng):
         batch = tokens.shape[0]
-        dtype = getattr(decode_model, "dtype", jnp.bfloat16)
 
         if prefill_len > 1:
             # One batched forward over the common prefix: every row's tokens
@@ -306,28 +346,20 @@ def _compiled_run(
             # already there — the same invariant (cache_index == t at body
             # entry) the single-token path maintains.
             chunk = tokens[:, : prefill_len - 1]
-            _, updated = decode_model.apply(
-                {"params": dequantize_pytree(params, dtype), "cache": cache},
-                chunk,
-                mutable=["cache"],
-            )
-            cache = updated["cache"]
+            _, cache = decode_token_step(decode_model, params, cache, chunk)
 
         def body(t, carry):
             tokens, cache, rng = carry
             current = jax.lax.dynamic_slice(tokens, (0, t), (batch, 1))
-            # Dequantize (a no-op tree_map when nothing is quantized) INSIDE
-            # the loop body: the int8->compute-dtype convert is a producer
-            # each weight's consumer matmul fuses, so the loop reads int8
-            # from HBM.
-            logits, updated = decode_model.apply(
-                {"params": dequantize_pytree(params, dtype), "cache": cache},
-                current,
-                mutable=["cache"],
+            # decode_token_step dequantizes (a no-op tree_map when nothing
+            # is quantized) INSIDE the loop body: the int8->compute-dtype
+            # convert is a producer each weight's consumer matmul fuses, so
+            # the loop reads int8 from HBM.
+            last_logits, cache = decode_token_step(
+                decode_model, params, cache, current
             )
-            cache = updated["cache"]
             rng, step_rng = jax.random.split(rng)
-            proposed = sample(logits[:, -1, :], step_rng)  # [B]
+            proposed = sample(last_logits, step_rng)  # [B]
             # Inside each row's prompt, keep the prompt token; past it, take
             # the sample. (t+1 is the position being decided.)
             keep_prompt = (t + 1) < prompt_lengths

@@ -4,16 +4,91 @@ The reference computes loss but never logs it (SURVEY.md §5: ``print()``-only
 observability, an unused ``SummaryWriter`` import at
 ``multigpu_profile.py:10``). We close that gap: per-epoch structured lines from
 process 0, with optional TensorBoard scalars when a writer backend is
-available.
+available; :class:`ReservoirHistogram` adds bounded-memory latency quantiles
+(p50/p95/p99) for the serving engine's TTFT/TPOT and the Trainer's step-time
+cadence.
 """
 
 from __future__ import annotations
 
 import json
+import math
+import random
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 from distributed_pytorch_tpu.parallel.bootstrap import is_main_process
+
+
+class ReservoirHistogram:
+    """Bounded-memory quantile estimator: uniform reservoir sampling
+    (Vitter's algorithm R) over a stream of observations.
+
+    Counters alone (the old metrics surface) cannot answer "what is p99
+    TTFT?" without keeping every sample; a reservoir keeps a fixed
+    ``capacity`` (default 1024) uniform subsample regardless of stream
+    length, so quantiles stay O(capacity) memory and are exact until the
+    reservoir first overflows. Deterministic for a given ``seed`` and record
+    order — the serving tests rely on that.
+
+    ``sum``/``count``/``min``/``max`` are exact over the WHOLE stream (they
+    are running aggregates, not reservoir-derived)."""
+
+    def __init__(self, capacity: int = 1024, seed: int = 0):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._rng = random.Random(seed)
+        self._samples: list = []
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def record(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.sum += value
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+        if len(self._samples) < self.capacity:
+            self._samples.append(value)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.capacity:
+                self._samples[j] = value
+
+    def quantile(self, q: float) -> float:
+        """Linear-interpolated quantile over the reservoir; NaN when empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        if not self._samples:
+            return math.nan
+        ordered = sorted(self._samples)
+        pos = q * (len(ordered) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(ordered) - 1)
+        frac = pos - lo
+        return ordered[lo] * (1.0 - frac) + ordered[hi] * frac
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else math.nan
+
+    def summary(self, prefix: str = "") -> Dict[str, float]:
+        """``{count, mean, min, max, p50, p95, p99}``, optionally prefixed —
+        ready to splat into :meth:`MetricLogger.log` or a JSON report."""
+        if not self.count:
+            return {f"{prefix}count": 0}
+        return {
+            f"{prefix}count": self.count,
+            f"{prefix}mean": self.mean,
+            f"{prefix}min": self.min,
+            f"{prefix}max": self.max,
+            f"{prefix}p50": self.quantile(0.50),
+            f"{prefix}p95": self.quantile(0.95),
+            f"{prefix}p99": self.quantile(0.99),
+        }
 
 
 class MetricLogger:

@@ -46,7 +46,9 @@ def apply_rope(
 
     ``positions`` ([T] int/float) defaults to global positions 0..T-1; the
     decode path passes the cache offset so a single-token step rotates by its
-    absolute position.
+    absolute position. A 2-D ``positions`` ([B, T]) gives every batch row its
+    OWN absolute positions — the continuous-batching decode path, where slots
+    sit at unrelated sequence offsets.
 
     Context extension knobs for running PAST the training length:
     ``scale > 1`` is linear position interpolation (positions divided by
@@ -61,9 +63,11 @@ def apply_rope(
     positions = positions.astype(jnp.float32)
     if scale != 1.0:
         positions = positions / scale
-    angles = positions[:, None] * freqs[None, :]  # [T, D/2]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions[..., :, None] * freqs  # [T, D/2] or [B, T, D/2]
+    if angles.ndim == 2:
+        angles = angles[None]  # shared positions broadcast over batch
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = x[..., :d_half], x[..., d_half:]
     rotated = jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
@@ -120,9 +124,26 @@ class Attention(nn.Module):
     # per-(token, head) quantization (scale over D) halves both. Dequant
     # happens at the attention einsum, so the loop reads int8.
     quantized_cache: bool = False
+    # Paged KV cache (the serving engine's layout, see serving/kv_cache.py):
+    # instead of one contiguous [B, max_len, H, D] buffer per sequence, the
+    # cache is a global pool [num_pages, page_size, Hkv, D] and each batch
+    # row addresses it through a block table of physical page ids. Page 0 is
+    # reserved as the NULL page: inactive slots write (and padded table
+    # entries read) there, and the visibility mask guarantees nothing read
+    # from it ever survives the softmax. Requires decode=True and the caller
+    # to pass ``block_tables`` [S, pages_per_seq] + ``seq_lens`` [S] into
+    # __call__ every step.
+    page_size: int = 0
+    num_pages: int = 0
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        *,
+        block_tables: Optional[jnp.ndarray] = None,
+        seq_lens: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
         # Validate unconditionally: a typo'd mode must fail on the first
         # single-chip forward, not later when the job first meets an sp>1
         # mesh mid-launch.
@@ -135,6 +156,23 @@ class Attention(nn.Module):
             raise ValueError(f"window must be >= 0, got {self.window}")
         if self.window and not self.causal:
             raise ValueError("window requires causal attention")
+        if self.page_size:
+            if not self.decode:
+                raise ValueError("page_size > 0 requires decode=True")
+            if self.num_pages < 2:
+                raise ValueError(
+                    "paged decode needs num_pages >= 2 (page 0 is the "
+                    f"reserved null page), got {self.num_pages}"
+                )
+            if self.quantized_cache:
+                raise ValueError(
+                    "paged decode does not compose with quantized_cache yet"
+                )
+            if self.window:
+                raise ValueError(
+                    "paged decode does not compose with sliding-window "
+                    "attention yet"
+                )
         head_dim = self.d_model // self.n_heads
         kv_heads = self.n_kv_heads or self.n_heads
         if self.n_heads % kv_heads:
@@ -150,26 +188,42 @@ class Attention(nn.Module):
         v = dense(kv_heads, "value")(x)
 
         if self.decode and self.has_variable("cache", "cached_key"):
-            out = self._decode_step(q_raw, k_raw, v)
+            if self.page_size:
+                if block_tables is None or seq_lens is None:
+                    raise ValueError(
+                        "paged decode requires block_tables and seq_lens "
+                        "every step (the serving engine passes them)"
+                    )
+                out = self._paged_decode_step(
+                    q_raw, k_raw, v, block_tables, seq_lens
+                )
+            else:
+                out = self._decode_step(q_raw, k_raw, v)
             return nn.DenseGeneral(
                 self.d_model, axis=(-2, -1), dtype=self.dtype, name="out"
             )(out)
         if self.decode:
-            # Cache init pass: size the KV cache to this call's (max) length,
-            # then fall through to the normal causal forward.
-            cache_dtype = jnp.int8 if self.quantized_cache else k_raw.dtype
-            self.variable("cache", "cached_key", jnp.zeros, k_raw.shape, cache_dtype)
-            self.variable("cache", "cached_value", jnp.zeros, v.shape, cache_dtype)
-            if self.quantized_cache:
+            # Cache init pass: size the KV cache — to this call's (max)
+            # length in contiguous mode, to the global page pool in paged
+            # mode — then fall through to the normal causal forward.
+            if self.page_size:
+                pool = (self.num_pages, self.page_size, kv_heads, head_dim)
+                self.variable("cache", "cached_key", jnp.zeros, pool, k_raw.dtype)
+                self.variable("cache", "cached_value", jnp.zeros, pool, v.dtype)
+            else:
+                cache_dtype = jnp.int8 if self.quantized_cache else k_raw.dtype
+                self.variable("cache", "cached_key", jnp.zeros, k_raw.shape, cache_dtype)
+                self.variable("cache", "cached_value", jnp.zeros, v.shape, cache_dtype)
+                if self.quantized_cache:
+                    self.variable(
+                        "cache", "key_scale", jnp.zeros, k_raw.shape[:-1], jnp.float32
+                    )
+                    self.variable(
+                        "cache", "value_scale", jnp.zeros, v.shape[:-1], jnp.float32
+                    )
                 self.variable(
-                    "cache", "key_scale", jnp.zeros, k_raw.shape[:-1], jnp.float32
+                    "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
                 )
-                self.variable(
-                    "cache", "value_scale", jnp.zeros, v.shape[:-1], jnp.float32
-                )
-            self.variable(
-                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
-            )
 
         rope = lambda x, **kw: apply_rope(  # noqa: E731
             x, theta=self.rope_theta, scale=self.rope_scale, **kw
@@ -284,6 +338,82 @@ class Attention(nn.Module):
         out = jnp.einsum("bhgqk,bkhd->bqhgd", weights, values)
         return out.reshape(b, t_q, h, d)
 
+    def _paged_decode_step(self, q_raw, k_raw, v, block_tables, seq_lens):
+        """One decode/prefill step against the PAGED cache pool.
+
+        ``q_raw`` [S, T_step, H, D]: T_step is 1 for the batched decode step,
+        or a prefill chunk length (then S is the chunked rows, usually 1).
+        ``block_tables`` [S, pages_per_seq] maps each row's logical page to a
+        physical page in the [num_pages, page_size, Hkv, D] pool (0 = the
+        reserved null page). ``seq_lens`` [S] is each row's token count
+        BEFORE this step, i.e. the absolute position of its first new token.
+
+        Same math as :meth:`_decode_step` — RoPE at absolute positions,
+        write-then-attend, grouped GQA einsums — except positions are
+        per-row, the write is a scatter into (physical page, offset), and the
+        read gathers each row's pages into a [S, pages*page_size, Hkv, D]
+        view. Rows whose table is all zeros (inactive slots) write into the
+        null page and read garbage that the visibility mask turns into a
+        discarded-but-finite output: the null page only ever holds finite
+        values written by other inactive rows.
+        """
+        cached_key = self.variable("cache", "cached_key", lambda: None)
+        cached_value = self.variable("cache", "cached_value", lambda: None)
+        s, t_step, h, d = q_raw.shape
+        kv_heads = k_raw.shape[2]
+        page = self.page_size
+        pages_per_seq = block_tables.shape[1]
+
+        seq_lens = seq_lens.astype(jnp.int32)
+        positions = seq_lens[:, None] + jnp.arange(t_step, dtype=jnp.int32)
+        q = apply_rope(
+            q_raw, positions=positions, theta=self.rope_theta,
+            scale=self.rope_scale,
+        )
+        k = apply_rope(
+            k_raw, positions=positions, theta=self.rope_theta,
+            scale=self.rope_scale,
+        )
+
+        # Scatter this step's K/V into (physical page, in-page offset). The
+        # logical page index is clipped to the table width: the engine
+        # guarantees real writes stay in range, so a clipped index can only
+        # belong to an inactive row, whose table maps everything to the null
+        # page anyway.
+        flat_pos = positions.reshape(-1)  # [S*T_step]
+        logical = jnp.clip(flat_pos // page, 0, pages_per_seq - 1)
+        rows = jnp.repeat(jnp.arange(s, dtype=jnp.int32), t_step)
+        phys = block_tables[rows, logical]  # [S*T_step]
+        offset = flat_pos % page
+        cached_key.value = cached_key.value.at[phys, offset].set(
+            k.astype(cached_key.value.dtype).reshape(-1, kv_heads, d)
+        )
+        cached_value.value = cached_value.value.at[phys, offset].set(
+            v.astype(cached_value.value.dtype).reshape(-1, kv_heads, d)
+        )
+
+        # Gather each row's pages into its contiguous logical view. K below
+        # is pages_per_seq * page_size — the row's maximum context, not the
+        # pool size.
+        keys = cached_key.value[block_tables].reshape(
+            s, pages_per_seq * page, kv_heads, d
+        )
+        values = cached_value.value[block_tables].reshape(
+            s, pages_per_seq * page, kv_heads, d
+        )
+        scale = d**-0.5
+        k_abs = jnp.arange(pages_per_seq * page)[None, None, :]
+        visible = k_abs <= positions[:, :, None]  # [S, T_step, K]
+        group = h // kv_heads
+        qg = q.reshape(s, t_step, kv_heads, group, d)
+        logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, keys) * scale
+        logits = jnp.where(visible[:, None, None], logits, NEG_INF)
+        weights = jax.nn.softmax(
+            logits.astype(jnp.float32), axis=-1
+        ).astype(q.dtype)
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", weights, values)
+        return out.reshape(s, t_step, h, d)
+
     def _update_quantized_cache(self, cached_key, cached_value, k, v, index):
         """Write this step's k/v as int8 + per-(token, head) float32 scales,
         and return the DEQUANTIZED full caches for the attention einsums —
@@ -344,9 +474,17 @@ class TransformerBlock(nn.Module):
     decode: bool = False
     remat_mlp: bool = False  # rematerialize only the MLP branch (see TransformerLM)
     quantized_cache: bool = False  # int8 KV cache in decode (see Attention)
+    page_size: int = 0  # paged KV cache in decode (see Attention); 0 = contiguous
+    num_pages: int = 0
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        *,
+        block_tables: Optional[jnp.ndarray] = None,
+        seq_lens: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
         def drop(y):
             # Active only when a "dropout" rng is supplied (the train step
             # with TrainState.rng armed); eval/decode never pass one, so
@@ -357,14 +495,22 @@ class TransformerBlock(nn.Module):
                 y, deterministic=not self.has_rng("dropout")
             )
 
+        # Only pass the paged-decode arrays when the caller supplied them:
+        # the train/remat paths must see the exact pre-paging call signature.
+        paged_kw = (
+            {} if block_tables is None
+            else {"block_tables": block_tables, "seq_lens": seq_lens}
+        )
         x = x + drop(Attention(
             self.n_heads, self.d_model, self.dtype, self.causal,
             n_kv_heads=self.n_kv_heads, window=self.window,
             rope_scale=self.rope_scale, rope_theta=self.rope_theta,
             mesh=self.mesh, sequence_axis=self.sequence_axis,
             sequence_mode=self.sequence_mode, decode=self.decode,
-            quantized_cache=self.quantized_cache, name="attention",
-        )(nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)))
+            quantized_cache=self.quantized_cache,
+            page_size=self.page_size, num_pages=self.num_pages,
+            name="attention",
+        )(nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x), **paged_kw))
         if self.n_experts > 0:
             cls = nn.remat(MoEMLP) if self.remat_mlp else MoEMLP
             mlp = cls(
@@ -496,10 +642,20 @@ class TransformerLM(nn.Module):
     decode: bool = False  # KV-cache autoregressive mode (see generation.py)
     quantized_cache: bool = False  # int8 KV cache in decode (see Attention)
     fused_head_chunk: int = 0  # >0: vocab chunk size for the fused CE head
+    # Paged KV cache for continuous-batching decode (see Attention and
+    # serving/): the serving engine clones with decode=True, page_size=P,
+    # num_pages=N and passes block_tables/seq_lens through __call__.
+    page_size: int = 0
+    num_pages: int = 0
 
     @nn.compact
     def __call__(
-        self, tokens: jnp.ndarray, targets: Optional[jnp.ndarray] = None
+        self,
+        tokens: jnp.ndarray,
+        targets: Optional[jnp.ndarray] = None,
+        *,
+        block_tables: Optional[jnp.ndarray] = None,
+        seq_lens: Optional[jnp.ndarray] = None,
     ) -> jnp.ndarray:
         embed = nn.Embed(
             self.vocab_size, self.d_model, dtype=self.dtype, name="embed"
@@ -518,6 +674,12 @@ class TransformerLM(nn.Module):
                 remat_mlp = True
             else:
                 raise ValueError(f"unknown remat_policy {self.remat_policy!r}")
+        # See TransformerBlock: the paged-decode arrays are forwarded only
+        # when present so the train/remat call signature is unchanged.
+        paged_kw = (
+            {} if block_tables is None
+            else {"block_tables": block_tables, "seq_lens": seq_lens}
+        )
         for i in range(self.n_layers):
             # GShard-style interleaving: every `moe_every`-th block is MoE.
             moe = self.n_experts if (i + 1) % self.moe_every == 0 else 0
@@ -530,8 +692,10 @@ class TransformerLM(nn.Module):
                 dropout_rate=self.dropout_rate,
                 n_experts=moe, moe_top_k=self.moe_top_k,
                 decode=self.decode, remat_mlp=remat_mlp,
-                quantized_cache=self.quantized_cache, name=f"block_{i}",
-            )(x)
+                quantized_cache=self.quantized_cache,
+                page_size=self.page_size, num_pages=self.num_pages,
+                name=f"block_{i}",
+            )(x, **paged_kw)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         if self.fused_head_chunk and self.vocab_size % self.fused_head_chunk:
             # Fail loudly here: a silent dense fallback would surface later as

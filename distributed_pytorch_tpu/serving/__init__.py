@@ -1,0 +1,57 @@
+"""Continuous-batching inference engine — the serving side of the LM family.
+
+``generation.py`` is strictly offline: one fixed batch in, one compiled
+``fori_loop`` out, and no request may join until every sequence in the batch
+finishes. This package turns the same decode math into a REQUEST-level
+engine, split exactly along the pjit paper's host/device line:
+
+* the device runs ONE fixed-shape jit decode step (padded slots masked out,
+  so there is exactly one compilation per shape bucket);
+* the host owns everything irregular: the paged KV-cache free list
+  (:mod:`.kv_cache`), the waiting queue / chunked-prefill / preemption
+  policy (:mod:`.scheduler`), and admission control + latency metrics
+  (:mod:`.admission`);
+* :class:`.engine.InferenceEngine` glues them behind
+  ``submit(prompt, params) -> request_id`` / ``step()`` / ``poll()``.
+
+Deterministic on CPU (``JAX_PLATFORMS=cpu``): tests assert continuous
+batching reproduces offline ``generate()`` token for token.
+"""
+
+from distributed_pytorch_tpu.serving.admission import (
+    AdmissionController,
+    AdmissionError,
+    QueueFull,
+    RequestTooLong,
+    ServingMetrics,
+)
+from distributed_pytorch_tpu.serving.engine import InferenceEngine
+from distributed_pytorch_tpu.serving.kv_cache import (
+    BlockTable,
+    OutOfPages,
+    PagedBlockAllocator,
+)
+from distributed_pytorch_tpu.serving.scheduler import (
+    Request,
+    RequestState,
+    SamplingParams,
+    Scheduler,
+    StepPlan,
+)
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionError",
+    "BlockTable",
+    "InferenceEngine",
+    "OutOfPages",
+    "PagedBlockAllocator",
+    "QueueFull",
+    "Request",
+    "RequestState",
+    "RequestTooLong",
+    "SamplingParams",
+    "Scheduler",
+    "ServingMetrics",
+    "StepPlan",
+]

@@ -1,0 +1,130 @@
+"""Admission control + serving metrics.
+
+The engine's front door. Two jobs:
+
+* **Backpressure**: a bounded waiting queue (``QueueFull`` the moment it
+  overflows — callers shed load or retry, the engine never buffers
+  unboundedly) and an up-front feasibility check (``RequestTooLong`` for
+  requests that could never fit the block table even on an empty cache —
+  rejecting at submit beats preempt-thrashing forever at runtime).
+* **Latency accounting**: per-request TTFT (submit -> first generated
+  token), TPOT (mean inter-token time past the first), and e2e latency,
+  recorded into bounded :class:`~distributed_pytorch_tpu.metrics
+  .ReservoirHistogram` reservoirs with p50/p95/p99 export, plus exact
+  throughput counters.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+from distributed_pytorch_tpu.metrics import ReservoirHistogram
+from distributed_pytorch_tpu.serving.scheduler import Request, SamplingParams
+
+
+class AdmissionError(RuntimeError):
+    """Base class: the request was NOT accepted."""
+
+
+class QueueFull(AdmissionError):
+    """Waiting queue at capacity — backpressure; retry later."""
+
+
+class RequestTooLong(AdmissionError):
+    """prompt + max_new_tokens can never fit the per-sequence block table."""
+
+
+class AdmissionController:
+    """Bounded-queue gate in front of the scheduler."""
+
+    def __init__(self, *, max_queue: int, max_request_tokens: int):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = max_queue
+        self.max_request_tokens = max_request_tokens
+        self.accepted = 0
+        self.rejected_queue_full = 0
+        self.rejected_too_long = 0
+
+    def check(
+        self, prompt_len: int, params: SamplingParams, queue_len: int
+    ) -> None:
+        """Raise an :class:`AdmissionError` subclass iff the request must be
+        rejected; otherwise count it accepted."""
+        if prompt_len < 1:
+            self.rejected_too_long += 1
+            raise RequestTooLong(
+                "empty prompt: generation is conditioned on at least one "
+                "token (offline generate() has the same contract — a "
+                "zero-length row's position 0 is never decided)"
+            )
+        total = prompt_len + params.max_new_tokens
+        if total > self.max_request_tokens:
+            self.rejected_too_long += 1
+            raise RequestTooLong(
+                f"prompt ({prompt_len}) + max_new_tokens "
+                f"({params.max_new_tokens}) = {total} exceeds the "
+                f"per-sequence cache capacity {self.max_request_tokens}"
+            )
+        if queue_len >= self.max_queue:
+            self.rejected_queue_full += 1
+            raise QueueFull(
+                f"waiting queue at capacity ({self.max_queue}); retry later"
+            )
+        self.accepted += 1
+
+    def counters(self) -> Dict[str, int]:
+        return {
+            "accepted": self.accepted,
+            "rejected_queue_full": self.rejected_queue_full,
+            "rejected_too_long": self.rejected_too_long,
+        }
+
+
+class ServingMetrics:
+    """TTFT / TPOT / e2e reservoirs + exact throughput counters."""
+
+    def __init__(self, reservoir_capacity: int = 1024):
+        self.ttft = ReservoirHistogram(reservoir_capacity, seed=1)
+        self.tpot = ReservoirHistogram(reservoir_capacity, seed=2)
+        self.e2e = ReservoirHistogram(reservoir_capacity, seed=3)
+        self.tokens_generated = 0
+        self.requests_completed = 0
+        self.engine_steps = 0
+        self._start = time.perf_counter()
+
+    def observe_step(self, new_tokens: int) -> None:
+        self.engine_steps += 1
+        self.tokens_generated += new_tokens
+
+    def observe_finished(self, req: Request) -> None:
+        self.requests_completed += 1
+        if req.first_token_time is not None:
+            self.ttft.record(req.first_token_time - req.submit_time)
+            if req.finish_time is not None:
+                self.e2e.record(req.finish_time - req.submit_time)
+                if req.n_generated > 1:
+                    self.tpot.record(
+                        (req.finish_time - req.first_token_time)
+                        / (req.n_generated - 1)
+                    )
+
+    def snapshot(self) -> Dict[str, float]:
+        """One flat dict: counters + tokens/s + per-metric percentiles —
+        the payload ``bench.py --serving`` writes and the smoke test
+        asserts non-empty."""
+        elapsed = time.perf_counter() - self._start
+        out: Dict[str, float] = {
+            "engine_steps": self.engine_steps,
+            "tokens_generated": self.tokens_generated,
+            "requests_completed": self.requests_completed,
+            "elapsed_s": elapsed,
+            "tokens_per_sec": (
+                self.tokens_generated / elapsed if elapsed > 0 else 0.0
+            ),
+        }
+        out.update(self.ttft.summary("ttft_s_"))
+        out.update(self.tpot.summary("tpot_s_"))
+        out.update(self.e2e.summary("e2e_s_"))
+        return out

@@ -1,0 +1,304 @@
+"""The continuous-batching inference engine: host orchestration around a
+fixed-shape jit decode step.
+
+``submit(prompt, params) -> request_id`` / ``step()`` / ``poll(request_id)``.
+Every ``step()``:
+
+1. asks the :class:`~.scheduler.Scheduler` for a plan (admission, chunked
+   prefill under the token budget, the batched decode set, preemption);
+2. executes the prefill chunks — each a ``[1, C]`` jit call writing K/V into
+   the request's pages (logits dead-code-eliminated), compiled once per
+   power-of-two chunk size;
+3. executes ONE batched decode step over all ``max_slots`` slots — inactive
+   slots are padded (null block table, length 0) and masked, so the decode
+   program compiles exactly once regardless of which requests are live;
+4. harvests sampled tokens host-side, retires finished requests, records
+   TTFT/TPOT/e2e.
+
+The decode math is :func:`~distributed_pytorch_tpu.generation
+.decode_token_step` — the SAME single-token step ``generate()``'s offline
+loop runs — against the paged cache, so continuous batching is
+token-for-token identical to offline decode (pinned by
+``tests/test_serving.py`` on CPU).
+
+Sampling determinism: each request gets ``PRNGKey(seed)`` and token i is
+drawn with ``fold_in(key, i)`` — independent of batch composition, slot
+assignment, and preemption, so a preempted-then-resumed request reproduces
+its exact stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_pytorch_tpu.generation import (
+    decode_token_step,
+    truncate_logits,
+)
+from distributed_pytorch_tpu.serving.admission import (
+    AdmissionController,
+    ServingMetrics,
+)
+from distributed_pytorch_tpu.serving.kv_cache import PagedBlockAllocator
+from distributed_pytorch_tpu.serving.scheduler import (
+    Request,
+    SamplingParams,
+    Scheduler,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestStatus:
+    """Snapshot returned by :meth:`InferenceEngine.poll`."""
+
+    req_id: int
+    state: str
+    prompt_len: int
+    generated: List[int]
+    finished: bool
+    preempt_count: int
+
+
+class InferenceEngine:
+    """Continuous-batching engine over a paged KV cache.
+
+    ``model`` is the TRAINING-mode module (same contract as ``generate``);
+    it is cloned with ``decode=True, page_size, num_pages`` internally.
+    ``num_pages`` defaults to exactly enough pages for every slot to hold
+    ``max_seq_len`` tokens (+1 for the reserved null page) — i.e. no
+    overcommit; pass a smaller value to exercise preemption.
+
+    ``top_k``/``top_p`` are engine-static (compiled into the decode step);
+    temperature and seed are per-request (:class:`SamplingParams`).
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        max_slots: int = 8,
+        max_seq_len: int = 256,
+        page_size: int = 16,
+        num_pages: Optional[int] = None,
+        token_budget: int = 64,
+        max_prefill_chunk: int = 32,
+        max_queue: int = 128,
+        top_k: int = 0,
+        top_p: float = 0.0,
+    ):
+        if max_seq_len % page_size:
+            raise ValueError(
+                f"max_seq_len {max_seq_len} must be a multiple of "
+                f"page_size {page_size}"
+            )
+        self.pages_per_seq = max_seq_len // page_size
+        if num_pages is None:
+            num_pages = max_slots * self.pages_per_seq + 1
+        self.page_size = page_size
+        self.max_slots = max_slots
+        self.max_seq_len = max_seq_len
+        self.params = params
+        self._top_k = int(top_k)
+        self._top_p = float(top_p)
+
+        self.decode_model = model.clone(
+            decode=True, page_size=page_size, num_pages=num_pages
+        )
+        # Size the paged pool from abstract shapes only (eval_shape traces
+        # init without running it); token length 1 — pool shapes depend only
+        # on (num_pages, page_size), never on the init input.
+        abstract = jax.eval_shape(
+            self.decode_model.init,
+            jax.random.PRNGKey(0),
+            jnp.zeros((max_slots, 1), jnp.int32),
+        )["cache"]
+        self.cache = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), abstract
+        )
+
+        self.allocator = PagedBlockAllocator(num_pages)
+        self.scheduler = Scheduler(
+            self.allocator,
+            max_slots=max_slots,
+            page_size=page_size,
+            pages_per_seq=self.pages_per_seq,
+            token_budget=token_budget,
+            max_prefill_chunk=max_prefill_chunk,
+        )
+        self.admission = AdmissionController(
+            max_queue=max_queue, max_request_tokens=max_seq_len
+        )
+        self.metrics = ServingMetrics()
+        self.requests: Dict[int, Request] = {}
+        self._next_id = 0
+        self._keys: Dict[int, jax.Array] = {}
+
+    # ------------------------------------------------------------- compiled
+
+    @functools.cached_property
+    def _decode_step(self):
+        """THE batched decode program: one compile for the engine's
+        lifetime. Greedy and sampled rows coexist via a per-slot temperature
+        vector (0 = greedy) so slot composition never re-specializes it."""
+        top_k, top_p = self._top_k, self._top_p
+
+        def run(params, cache, tokens, tables, lens, temps, keys):
+            last_logits, cache = decode_token_step(
+                self.decode_model, params, cache, tokens[:, None],
+                block_tables=tables, seq_lens=lens,
+            )
+            greedy = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+            safe_t = jnp.where(temps > 0, temps, 1.0)
+            scaled = truncate_logits(
+                last_logits / safe_t[:, None], top_k, top_p
+            )
+            sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+            nxt = jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+            return nxt, cache
+
+        return jax.jit(run, donate_argnums=(1,))
+
+    @functools.lru_cache(maxsize=16)
+    def _prefill_step(self, chunk: int):
+        """One compile per power-of-two chunk length; returns only the
+        updated cache, so XLA prunes the LM head from the program."""
+
+        def run(params, cache, tokens, table, length):
+            _, cache = decode_token_step(
+                self.decode_model, params, cache, tokens,
+                block_tables=table, seq_lens=length,
+            )
+            return cache
+
+        return jax.jit(run, donate_argnums=(1,))
+
+    # ----------------------------------------------------------------- API
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        params: Optional[SamplingParams] = None,
+    ) -> int:
+        """Queue one request; returns its id. Raises
+        :class:`~.admission.QueueFull` (backpressure) or
+        :class:`~.admission.RequestTooLong` (can never fit) — admission is
+        decided NOW, not at first schedule."""
+        params = params or SamplingParams()
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        self.admission.check(len(prompt), params, self.scheduler.num_waiting)
+        req = Request(
+            req_id=self._next_id,
+            prompt=prompt,
+            params=params,
+            submit_time=time.perf_counter(),
+        )
+        self._next_id += 1
+        self.requests[req.req_id] = req
+        self._keys[req.req_id] = jax.random.PRNGKey(params.seed)
+        self.scheduler.add(req)
+        return req.req_id
+
+    def step(self) -> List[int]:
+        """Run one engine iteration; returns ids of requests that FINISHED
+        during it. A no-op (empty list) when nothing is queued or running."""
+        plan = self.scheduler.schedule()
+        if plan.empty:
+            return []
+
+        for slot, chunk in plan.prefill:
+            req = self.scheduler.slots[slot]
+            start = req.len_cached
+            tok = np.asarray(
+                [req.tokens[start : start + chunk]], np.int32
+            )
+            table = req.table.as_row(self.pages_per_seq)[None]
+            self.cache = self._prefill_step(chunk)(
+                self.params, self.cache, jnp.asarray(tok),
+                jnp.asarray(table), jnp.asarray([start], jnp.int32),
+            )
+            self.scheduler.note_prefilled(slot, chunk)
+
+        finished: List[int] = []
+        if plan.decode_slots:
+            tokens = np.zeros((self.max_slots,), np.int32)
+            tables = np.zeros(
+                (self.max_slots, self.pages_per_seq), np.int32
+            )
+            lens = np.zeros((self.max_slots,), np.int32)
+            temps = np.zeros((self.max_slots,), np.float32)
+            keys = np.zeros((self.max_slots, 2), np.uint32)
+            for slot in plan.decode_slots:
+                req = self.scheduler.slots[slot]
+                tokens[slot] = req.tokens[req.len_cached]
+                tables[slot] = req.table.as_row(self.pages_per_seq)
+                lens[slot] = req.len_cached
+                temps[slot] = req.params.temperature
+                keys[slot] = np.asarray(
+                    jax.random.fold_in(
+                        self._keys[req.req_id], req.n_generated
+                    ),
+                    np.uint32,
+                )
+            nxt, self.cache = self._decode_step(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(tables), jnp.asarray(lens),
+                jnp.asarray(temps), jnp.asarray(keys),
+            )
+            nxt_host = np.asarray(nxt)  # device sync point
+            now = time.perf_counter()
+            for slot in plan.decode_slots:
+                done = self.scheduler.note_decoded(
+                    slot, int(nxt_host[slot]), now=now
+                )
+                if done is not None:
+                    self.scheduler.retire(done, now=now)
+                    self.metrics.observe_finished(done)
+                    self._keys.pop(done.req_id, None)
+                    finished.append(done.req_id)
+        self.metrics.observe_step(new_tokens=len(plan.decode_slots))
+        return finished
+
+    def poll(self, req_id: int) -> RequestStatus:
+        req = self.requests[req_id]
+        return RequestStatus(
+            req_id=req_id,
+            state=req.state.value,
+            prompt_len=len(req.prompt),
+            generated=list(req.generated),
+            finished=req.done,
+            preempt_count=req.preempt_count,
+        )
+
+    def run(self, max_steps: int = 10_000) -> List[int]:
+        """Drive :meth:`step` until the engine drains; returns every
+        request id finished along the way. ``max_steps`` bounds a scheduling
+        bug to a loud failure instead of a hang."""
+        finished: List[int] = []
+        steps = 0
+        while self.scheduler.has_work:
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"engine did not drain within {max_steps} steps "
+                    f"({self.scheduler.num_waiting} waiting, "
+                    f"{len(self.scheduler.running)} running)"
+                )
+            finished.extend(self.step())
+            steps += 1
+        return finished
+
+    def stats(self) -> Dict[str, float]:
+        """Metrics snapshot + admission counters + cache pressure."""
+        out = self.metrics.snapshot()
+        out.update(self.admission.counters())
+        out["preemptions"] = self.scheduler.preemptions
+        out["pages_free"] = self.allocator.num_free
+        out["pages_allocated"] = self.allocator.num_allocated
+        return out

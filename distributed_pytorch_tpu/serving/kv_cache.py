@@ -1,0 +1,145 @@
+"""Paged KV-cache management: host-side page accounting for the device pool.
+
+The device holds ONE global cache per attention layer, laid out
+``[num_pages, page_size, Hkv, D]`` (see ``models/transformer.py``'s paged
+decode mode). This module owns the host half: a free-list allocator over
+physical page ids and a per-sequence :class:`BlockTable` mapping logical
+pages to physical ones. Two invariants make slot reuse copy-free:
+
+* **Page 0 is the NULL page** — never allocated. Inactive decode slots and
+  padded block-table entries all point at it; the attention visibility mask
+  guarantees nothing read from it survives the softmax, so retired pages
+  need no zeroing before reuse (stale K/V beyond a row's ``seq_len`` is
+  masked exactly like stale cache beyond ``cache_index`` in offline decode).
+* **Every allocated page is owned by exactly one table** — the allocator
+  tracks the owning set, so a double-free or a leak is an immediate
+  ``AssertionError`` in :meth:`PagedBlockAllocator.check_invariants`, not a
+  silent cross-request cache corruption. The scheduler property test drives
+  1k randomized submit/finish/preempt cycles against this.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+NULL_PAGE = 0
+
+
+class OutOfPages(RuntimeError):
+    """Raised when an allocation cannot be satisfied — the scheduler's cue
+    to preempt the lowest-priority running sequence."""
+
+
+class PagedBlockAllocator:
+    """LIFO free-list over physical page ids ``1..num_pages-1``.
+
+    LIFO keeps reuse hot (the page most recently retired is reassigned
+    first) and, with the deterministic initial ordering, makes the whole
+    engine reproducible on CPU: identical submit/finish order yields
+    identical physical page assignments."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError(
+                f"need >= 2 pages (page {NULL_PAGE} is reserved), got {num_pages}"
+            )
+        self.num_pages = num_pages
+        # pop() takes from the end: seed the stack so pages come out
+        # 1, 2, 3, ... on a fresh allocator.
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._owned: set = set()
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_allocated(self) -> int:
+        return len(self._owned)
+
+    @staticmethod
+    def pages_needed(n_tokens: int, page_size: int) -> int:
+        return -(-n_tokens // page_size) if n_tokens > 0 else 0
+
+    def allocate(self, n: int = 1) -> List[int]:
+        """Take ``n`` pages or raise :class:`OutOfPages` taking NONE —
+        partial grabs would leak on the error path."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} pages")
+        if n > len(self._free):
+            raise OutOfPages(
+                f"need {n} pages, {len(self._free)} free "
+                f"of {self.num_pages - 1} allocatable"
+            )
+        pages = [self._free.pop() for _ in range(n)]
+        self._owned.update(pages)
+        return pages
+
+    def free(self, pages: Sequence[int]) -> None:
+        for page in pages:
+            if page not in self._owned:
+                raise AssertionError(
+                    f"freeing page {page} that is not allocated "
+                    "(double free or foreign page)"
+                )
+            self._owned.discard(page)
+            self._free.append(page)
+
+    def check_invariants(self) -> None:
+        """Free + owned partition the allocatable pages exactly."""
+        free_set = set(self._free)
+        assert len(free_set) == len(self._free), "duplicate page in free list"
+        assert NULL_PAGE not in free_set, "null page leaked into free list"
+        assert NULL_PAGE not in self._owned, "null page was allocated"
+        assert not (free_set & self._owned), (
+            f"pages both free and owned: {free_set & self._owned}"
+        )
+        assert len(free_set) + len(self._owned) == self.num_pages - 1, (
+            f"page leak: {len(free_set)} free + {len(self._owned)} owned "
+            f"!= {self.num_pages - 1} allocatable"
+        )
+
+
+class BlockTable:
+    """One sequence's logical-page -> physical-page map."""
+
+    def __init__(self):
+        self.pages: List[int] = []
+
+    def __len__(self) -> int:
+        return len(self.pages)
+
+    def ensure(
+        self, n_tokens: int, page_size: int, allocator: PagedBlockAllocator
+    ) -> int:
+        """Grow the table to cover ``n_tokens`` positions; returns how many
+        pages were newly allocated. All-or-nothing per call: a failed grow
+        raises :class:`OutOfPages` without taking any pages."""
+        need = PagedBlockAllocator.pages_needed(n_tokens, page_size)
+        grow = need - len(self.pages)
+        if grow <= 0:
+            return 0
+        self.pages.extend(allocator.allocate(grow))
+        return grow
+
+    def release(self, allocator: PagedBlockAllocator) -> int:
+        """Return every page to the allocator (retire/preempt); returns the
+        count released. No device-side work: stale contents are masked."""
+        n = len(self.pages)
+        if n:
+            allocator.free(self.pages)
+            self.pages = []
+        return n
+
+    def as_row(self, width: int) -> np.ndarray:
+        """``[width]`` int32 row for the device block-table batch, padded
+        with the null page."""
+        if len(self.pages) > width:
+            raise ValueError(
+                f"table holds {len(self.pages)} pages, row width is {width}"
+            )
+        row = np.full((width,), NULL_PAGE, np.int32)
+        row[: len(self.pages)] = self.pages
+        return row

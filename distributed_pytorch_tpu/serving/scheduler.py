@@ -1,0 +1,330 @@
+"""Request-level continuous-batching scheduler.
+
+The host-side policy half of the engine: maintains the waiting queue and the
+active slot set, interleaves chunked prefill with batched decode under a
+per-step token budget, preempts under page pressure, and retires finished
+sequences every step so new requests join mid-flight.
+
+Design decisions, in the order they bite:
+
+* **Priority = submission order** (request id, lower wins). Preemption only
+  ever evicts a strictly LOWER-priority victim than the sequence that needs
+  pages — or, failing that, preempts the requester itself — so the oldest
+  running request always makes forward progress and two cache-hungry
+  requests cannot livelock trading pages.
+* **Decode before prefill in the budget**: every DECODE-state slot reserves
+  one token of the step budget first, then the remainder goes to prefill
+  chunks. Running sequences never starve (TPOT stays flat), while admitted
+  prompts still chunk in within a bounded number of steps (TTFT bounded by
+  prompt_len / leftover_budget).
+* **Prefill covers positions [0, L-1)** of a request's token list; the LAST
+  token always goes through the shared batched decode step, whose sampled
+  output is the first new token. This mirrors ``generate``'s serial loop
+  exactly (the body at position t decides token t+1), which is what makes
+  served output token-identical to offline decode.
+* **Chunks are power-of-two sized** (greedy decomposition, capped at
+  ``max_prefill_chunk``), so the engine compiles at most log2(cap)+1 prefill
+  variants — the "one compilation per shape bucket" contract.
+* **Preempted sequences keep their generated tokens** and re-enter the
+  waiting queue at their original priority; on re-admission the whole
+  prompt+generated prefix is re-prefilled. With per-request fold_in RNG the
+  resumed continuation reproduces the identical token stream.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import enum
+import time
+from typing import List, Optional, Tuple
+
+from distributed_pytorch_tpu.serving.kv_cache import (
+    BlockTable,
+    OutOfPages,
+    PagedBlockAllocator,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request generation parameters. ``temperature <= 0`` is greedy;
+    ``seed`` drives a per-request RNG folded with the token index, so a
+    request's sampled stream is independent of batch composition and
+    survives preemption. ``top_k``/``top_p`` are engine-level (static in the
+    compiled step), not per-request."""
+
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    seed: int = 0
+    stop_token: Optional[int] = None
+
+
+class RequestState(enum.Enum):
+    WAITING = "waiting"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    FINISHED = "finished"
+
+
+@dataclasses.dataclass
+class Request:
+    """One in-flight generation request. ``tokens`` = prompt + generated;
+    ``len_cached`` counts how many of them have K/V in the paged cache.
+    Invariant while in DECODE state: ``len_cached == len(tokens) - 1`` — the
+    next decode step feeds ``tokens[len_cached]`` and appends the sample."""
+
+    req_id: int
+    prompt: List[int]
+    params: SamplingParams
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    generated: List[int] = dataclasses.field(default_factory=list)
+    len_cached: int = 0
+    table: BlockTable = dataclasses.field(default_factory=BlockTable)
+    state: RequestState = RequestState.WAITING
+    slot: Optional[int] = None
+    submit_time: float = 0.0
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    preempt_count: int = 0
+
+    def __post_init__(self):
+        if not self.tokens:
+            self.tokens = list(self.prompt)
+
+    @property
+    def n_generated(self) -> int:
+        return len(self.generated)
+
+    @property
+    def remaining_prefill(self) -> int:
+        return len(self.tokens) - 1 - self.len_cached
+
+    @property
+    def done(self) -> bool:
+        return self.state is RequestState.FINISHED
+
+
+@dataclasses.dataclass
+class StepPlan:
+    """One engine step's worth of device work: prefill chunks (executed in
+    order, each ``(slot, chunk_len)``), then one batched decode over
+    ``decode_slots``."""
+
+    prefill: List[Tuple[int, int]] = dataclasses.field(default_factory=list)
+    decode_slots: List[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def empty(self) -> bool:
+        return not self.prefill and not self.decode_slots
+
+
+def _pow2_floor(n: int) -> int:
+    return 1 << (n.bit_length() - 1) if n > 0 else 0
+
+
+class Scheduler:
+    """Waiting queue + slot set + page-pressure policy (see module doc)."""
+
+    def __init__(
+        self,
+        allocator: PagedBlockAllocator,
+        *,
+        max_slots: int,
+        page_size: int,
+        pages_per_seq: int,
+        token_budget: int = 64,
+        max_prefill_chunk: int = 32,
+    ):
+        if token_budget < 1:
+            raise ValueError(f"token_budget must be >= 1, got {token_budget}")
+        if _pow2_floor(max_prefill_chunk) != max_prefill_chunk:
+            raise ValueError(
+                f"max_prefill_chunk must be a power of two, got "
+                f"{max_prefill_chunk} (chunk sizes are compile-cache keys)"
+            )
+        self.allocator = allocator
+        self.max_slots = max_slots
+        self.page_size = page_size
+        self.pages_per_seq = pages_per_seq
+        self.token_budget = token_budget
+        self.max_prefill_chunk = max_prefill_chunk
+        self.waiting: List[Request] = []  # kept sorted by req_id
+        self.slots: List[Optional[Request]] = [None] * max_slots
+        self.preemptions = 0
+
+    # ------------------------------------------------------------- queries
+
+    @property
+    def running(self) -> List[Request]:
+        return [r for r in self.slots if r is not None]
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting) or any(r is not None for r in self.slots)
+
+    # ------------------------------------------------------------ mutation
+
+    def add(self, req: Request) -> None:
+        bisect.insort(self.waiting, req, key=lambda r: r.req_id)
+
+    def _admit(self, req: Request, slot: int) -> None:
+        req.slot = slot
+        req.len_cached = 0
+        req.state = (
+            RequestState.DECODE if req.remaining_prefill == 0
+            else RequestState.PREFILL
+        )
+        self.slots[slot] = req
+
+    def _preempt(self, req: Request) -> None:
+        """Evict ``req`` back to the waiting queue: pages freed, generated
+        tokens KEPT (they re-prefill on re-admission)."""
+        self.preemptions += 1
+        req.preempt_count += 1
+        req.table.release(self.allocator)
+        self.slots[req.slot] = None
+        req.slot = None
+        req.len_cached = 0
+        req.state = RequestState.WAITING
+        self.add(req)
+
+    def retire(self, req: Request, now: Optional[float] = None) -> None:
+        """Finished: free pages and the slot. Copy-free — the slot and its
+        stale cache pages are immediately reusable (masking handles the
+        rest)."""
+        req.table.release(self.allocator)
+        if req.slot is not None:
+            self.slots[req.slot] = None
+        req.slot = None
+        req.state = RequestState.FINISHED
+        req.finish_time = time.perf_counter() if now is None else now
+
+    def _ensure_pages(self, req: Request, n_tokens: int) -> bool:
+        """Cover ``n_tokens`` positions of ``req``'s table, preempting
+        strictly lower-priority victims as needed. Returns False — after
+        preempting ``req`` itself — when even that cannot free enough."""
+        while True:
+            try:
+                req.table.ensure(n_tokens, self.page_size, self.allocator)
+                return True
+            except OutOfPages:
+                victim = None
+                for cand in self.running:
+                    if cand.req_id > req.req_id and (
+                        victim is None or cand.req_id > victim.req_id
+                    ):
+                        victim = cand
+                if victim is None:
+                    # req is the lowest-priority page-holder; it yields.
+                    self._preempt(req)
+                    return False
+                self._preempt(victim)
+
+    # ------------------------------------------------------------ planning
+
+    def schedule(self) -> StepPlan:
+        """Build the next step's plan. Mutates scheduler state (admission,
+        page allocation, preemption); the engine then executes the device
+        work and reports back via :meth:`note_prefilled` /
+        :meth:`note_decoded`."""
+        plan = StepPlan()
+
+        # 1. Admit waiting requests into free slots, oldest first. Pages are
+        # allocated lazily below, so admission itself cannot fail.
+        for slot in range(self.max_slots):
+            if not self.waiting:
+                break
+            if self.slots[slot] is None:
+                self._admit(self.waiting.pop(0), slot)
+
+        # 2. Decode set reserves budget first: one token per running
+        # sequence, each guaranteed a page for its write position.
+        budget = self.token_budget
+        for req in sorted(self.running, key=lambda r: r.req_id):
+            if req.state is not RequestState.DECODE or budget <= 0:
+                continue
+            if self._ensure_pages(req, req.len_cached + 1):
+                plan.decode_slots.append(req.slot)
+                budget -= 1
+
+        # 3. Remaining budget goes to prefill chunks, highest priority
+        # first, power-of-two sized so compile variants stay bounded.
+        for req in sorted(self.running, key=lambda r: r.req_id):
+            if req.state is not RequestState.PREFILL:
+                continue
+            slot = req.slot
+            planned = req.len_cached
+            while budget > 0:
+                remaining = len(req.tokens) - 1 - planned
+                if remaining <= 0:
+                    break
+                chunk = min(
+                    _pow2_floor(remaining),
+                    self.max_prefill_chunk,
+                    _pow2_floor(budget),
+                )
+                if chunk <= 0:
+                    break
+                if not self._ensure_pages(req, planned + chunk):
+                    break  # req was preempted; its plan entries are dropped
+                plan.prefill.append((slot, chunk))
+                planned += chunk
+                budget -= chunk
+            if req.state is not RequestState.PREFILL:
+                # Preempted while growing: drop any chunks already planned
+                # for its (now free) slot.
+                plan.prefill = [
+                    (s, c) for (s, c) in plan.prefill if s != slot
+                ]
+        # A prefill allocation above may have preempted a (lower-priority)
+        # request that was already planned for decode — keep only slots
+        # still holding a DECODE-state request.
+        plan.decode_slots = [
+            s for s in plan.decode_slots
+            if self.slots[s] is not None
+            and self.slots[s].state is RequestState.DECODE
+        ]
+        return plan
+
+    # ----------------------------------------------------------- execution
+
+    def note_prefilled(self, slot: int, chunk: int) -> None:
+        req = self.slots[slot]
+        assert req is not None, f"prefill completion for empty slot {slot}"
+        req.len_cached += chunk
+        assert req.len_cached <= len(req.tokens) - 1, (
+            f"request {req.req_id} prefilled past its last token"
+        )
+        if req.remaining_prefill == 0:
+            req.state = RequestState.DECODE
+
+    def note_decoded(
+        self, slot: int, token: int, now: Optional[float] = None
+    ) -> Optional[Request]:
+        """Record one decode-step output for ``slot``. Returns the request
+        when this token FINISHED it (caller retires + records metrics)."""
+        req = self.slots[slot]
+        assert req is not None, f"decode result for empty slot {slot}"
+        assert req.state is RequestState.DECODE
+        req.len_cached += 1
+        assert req.len_cached == len(req.tokens), (
+            f"request {req.req_id} decode out of sync"
+        )
+        req.tokens.append(int(token))
+        req.generated.append(int(token))
+        if req.first_token_time is None:
+            req.first_token_time = (
+                time.perf_counter() if now is None else now
+            )
+        stop = req.params.stop_token
+        if (
+            req.n_generated >= req.params.max_new_tokens
+            or (stop is not None and int(token) == stop)
+        ):
+            return req
+        return None

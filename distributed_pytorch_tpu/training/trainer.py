@@ -24,6 +24,7 @@ from __future__ import annotations
 import os
 import signal
 import threading
+import time
 from typing import Callable, Optional
 
 import jax
@@ -38,7 +39,7 @@ from distributed_pytorch_tpu.checkpoint import (
     save_checkpoint,
     save_snapshot,
 )
-from distributed_pytorch_tpu.metrics import MetricLogger
+from distributed_pytorch_tpu.metrics import MetricLogger, ReservoirHistogram
 from distributed_pytorch_tpu.parallel.bootstrap import is_main_process
 from distributed_pytorch_tpu.parallel.sharding import (
     put_global_batch,
@@ -121,6 +122,11 @@ class Trainer:
         self.loss_fn = loss_fn
         self.profiler = profiler
         self.metrics = metrics or MetricLogger()
+        # Per-batch wall time (dispatch + any sync the loop already does) in
+        # a bounded reservoir; p50/p95 logged at every epoch boundary. Tail
+        # percentiles are where stragglers, recompiles, and host stalls show
+        # up — the mean hides them.
+        self.step_times = ReservoirHistogram(1024)
         self.log_every = log_every
         self.grad_accum = grad_accum
         # async_save: overlap snapshot disk writes with the next epoch's
@@ -493,9 +499,11 @@ class Trainer:
         for i, (xs, ys) in enumerate(
             self.train_data.iter_batches(start), start=start
         ):
+            t0 = time.perf_counter()
             batch = self._put_batch(xs, ys)
             loss = self._run_batch(batch)
             losses.append(loss)
+            self.step_times.record(time.perf_counter() - t0)
             if self.profiler is not None:
                 # Device sync so the profiled window reflects real step time.
                 jax.block_until_ready(loss)
@@ -516,7 +524,13 @@ class Trainer:
         )
         count = carry_count + len(losses)
         epoch_loss = total / count if count else 0.0
-        self.metrics.log(int(self.state.step), epoch_loss=epoch_loss, epoch=epoch)
+        self.metrics.log(
+            int(self.state.step),
+            epoch_loss=epoch_loss,
+            epoch=epoch,
+            step_time_s_p50=self.step_times.quantile(0.5),
+            step_time_s_p95=self.step_times.quantile(0.95),
+        )
         return epoch_loss
 
     def _eval_apply(self, variables, inputs, **kwargs):

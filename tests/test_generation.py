@@ -406,3 +406,46 @@ class TestTopP:
         # ...and still composes with a nucleus.
         out_p = truncate_logits(logits, 100, 0.5)
         assert np.isfinite(np.asarray(out_p)).sum() < logits.size
+
+
+# ----------------------------------------------------- zero-length prompts
+
+
+class TestZeroLengthPrompt:
+    """Regression: a zero-length row in ``prompt_lengths`` must not push the
+    bucketed prefill below 1 (the serial loop's body at position t decides
+    token t+1, so position 0 must go through the loop — a prefill of 0 would
+    try to compile a [B, 0] apply)."""
+
+    def test_bucketed_prefill_len_clamps_zero_to_one(self):
+        from distributed_pytorch_tpu.generation import bucketed_prefill_len
+
+        assert bucketed_prefill_len([0, 6]) == 1
+        assert bucketed_prefill_len([0]) == 1
+        assert bucketed_prefill_len([1, 9]) == 1  # pow2 floor of min
+        assert bucketed_prefill_len([6, 9]) == 4
+
+    def test_negative_prompt_length_raises(self):
+        from distributed_pytorch_tpu.generation import bucketed_prefill_len
+
+        with pytest.raises(ValueError):
+            bucketed_prefill_len([-1, 6])
+
+    def test_zero_length_row_does_not_perturb_others(self):
+        """A batch containing a zero-length prompt generates, and the
+        full-prompt row's output is identical to running it alone."""
+        model = tiny_lm()
+        params, tokens = make_params(model, batch=2, seq=6)
+        lengths = jnp.asarray([6, 0], jnp.int32)
+        out = np.asarray(
+            generate(
+                model, params, jnp.asarray(tokens), 4,
+                prompt_lengths=lengths,
+            )
+        )
+        solo = np.asarray(
+            generate(model, params, jnp.asarray(tokens[:1]), 4)
+        )
+        np.testing.assert_array_equal(out[0], solo[0])
+        assert out.shape == (2, 10)
+        assert (out >= 0).all() and (out < 48).all()

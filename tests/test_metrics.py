@@ -63,3 +63,64 @@ def test_tensorboard_scalars_written(tmp_path, capsys):
 
 def test_close_without_writer_is_safe():
     MetricLogger().close()
+
+
+# --------------------------------------------------- reservoir histograms
+
+
+class TestReservoirHistogram:
+    def _hist(self, capacity=8, seed=0):
+        from distributed_pytorch_tpu.metrics import ReservoirHistogram
+
+        return ReservoirHistogram(capacity, seed=seed)
+
+    def test_exact_quantiles_before_overflow(self):
+        h = self._hist(capacity=100)
+        for v in range(1, 101):  # 1..100
+            h.record(float(v))
+        assert h.quantile(0.0) == 1.0
+        assert h.quantile(1.0) == 100.0
+        assert abs(h.quantile(0.5) - 50.5) < 1e-9
+        assert h.count == 100
+        assert h.sum == 5050.0
+
+    def test_bounded_memory_and_exact_extremes(self):
+        h = self._hist(capacity=16)
+        for v in range(10_000):
+            h.record(float(v))
+        assert len(h._samples) == 16  # reservoir never grows past capacity
+        # count/sum/min/max are exact regardless of sampling
+        assert h.count == 10_000
+        assert h.min == 0.0 and h.max == 9_999.0
+        # sampled quantiles land in-range
+        assert 0.0 <= h.quantile(0.5) <= 9_999.0
+
+    def test_deterministic_per_seed(self):
+        a, b = self._hist(seed=7), self._hist(seed=7)
+        for v in range(1000):
+            a.record(float(v % 37))
+            b.record(float(v % 37))
+        assert a.quantile(0.95) == b.quantile(0.95)
+        assert sorted(a._samples) == sorted(b._samples)
+
+    def test_empty_histogram(self):
+        import math
+
+        h = self._hist()
+        assert h.count == 0
+        assert math.isnan(h.quantile(0.5))
+        s = h.summary("x_")
+        assert s["x_count"] == 0
+
+    def test_summary_keys_prefixed(self):
+        h = self._hist()
+        h.record(1.0)
+        h.record(3.0)
+        s = h.summary("step_time_s_")
+        assert set(s) == {
+            "step_time_s_count", "step_time_s_mean", "step_time_s_min",
+            "step_time_s_max", "step_time_s_p50", "step_time_s_p95",
+            "step_time_s_p99",
+        }
+        assert s["step_time_s_count"] == 2
+        assert s["step_time_s_mean"] == 2.0

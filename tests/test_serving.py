@@ -1,0 +1,331 @@
+"""Serving subsystem tests: continuous-batching parity with offline
+generate(), scheduler/allocator invariants under randomized load, preemption
+determinism, and admission control. All on CPU (conftest pins
+JAX_PLATFORMS=cpu) — the engine is deterministic there by construction.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu.generation import generate
+from distributed_pytorch_tpu.models.transformer import TransformerLM
+from distributed_pytorch_tpu.serving import (
+    InferenceEngine,
+    OutOfPages,
+    PagedBlockAllocator,
+    QueueFull,
+    Request,
+    RequestTooLong,
+    SamplingParams,
+    Scheduler,
+)
+from distributed_pytorch_tpu.serving.kv_cache import NULL_PAGE, BlockTable
+
+
+def tiny_lm(**kw):
+    return TransformerLM(
+        vocab_size=48, d_model=16, n_layers=2, n_heads=2, d_ff=32,
+        dtype=jnp.float32, **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = tiny_lm()
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+def offline_greedy(model, params, prompt, max_new):
+    out = generate(
+        model, params, jnp.asarray([prompt], jnp.int32),
+        max_new_tokens=max_new, temperature=0.0, rng=jax.random.PRNGKey(0),
+    )
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+# ---------------------------------------------------------------- allocator
+
+
+class TestPagedBlockAllocator:
+    def test_null_page_reserved(self):
+        alloc = PagedBlockAllocator(4)
+        pages = alloc.allocate(3)
+        assert NULL_PAGE not in pages
+        assert sorted(pages) == [1, 2, 3]
+
+    def test_all_or_nothing(self):
+        alloc = PagedBlockAllocator(4)
+        alloc.allocate(2)
+        with pytest.raises(OutOfPages):
+            alloc.allocate(2)
+        # the failed call took nothing
+        assert alloc.num_free == 1
+        alloc.check_invariants()
+
+    def test_double_free_detected(self):
+        alloc = PagedBlockAllocator(4)
+        pages = alloc.allocate(1)
+        alloc.free(pages)
+        with pytest.raises(AssertionError):
+            alloc.free(pages)
+
+    def test_block_table_grow_and_release(self):
+        alloc = PagedBlockAllocator(8)
+        table = BlockTable()
+        assert table.ensure(5, 2, alloc) == 3  # ceil(5/2)
+        assert table.ensure(6, 2, alloc) == 0  # already covered
+        assert table.ensure(7, 2, alloc) == 1
+        row = table.as_row(6)
+        assert row.dtype == np.int32
+        assert list(row[4:]) == [NULL_PAGE, NULL_PAGE]
+        assert table.release(alloc) == 4
+        alloc.check_invariants()
+        assert alloc.num_free == 7
+
+
+# ---------------------------------------------------------- scheduler props
+
+
+class TestSchedulerInvariants:
+    def _drive(self, sched, plan):
+        """Simulate the device side of one plan: complete every prefill
+        chunk, then emit an arbitrary token for every decode slot."""
+        finished = []
+        for slot, chunk in plan.prefill:
+            sched.note_prefilled(slot, chunk)
+        for slot in plan.decode_slots:
+            done = sched.note_decoded(slot, token=1, now=0.0)
+            if done is not None:
+                sched.retire(done, now=0.0)
+                finished.append(done)
+        return finished
+
+    def test_no_block_leaked_over_randomized_cycles(self):
+        """1k randomized submit/step cycles against a small pool: allocator
+        invariants hold at every step and every page is free at the end."""
+        rng = random.Random(1234)
+        alloc = PagedBlockAllocator(17)
+        sched = Scheduler(
+            alloc, max_slots=4, page_size=2, pages_per_seq=8,
+            token_budget=8, max_prefill_chunk=4,
+        )
+        next_id = 0
+        live = {}
+        for cycle in range(1000):
+            if rng.random() < 0.4 and len(live) < 32:
+                prompt = [rng.randrange(48) for _ in range(rng.randint(1, 9))]
+                req = Request(
+                    req_id=next_id, prompt=prompt,
+                    params=SamplingParams(
+                        max_new_tokens=rng.randint(1, 16 - len(prompt))
+                    ),
+                )
+                live[next_id] = req
+                sched.add(req)
+                next_id += 1
+            plan = self._drive(sched, sched.schedule())
+            for req in plan:
+                del live[req.req_id]
+            alloc.check_invariants()
+            for req in live.values():
+                # every live table is page-aligned with what's cached
+                assert len(req.table) >= PagedBlockAllocator.pages_needed(
+                    req.len_cached, 2
+                )
+        # drain whatever is left
+        for _ in range(2000):
+            if not sched.has_work:
+                break
+            for req in self._drive(sched, sched.schedule()):
+                del live[req.req_id]
+        assert not sched.has_work
+        assert not live
+        alloc.check_invariants()
+        assert alloc.num_free == 16  # every allocatable page returned
+
+    def test_preemption_only_evicts_lower_priority(self):
+        """With a pool that fits one sequence, the oldest request finishes
+        first — newer ones get preempted, never the oldest."""
+        alloc = PagedBlockAllocator(5)  # 4 usable pages
+        sched = Scheduler(
+            alloc, max_slots=2, page_size=2, pages_per_seq=4,
+            token_budget=8, max_prefill_chunk=4,
+        )
+        reqs = [
+            Request(req_id=i, prompt=[1, 2, 3],
+                    params=SamplingParams(max_new_tokens=5))
+            for i in range(2)
+        ]
+        for r in reqs:
+            sched.add(r)
+        order = []
+        for _ in range(200):
+            if not sched.has_work:
+                break
+            order.extend(
+                r.req_id
+                for r in TestSchedulerInvariants._drive(self, sched,
+                                                        sched.schedule())
+            )
+        assert order and order[0] == 0, "oldest request must finish first"
+        assert reqs[0].preempt_count == 0, (
+            "highest-priority request must never be preempted"
+        )
+        assert reqs[1].preempt_count > 0
+        alloc.check_invariants()
+        assert alloc.num_free == 4
+
+
+# ------------------------------------------------------------- engine parity
+
+
+class TestEngineParity:
+    PROMPTS = [[5, 7, 11, 2, 9, 3], [1, 4, 8], [2, 2, 3, 17, 40], [6, 1, 9, 9]]
+
+    def test_continuous_batching_matches_offline_generate(
+        self, model_and_params
+    ):
+        """Greedy continuous batching — including requests submitted
+        mid-flight — is token-identical to each prompt decoded alone with
+        offline generate()."""
+        model, params = model_and_params
+        refs = [
+            offline_greedy(model, params, p, 14 - len(p))
+            for p in self.PROMPTS
+        ]
+        eng = InferenceEngine(
+            model, params, max_slots=4, max_seq_len=64, page_size=4,
+            token_budget=16, max_prefill_chunk=8,
+        )
+        ids = [
+            eng.submit(p, SamplingParams(max_new_tokens=14 - len(p)))
+            for p in self.PROMPTS[:2]
+        ]
+        for _ in range(3):
+            eng.step()  # the late submissions join a half-drained batch
+        ids += [
+            eng.submit(p, SamplingParams(max_new_tokens=14 - len(p)))
+            for p in self.PROMPTS[2:]
+        ]
+        eng.run()
+        for rid, ref in zip(ids, refs):
+            assert eng.poll(rid).generated == ref
+        stats = eng.stats()
+        assert stats["requests_completed"] == 4
+        assert stats["pages_allocated"] == 0
+
+    def test_preempted_sequence_reproduces_identical_tokens(
+        self, model_and_params
+    ):
+        """A pool too small for all requests forces preemption; resumed
+        sequences still emit exactly the offline token stream."""
+        model, params = model_and_params
+        prompts = self.PROMPTS[:3]
+        refs = [offline_greedy(model, params, p, 8) for p in prompts]
+        eng = InferenceEngine(
+            model, params, max_slots=3, max_seq_len=16, page_size=2,
+            num_pages=10, token_budget=8, max_prefill_chunk=4,
+        )
+        ids = [
+            eng.submit(p, SamplingParams(max_new_tokens=8)) for p in prompts
+        ]
+        eng.run()
+        assert eng.stats()["preemptions"] > 0, (
+            "pool was sized to force preemption"
+        )
+        assert any(eng.poll(r).preempt_count > 0 for r in ids)
+        for rid, ref in zip(ids, refs):
+            assert eng.poll(rid).generated == ref
+        eng.allocator.check_invariants()
+        assert eng.allocator.num_free == 9
+
+    def test_sampled_stream_independent_of_batch_composition(
+        self, model_and_params
+    ):
+        """fold_in(seed, token_index) keys: the same request samples the
+        same tokens whether it runs alone or beside other requests."""
+        model, params = model_and_params
+        sp = SamplingParams(max_new_tokens=10, temperature=1.0, seed=42)
+        eng = InferenceEngine(model, params, max_slots=2, max_seq_len=32,
+                              page_size=4)
+        solo = eng.submit([5, 7, 11], sp)
+        eng.run()
+        eng2 = InferenceEngine(model, params, max_slots=2, max_seq_len=32,
+                               page_size=4)
+        eng2.submit(
+            [1, 2, 3, 4],
+            SamplingParams(max_new_tokens=6, temperature=0.7, seed=7),
+        )
+        both = eng2.submit([5, 7, 11], sp)
+        eng2.run()
+        assert eng.poll(solo).generated == eng2.poll(both).generated
+
+    def test_stop_token_ends_request_early(self, model_and_params):
+        model, params = model_and_params
+        ref = offline_greedy(model, params, [6, 1, 9, 9], 8)
+        stop = ref[2]
+        assert stop not in ref[:2], "test needs a stop token unique so far"
+        eng = InferenceEngine(model, params, max_slots=2, max_seq_len=32,
+                              page_size=4)
+        rid = eng.submit(
+            [6, 1, 9, 9],
+            SamplingParams(max_new_tokens=8, stop_token=stop),
+        )
+        eng.run()
+        assert eng.poll(rid).generated == ref[:3]  # stop token included
+
+
+# --------------------------------------------------------------- admission
+
+
+class TestAdmission:
+    def test_queue_full_backpressure(self, model_and_params):
+        model, params = model_and_params
+        eng = InferenceEngine(
+            model, params, max_slots=1, max_seq_len=16, page_size=4,
+            max_queue=2,
+        )
+        eng.submit([1, 2], SamplingParams(max_new_tokens=2))
+        eng.submit([3, 4], SamplingParams(max_new_tokens=2))
+        with pytest.raises(QueueFull):
+            eng.submit([5, 6], SamplingParams(max_new_tokens=2))
+        eng.run()  # queue drains; admission reopens
+        rid = eng.submit([5, 6], SamplingParams(max_new_tokens=2))
+        eng.run()
+        assert eng.poll(rid).finished
+        assert eng.stats()["rejected_queue_full"] == 1
+
+    def test_request_too_long_rejected_up_front(self, model_and_params):
+        model, params = model_and_params
+        eng = InferenceEngine(model, params, max_slots=1, max_seq_len=16,
+                              page_size=4)
+        with pytest.raises(RequestTooLong):
+            eng.submit(list(range(12)), SamplingParams(max_new_tokens=8))
+
+    def test_empty_prompt_rejected(self, model_and_params):
+        model, params = model_and_params
+        eng = InferenceEngine(model, params, max_slots=1, max_seq_len=16,
+                              page_size=4)
+        with pytest.raises(RequestTooLong):
+            eng.submit([], SamplingParams(max_new_tokens=2))
+
+    def test_latency_metrics_populated(self, model_and_params):
+        model, params = model_and_params
+        eng = InferenceEngine(model, params, max_slots=2, max_seq_len=32,
+                              page_size=4)
+        for p in ([1, 2, 3], [4, 5]):
+            eng.submit(p, SamplingParams(max_new_tokens=4))
+        eng.run()
+        s = eng.stats()
+        assert s["ttft_s_count"] == 2
+        assert s["e2e_s_count"] == 2
+        assert s["tpot_s_count"] == 2
+        assert s["ttft_s_p50"] > 0
+        assert s["tokens_generated"] == 8

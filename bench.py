@@ -472,12 +472,19 @@ def bench_serving(
     n_requests: int = 24,
     arrival_rate_hz: float = 20.0,
     seed: int = 0,
+    shared_prefix_len: int = 24,
 ):
     """Continuous-batching serving benchmark: Poisson arrivals against the
     ``serving.InferenceEngine``, reporting throughput plus TTFT/TPOT/e2e
     percentiles (the reservoirs in ``ServingMetrics``). The model is small
     on purpose — the measurement is the ENGINE (scheduler overhead, slot
-    churn, compile-once decode), not the matmuls."""
+    churn, compile-once decode), not the matmuls.
+
+    Every prompt shares a ``shared_prefix_len``-token system prefix (the
+    prefix-heavy fleet shape; 0 disables). The SAME workload — identical
+    prompts and arrival times — runs twice, prefix caching off then on, so
+    the before/after rows in ``BENCH_SERVING.json`` isolate the cache: hit
+    rate, TTFT split by hit/miss, and the cached-vs-cold TTFT p50 ratio."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -494,63 +501,95 @@ def bench_serving(
     params = model.init(
         jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
     )["params"]
-    eng = InferenceEngine(
-        model, params, max_slots=8, max_seq_len=64, page_size=8,
-        token_budget=64, max_prefill_chunk=32, max_queue=n_requests,
-    )
 
+    # One fixed workload for both passes.
     rng = np.random.default_rng(seed)
-    # Warm the compile caches off the clock — one request per power-of-two
-    # prefill bucket (a prompt of length c+1 prefills exactly one c-chunk)
-    # plus the shared decode step — then reset the accounting: TTFT must
-    # measure scheduling, not XLA compilation.
-    chunk = 1
-    while chunk <= 32:
-        warm = eng.submit(
-            rng.integers(0, 256, chunk + 1).tolist(),
-            SamplingParams(max_new_tokens=2),
-        )
-        eng.run()
-        assert eng.poll(warm).finished
-        chunk *= 2
-    eng.metrics = ServingMetrics()
-    eng.admission.accepted = 0
-
+    shared = (
+        rng.integers(0, 256, shared_prefix_len).tolist()
+        if shared_prefix_len else []
+    )
     arrivals = np.cumsum(rng.exponential(1.0 / arrival_rate_hz, n_requests))
     prompts = [
-        rng.integers(0, 256, int(rng.integers(4, 17))).tolist()
+        shared + rng.integers(0, 256, int(rng.integers(4, 17))).tolist()
         for _ in range(n_requests)
     ]
-    start = time.perf_counter()
-    submitted = 0
-    ids = []
-    while submitted < n_requests or eng.scheduler.has_work:
-        now = time.perf_counter() - start
-        while submitted < n_requests and arrivals[submitted] <= now:
-            ids.append(
-                eng.submit(
-                    prompts[submitted], SamplingParams(max_new_tokens=16)
-                )
-            )
-            submitted += 1
-        if eng.scheduler.has_work:
-            eng.step()
-        elif submitted < n_requests:
-            time.sleep(min(arrivals[submitted] - now, 0.01))
-    assert all(eng.poll(r).finished for r in ids)
+    warm_rng = np.random.default_rng(seed + 1)
 
-    stats = eng.stats()
+    def run_pass(prefix_caching: bool):
+        eng = InferenceEngine(
+            model, params, max_slots=8, max_seq_len=64, page_size=8,
+            token_budget=64, max_prefill_chunk=32, max_queue=n_requests,
+            prefix_cache=prefix_caching,
+        )
+        # Warm the compile caches off the clock — one request per
+        # power-of-two prefill bucket (a prompt of length c+1 prefills
+        # exactly one c-chunk) plus the shared decode step — then reset the
+        # accounting: TTFT must measure scheduling, not XLA compilation.
+        chunk = 1
+        while chunk <= 32:
+            warm = eng.submit(
+                warm_rng.integers(0, 256, chunk + 1).tolist(),
+                SamplingParams(max_new_tokens=2),
+            )
+            eng.run()
+            assert eng.poll(warm).finished
+            chunk *= 2
+        eng.metrics = ServingMetrics()
+        eng.admission.accepted = 0
+        eng.admission.cached_tokens_admitted = 0
+        if eng.prefix_cache is not None:
+            # Warm-request prompts were random; zero the hit accounting so
+            # the row reports the measured workload only.
+            eng.prefix_cache.lookups = eng.prefix_cache.hits = 0
+            eng.prefix_cache.tokens_hit = eng.prefix_cache.tokens_missed = 0
+
+        start = time.perf_counter()
+        submitted = 0
+        ids = []
+        while submitted < n_requests or eng.scheduler.has_work:
+            now = time.perf_counter() - start
+            while submitted < n_requests and arrivals[submitted] <= now:
+                ids.append(
+                    eng.submit(
+                        prompts[submitted], SamplingParams(max_new_tokens=16)
+                    )
+                )
+                submitted += 1
+            if eng.scheduler.has_work or eng._inflight is not None:
+                eng.step()
+            elif submitted < n_requests:
+                time.sleep(min(arrivals[submitted] - now, 0.01))
+        assert all(eng.poll(r).finished for r in ids)
+        stats = eng.stats()
+        return {
+            "prefix_caching": prefix_caching,
+            "stats": {
+                k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in stats.items()
+            },
+        }
+
+    rows = [run_pass(False), run_pass(True)]
+    off, on = rows[0]["stats"], rows[1]["stats"]
     out = {
-        "mode": "serving_poisson",
-        "workload": f"serving_lm64_poisson{arrival_rate_hz:g}hz_n{n_requests}",
+        "mode": "serving_poisson_prefix",
+        "workload": (
+            f"serving_lm64_poisson{arrival_rate_hz:g}hz_n{n_requests}"
+            f"_prefix{shared_prefix_len}"
+        ),
         "platform": jax.devices()[0].platform,
         "device_kind": jax.devices()[0].device_kind,
         "arrival_rate_hz": arrival_rate_hz,
         "n_requests": n_requests,
-        "stats": {
-            k: (round(v, 6) if isinstance(v, float) else v)
-            for k, v in stats.items()
-        },
+        "shared_prefix_len": shared_prefix_len,
+        "rows": rows,
+        "prefix_hit_rate": on.get("prefix_hit_rate", 0.0),
+        "ttft_s_p50_caching_off": off.get("ttft_s_p50"),
+        "ttft_s_p50_caching_on": on.get("ttft_s_p50"),
+        "ttft_p50_speedup_cached": (
+            round(off["ttft_s_p50"] / on["ttft_s_p50"], 4)
+            if on.get("ttft_s_p50") else None
+        ),
     }
     path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_SERVING.json"
@@ -692,8 +731,13 @@ def main():
     parser.add_argument(
         "--serving", action="store_true",
         help="benchmark the continuous-batching inference engine under "
-        "Poisson arrivals (throughput + TTFT/TPOT/e2e percentiles) and "
-        "write BENCH_SERVING.json",
+        "Poisson arrivals (throughput + TTFT/TPOT/e2e percentiles, "
+        "prefix-caching off-vs-on rows) and write BENCH_SERVING.json",
+    )
+    parser.add_argument(
+        "--shared-prefix-len", type=int, default=24, metavar="L",
+        help="length of the system-prompt prefix every --serving request "
+        "shares (0 = fully distinct prompts)",
     )
     parser.add_argument(
         "--fake_devices", type=int, default=0, metavar="N",
@@ -788,9 +832,11 @@ def run_benches(args, dev, peak):
 
     if args.serving:
         # Exclusive mode: the continuous-batching engine under open-loop
-        # Poisson load. One JSON line; full percentiles in the file.
-        result = bench_serving()
-        s = result["stats"]
+        # Poisson load, prefix caching off then on over the identical
+        # workload. One JSON line (the caching-on row is the headline);
+        # full before/after percentiles in the file.
+        result = bench_serving(shared_prefix_len=args.shared_prefix_len)
+        s = result["rows"][1]["stats"]
         print(
             json.dumps(
                 {
@@ -804,6 +850,10 @@ def run_benches(args, dev, peak):
                     "tpot_s_p50": s["tpot_s_p50"],
                     "e2e_s_p95": s["e2e_s_p95"],
                     "preemptions": s["preemptions"],
+                    "prefix_hit_rate": result["prefix_hit_rate"],
+                    "ttft_p50_speedup_cached": result[
+                        "ttft_p50_speedup_cached"
+                    ],
                 }
             )
         )

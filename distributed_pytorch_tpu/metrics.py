@@ -91,6 +91,49 @@ class ReservoirHistogram:
         }
 
 
+class ReservoirGroup:
+    """A fixed family of labeled :class:`ReservoirHistogram` reservoirs
+    sharing one capacity — per-source latency splits, e.g. TTFT for
+    prefix-cache hits vs misses. Labels are declared up front so the
+    summary surface is stable (an unseen label reports ``count: 0`` rather
+    than vanishing); each label gets a distinct derived seed so the
+    reservoirs stay deterministic yet uncorrelated."""
+
+    def __init__(self, labels, capacity: int = 1024, seed: int = 0):
+        labels = tuple(labels)
+        if not labels:
+            raise ValueError("ReservoirGroup needs at least one label")
+        if len(set(labels)) != len(labels):
+            raise ValueError(f"duplicate labels: {labels}")
+        self._hists: Dict[str, ReservoirHistogram] = {
+            label: ReservoirHistogram(capacity, seed=seed + i)
+            for i, label in enumerate(labels)
+        }
+
+    @property
+    def labels(self):
+        return tuple(self._hists)
+
+    def __getitem__(self, label: str) -> ReservoirHistogram:
+        return self._hists[label]
+
+    def record(self, label: str, value: float) -> None:
+        try:
+            hist = self._hists[label]
+        except KeyError:
+            raise KeyError(
+                f"unknown label {label!r}; declared: {self.labels}"
+            ) from None
+        hist.record(value)
+
+    def summary(self, prefix: str = "") -> Dict[str, float]:
+        """Every label's summary merged flat: ``{prefix}{label}_p50`` etc."""
+        out: Dict[str, float] = {}
+        for label, hist in self._hists.items():
+            out.update(hist.summary(f"{prefix}{label}_"))
+        return out
+
+
 class MetricLogger:
     """Process-0 metric emitter: one JSON line per report + optional TensorBoard."""
 

@@ -7,10 +7,10 @@ engine, split exactly along the pjit paper's host/device line:
 
 * the device runs ONE fixed-shape jit decode step (padded slots masked out,
   so there is exactly one compilation per shape bucket);
-* the host owns everything irregular: the paged KV-cache free list
-  (:mod:`.kv_cache`), the waiting queue / chunked-prefill / preemption
-  policy (:mod:`.scheduler`), and admission control + latency metrics
-  (:mod:`.admission`);
+* the host owns everything irregular: the refcounted paged KV allocator and
+  the prefix-cache trie (:mod:`.kv_cache`), the waiting queue /
+  chunked-prefill / copy-on-write / preemption policy (:mod:`.scheduler`),
+  and admission control + latency metrics (:mod:`.admission`);
 * :class:`.engine.InferenceEngine` glues them behind
   ``submit(prompt, params) -> request_id`` / ``step()`` / ``poll()``.
 
@@ -30,8 +30,10 @@ from distributed_pytorch_tpu.serving.kv_cache import (
     BlockTable,
     OutOfPages,
     PagedBlockAllocator,
+    PrefixCache,
 )
 from distributed_pytorch_tpu.serving.scheduler import (
+    PENDING_TOKEN,
     Request,
     RequestState,
     SamplingParams,
@@ -45,7 +47,9 @@ __all__ = [
     "BlockTable",
     "InferenceEngine",
     "OutOfPages",
+    "PENDING_TOKEN",
     "PagedBlockAllocator",
+    "PrefixCache",
     "QueueFull",
     "Request",
     "RequestState",

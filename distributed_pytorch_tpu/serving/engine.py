@@ -4,27 +4,45 @@ fixed-shape jit decode step.
 ``submit(prompt, params) -> request_id`` / ``step()`` / ``poll(request_id)``.
 Every ``step()``:
 
-1. asks the :class:`~.scheduler.Scheduler` for a plan (admission, chunked
-   prefill under the token budget, the batched decode set, preemption);
-2. executes the prefill chunks — each a ``[1, C]`` jit call writing K/V into
+1. asks the :class:`~.scheduler.Scheduler` for a plan (admission with
+   prefix-cache lookup, copy-on-write page copies, chunked prefill under
+   the token budget, the batched decode set, preemption);
+2. executes the CoW copies — one compiled page-copy program per shared page
+   a writer is about to extend;
+3. executes the prefill chunks — each a ``[1, C]`` jit call writing K/V into
    the request's pages (logits dead-code-eliminated), compiled once per
-   power-of-two chunk size;
-3. executes ONE batched decode step over all ``max_slots`` slots — inactive
-   slots are padded (null block table, length 0) and masked, so the decode
-   program compiles exactly once regardless of which requests are live;
-4. harvests sampled tokens host-side, retires finished requests, records
-   TTFT/TPOT/e2e.
+   power-of-two chunk size, starting at the first token the prefix cache
+   did not already cover;
+4. dispatches ONE batched decode step over all ``max_slots`` slots —
+   inactive slots are padded (null block table, length 0) and masked, so
+   the decode program compiles exactly once regardless of which requests
+   are live;
+5. resolves the PREVIOUS step's decode readback (overlapped stepping: the
+   blocking ``np.asarray`` lands while the device chews on the decode just
+   dispatched), retires finished requests, records TTFT/TPOT/e2e.
+
+Overlap mechanics: the sampled-token vector from step N is fed back into
+step N+1 as a device-resident ``prev`` argument — each slot's input token
+is ``where(use_prev, prev[slot], host_token)`` — so a decoding sequence's
+next input never round-trips through the host. Host bookkeeping tracks the
+dispatch with a PENDING placeholder that :meth:`Scheduler.resolve_decoded`
+fills in one step later. ``overlap=False`` resolves synchronously (same
+compiled program; ``use_prev`` is simply always 0), which is also the
+behavior under a scheduler that never redispatches an unresolved slot.
 
 The decode math is :func:`~distributed_pytorch_tpu.generation
 .decode_token_step` — the SAME single-token step ``generate()``'s offline
 loop runs — against the paged cache, so continuous batching is
 token-for-token identical to offline decode (pinned by
-``tests/test_serving.py`` on CPU).
+``tests/test_serving.py`` on CPU), with or without prefix caching and
+overlap.
 
 Sampling determinism: each request gets ``PRNGKey(seed)`` and token i is
 drawn with ``fold_in(key, i)`` — independent of batch composition, slot
 assignment, and preemption, so a preempted-then-resumed request reproduces
-its exact stream.
+its exact stream. Under overlap the fold index is the DISPATCH count
+(``n_issued``), which equals the generated count at the same point of the
+synchronous schedule.
 """
 
 from __future__ import annotations
@@ -32,7 +50,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -46,8 +64,12 @@ from distributed_pytorch_tpu.serving.admission import (
     AdmissionController,
     ServingMetrics,
 )
-from distributed_pytorch_tpu.serving.kv_cache import PagedBlockAllocator
+from distributed_pytorch_tpu.serving.kv_cache import (
+    PagedBlockAllocator,
+    PrefixCache,
+)
 from distributed_pytorch_tpu.serving.scheduler import (
+    PENDING_TOKEN,
     Request,
     SamplingParams,
     Scheduler,
@@ -73,7 +95,15 @@ class InferenceEngine:
     it is cloned with ``decode=True, page_size, num_pages`` internally.
     ``num_pages`` defaults to exactly enough pages for every slot to hold
     ``max_seq_len`` tokens (+1 for the reserved null page) — i.e. no
-    overcommit; pass a smaller value to exercise preemption.
+    overcommit; pass a smaller value to exercise preemption and cache
+    eviction.
+
+    ``prefix_cache=True`` shares page-aligned K/V across requests with a
+    common prompt prefix (retired pages idle on an LRU instead of freeing);
+    ``overlap=True`` defers each decode readback by one step so host
+    scheduling hides under device compute. Both default on — outputs are
+    bitwise-identical either way. ``debug=True`` re-enables the
+    O(num_pages) allocator invariant sweep after every schedule.
 
     ``top_k``/``top_p`` are engine-static (compiled into the decode step);
     temperature and seed are per-request (:class:`SamplingParams`).
@@ -91,8 +121,12 @@ class InferenceEngine:
         token_budget: int = 64,
         max_prefill_chunk: int = 32,
         max_queue: int = 128,
+        max_queue_tokens: Optional[int] = None,
         top_k: int = 0,
         top_p: float = 0.0,
+        prefix_cache: bool = True,
+        overlap: bool = True,
+        debug: bool = False,
     ):
         if max_seq_len % page_size:
             raise ValueError(
@@ -106,6 +140,7 @@ class InferenceEngine:
         self.max_slots = max_slots
         self.max_seq_len = max_seq_len
         self.params = params
+        self.overlap = overlap
         self._top_k = int(top_k)
         self._top_p = float(top_p)
 
@@ -125,6 +160,9 @@ class InferenceEngine:
         )
 
         self.allocator = PagedBlockAllocator(num_pages)
+        self.prefix_cache = (
+            PrefixCache(self.allocator, page_size) if prefix_cache else None
+        )
         self.scheduler = Scheduler(
             self.allocator,
             max_slots=max_slots,
@@ -132,14 +170,39 @@ class InferenceEngine:
             pages_per_seq=self.pages_per_seq,
             token_budget=token_budget,
             max_prefill_chunk=max_prefill_chunk,
+            prefix_cache=self.prefix_cache,
+            debug=debug,
         )
         self.admission = AdmissionController(
-            max_queue=max_queue, max_request_tokens=max_seq_len
+            max_queue=max_queue,
+            max_request_tokens=max_seq_len,
+            max_queue_tokens=max_queue_tokens,
         )
         self.metrics = ServingMetrics()
         self.requests: Dict[int, Request] = {}
         self._next_id = 0
         self._keys: Dict[int, jax.Array] = {}
+
+        # Reusable host staging buffers for the batched decode inputs —
+        # refilled in place every step instead of reallocated. Rows for
+        # inactive slots MUST be re-zeroed each step (a stale block-table
+        # row would scatter the masked write into a page some other request
+        # now owns); jnp.asarray copies host->device, so mutating these
+        # after dispatch is safe.
+        self._stage_tokens = np.zeros((max_slots,), np.int32)
+        self._stage_tables = np.zeros(
+            (max_slots, self.pages_per_seq), np.int32
+        )
+        self._stage_lens = np.zeros((max_slots,), np.int32)
+        self._stage_temps = np.zeros((max_slots,), np.float32)
+        self._stage_keys = np.zeros((max_slots, 2), np.uint32)
+        self._stage_use_prev = np.zeros((max_slots,), np.int32)
+        self._zero_prev = jnp.zeros((max_slots,), jnp.int32)
+        # (sampled-token device array, decode slots, their requests) of the
+        # not-yet-resolved dispatch, or None.
+        self._inflight: Optional[
+            Tuple[jax.Array, List[int], List[Request]]
+        ] = None
 
     # ------------------------------------------------------------- compiled
 
@@ -147,12 +210,16 @@ class InferenceEngine:
     def _decode_step(self):
         """THE batched decode program: one compile for the engine's
         lifetime. Greedy and sampled rows coexist via a per-slot temperature
-        vector (0 = greedy) so slot composition never re-specializes it."""
+        vector (0 = greedy); ``prev``/``use_prev`` splice the previous
+        step's device-resident samples in as inputs so overlapped slots
+        never wait on a host readback."""
         top_k, top_p = self._top_k, self._top_p
 
-        def run(params, cache, tokens, tables, lens, temps, keys):
+        def run(params, cache, tokens, prev, use_prev, tables, lens, temps,
+                keys):
+            tok = jnp.where(use_prev > 0, prev, tokens)
             last_logits, cache = decode_token_step(
-                self.decode_model, params, cache, tokens[:, None],
+                self.decode_model, params, cache, tok[:, None],
                 block_tables=tables, seq_lens=lens,
             )
             greedy = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
@@ -180,6 +247,19 @@ class InferenceEngine:
 
         return jax.jit(run, donate_argnums=(1,))
 
+    @functools.cached_property
+    def _copy_page(self):
+        """Copy one physical page across every layer's K/V pool — the
+        device half of copy-on-write. Page ids are traced scalars, so this
+        compiles exactly once."""
+
+        def run(cache, src, dst):
+            return jax.tree_util.tree_map(
+                lambda pool: pool.at[dst].set(pool[src]), cache
+            )
+
+        return jax.jit(run, donate_argnums=(0,))
+
     # ----------------------------------------------------------------- API
 
     def submit(
@@ -190,15 +270,27 @@ class InferenceEngine:
         """Queue one request; returns its id. Raises
         :class:`~.admission.QueueFull` (backpressure) or
         :class:`~.admission.RequestTooLong` (can never fit) — admission is
-        decided NOW, not at first schedule."""
+        decided NOW, not at first schedule, and counts the currently-cached
+        prefix: a shared-prompt request costs only its uncached tail of
+        prefill work against the queue-token budget."""
         params = params or SamplingParams()
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
-        self.admission.check(len(prompt), params, self.scheduler.num_waiting)
+        cached = 0
+        if self.prefix_cache is not None and prompt:
+            cached = self.prefix_cache.peek(prompt)
+        self.admission.check(
+            len(prompt), params, self.scheduler.num_waiting,
+            cached_tokens=cached,
+            queued_uncached_tokens=sum(
+                r.est_uncached for r in self.scheduler.waiting
+            ),
+        )
         req = Request(
             req_id=self._next_id,
             prompt=prompt,
             params=params,
             submit_time=time.perf_counter(),
+            est_uncached=max(0, len(prompt) - 1 - cached),
         )
         self._next_id += 1
         self.requests[req.req_id] = req
@@ -206,12 +298,47 @@ class InferenceEngine:
         self.scheduler.add(req)
         return req.req_id
 
+    def _resolve_inflight(self) -> List[int]:
+        """Read back the outstanding decode dispatch (the ONE blocking
+        device sync — under overlap it lands while the next step computes),
+        fill in sampled tokens, retire what finished."""
+        nxt, slots, reqs = self._inflight
+        self._inflight = None
+        nxt_host = np.asarray(nxt)
+        now = time.perf_counter()
+        finished: List[int] = []
+        for slot, req in zip(slots, reqs):
+            done = self.scheduler.resolve_decoded(
+                req, int(nxt_host[slot]), now=now
+            )
+            if done is not None:
+                self.scheduler.retire(done, now=now)
+                self.metrics.observe_finished(done)
+                self._keys.pop(done.req_id, None)
+                finished.append(done.req_id)
+        return finished
+
     def step(self) -> List[int]:
         """Run one engine iteration; returns ids of requests that FINISHED
-        during it. A no-op (empty list) when nothing is queued or running."""
+        during it (under overlap, a finish surfaces on the step after its
+        token was dispatched). A no-op (empty list) when nothing is queued,
+        running, or in flight."""
         plan = self.scheduler.schedule()
+
+        for _slot, src, dst in plan.copies:
+            self.cache = self._copy_page(
+                self.cache,
+                jnp.asarray(src, jnp.int32),
+                jnp.asarray(dst, jnp.int32),
+            )
+
         if plan.empty:
-            return []
+            # Nothing to dispatch — drain the outstanding readback (e.g.
+            # the final token of the last request) before reporting idle.
+            return (
+                self._resolve_inflight() if self._inflight is not None
+                else []
+            )
 
         for slot, chunk in plan.prefill:
             req = self.scheduler.slots[slot]
@@ -227,42 +354,61 @@ class InferenceEngine:
             self.scheduler.note_prefilled(slot, chunk)
 
         finished: List[int] = []
+        dispatched = None
         if plan.decode_slots:
-            tokens = np.zeros((self.max_slots,), np.int32)
-            tables = np.zeros(
-                (self.max_slots, self.pages_per_seq), np.int32
-            )
-            lens = np.zeros((self.max_slots,), np.int32)
-            temps = np.zeros((self.max_slots,), np.float32)
-            keys = np.zeros((self.max_slots, 2), np.uint32)
+            self._stage_tables.fill(0)
+            self._stage_lens.fill(0)
+            self._stage_use_prev.fill(0)
             for slot in plan.decode_slots:
                 req = self.scheduler.slots[slot]
-                tokens[slot] = req.tokens[req.len_cached]
-                tables[slot] = req.table.as_row(self.pages_per_seq)
-                lens[slot] = req.len_cached
-                temps[slot] = req.params.temperature
-                keys[slot] = np.asarray(
+                pos = req.len_cached
+                tok = req.tokens[pos]
+                if tok == PENDING_TOKEN:
+                    # Input is last step's still-in-flight sample: select
+                    # it device-side from ``prev``.
+                    self._stage_use_prev[slot] = 1
+                    self._stage_tokens[slot] = 0
+                else:
+                    self._stage_tokens[slot] = tok
+                self._stage_tables[slot] = req.table.as_row(
+                    self.pages_per_seq
+                )
+                self._stage_lens[slot] = pos
+                self._stage_temps[slot] = req.params.temperature
+                self._stage_keys[slot] = np.asarray(
                     jax.random.fold_in(
-                        self._keys[req.req_id], req.n_generated
+                        self._keys[req.req_id], req.n_issued
                     ),
                     np.uint32,
                 )
-            nxt, self.cache = self._decode_step(
-                self.params, self.cache, jnp.asarray(tokens),
-                jnp.asarray(tables), jnp.asarray(lens),
-                jnp.asarray(temps), jnp.asarray(keys),
+            prev = (
+                self._inflight[0] if self._inflight is not None
+                else self._zero_prev
             )
-            nxt_host = np.asarray(nxt)  # device sync point
-            now = time.perf_counter()
-            for slot in plan.decode_slots:
-                done = self.scheduler.note_decoded(
-                    slot, int(nxt_host[slot]), now=now
-                )
-                if done is not None:
-                    self.scheduler.retire(done, now=now)
-                    self.metrics.observe_finished(done)
-                    self._keys.pop(done.req_id, None)
-                    finished.append(done.req_id)
+            nxt, self.cache = self._decode_step(
+                self.params, self.cache,
+                jnp.asarray(self._stage_tokens), prev,
+                jnp.asarray(self._stage_use_prev),
+                jnp.asarray(self._stage_tables),
+                jnp.asarray(self._stage_lens),
+                jnp.asarray(self._stage_temps),
+                jnp.asarray(self._stage_keys),
+            )
+            dispatched = (
+                nxt,
+                list(plan.decode_slots),
+                [
+                    self.scheduler.note_decode_dispatched(s)
+                    for s in plan.decode_slots
+                ],
+            )
+        # Resolve LAST step's tokens now — the np.asarray sync overlaps
+        # with the decode dispatched above.
+        if self._inflight is not None:
+            finished.extend(self._resolve_inflight())
+        self._inflight = dispatched
+        if not self.overlap and self._inflight is not None:
+            finished.extend(self._resolve_inflight())
         self.metrics.observe_step(new_tokens=len(plan.decode_slots))
         return finished
 
@@ -283,7 +429,7 @@ class InferenceEngine:
         bug to a loud failure instead of a hang."""
         finished: List[int] = []
         steps = 0
-        while self.scheduler.has_work:
+        while self.scheduler.has_work or self._inflight is not None:
             if steps >= max_steps:
                 raise RuntimeError(
                     f"engine did not drain within {max_steps} steps "
@@ -295,10 +441,16 @@ class InferenceEngine:
         return finished
 
     def stats(self) -> Dict[str, float]:
-        """Metrics snapshot + admission counters + cache pressure."""
+        """Metrics snapshot + admission counters + cache pressure +
+        prefix-cache hit rates."""
         out = self.metrics.snapshot()
         out.update(self.admission.counters())
         out["preemptions"] = self.scheduler.preemptions
+        out["cow_copies"] = self.scheduler.cow_copies
         out["pages_free"] = self.allocator.num_free
         out["pages_allocated"] = self.allocator.num_allocated
+        out["pages_idle"] = self.allocator.num_idle
+        out["page_evictions"] = self.allocator.evictions
+        if self.prefix_cache is not None:
+            out.update(self.prefix_cache.stats())
         return out

@@ -2,25 +2,37 @@
 
 The device holds ONE global cache per attention layer, laid out
 ``[num_pages, page_size, Hkv, D]`` (see ``models/transformer.py``'s paged
-decode mode). This module owns the host half: a free-list allocator over
-physical page ids and a per-sequence :class:`BlockTable` mapping logical
-pages to physical ones. Two invariants make slot reuse copy-free:
+decode mode). This module owns the host half: a refcounted allocator over
+physical page ids, a per-sequence :class:`BlockTable` mapping logical pages
+to physical ones, and a :class:`PrefixCache` hash-trie that maps
+page-aligned token prefixes to already-computed pages so shared prompts are
+prefilled once. Invariants that keep sharing copy-free and leak-proof:
 
 * **Page 0 is the NULL page** — never allocated. Inactive decode slots and
   padded block-table entries all point at it; the attention visibility mask
   guarantees nothing read from it survives the softmax, so retired pages
   need no zeroing before reuse (stale K/V beyond a row's ``seq_len`` is
   masked exactly like stale cache beyond ``cache_index`` in offline decode).
-* **Every allocated page is owned by exactly one table** — the allocator
-  tracks the owning set, so a double-free or a leak is an immediate
-  ``AssertionError`` in :meth:`PagedBlockAllocator.check_invariants`, not a
-  silent cross-request cache corruption. The scheduler property test drives
-  1k randomized submit/finish/preempt cycles against this.
+* **Every page is in exactly one of three states**: *free* (content
+  meaningless), *referenced* (refcount >= 1 readers hold it in a block
+  table), or *cached-idle* (refcount 0 but registered in the prefix trie;
+  content is valid K/V, kept on an LRU and evicted only under allocation
+  pressure). A double-unref or a leak is an immediate ``AssertionError`` in
+  :meth:`PagedBlockAllocator.check_invariants`, not a silent cross-request
+  cache corruption. The scheduler property tests drive randomized
+  submit/finish/preempt/evict cycles against this.
+* **Writers own their write page exclusively.** A shared page (refcount
+  > 1) is never written in place: the scheduler copies it first
+  (copy-on-write) so concurrent extenders of a cached partial page cannot
+  clobber each other's tokens. Pages with refcount 1 may be extended in
+  place even while registered — appending beyond a registered prefix never
+  changes the prefix content a future matcher reads.
 """
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -28,17 +40,26 @@ NULL_PAGE = 0
 
 
 class OutOfPages(RuntimeError):
-    """Raised when an allocation cannot be satisfied — the scheduler's cue
-    to preempt the lowest-priority running sequence."""
+    """Raised when an allocation cannot be satisfied even after evicting
+    every cached-idle page — the scheduler's cue to preempt the
+    lowest-priority running sequence."""
 
 
 class PagedBlockAllocator:
-    """LIFO free-list over physical page ids ``1..num_pages-1``.
+    """Refcounted allocator over physical page ids ``1..num_pages-1``.
 
-    LIFO keeps reuse hot (the page most recently retired is reassigned
-    first) and, with the deterministic initial ordering, makes the whole
-    engine reproducible on CPU: identical submit/finish order yields
-    identical physical page assignments."""
+    The free list is LIFO: reuse stays hot (the page most recently retired
+    is reassigned first) and, with the deterministic initial ordering, the
+    whole engine is reproducible on CPU: identical submit/finish order
+    yields identical physical page assignments.
+
+    Refcounts support prefix sharing: :meth:`ref` adds a reader to a page
+    another sequence already holds, :meth:`unref` drops one. When the count
+    reaches zero the page either returns to the free list or — if the
+    prefix cache registered it via :meth:`mark_cached` — parks on the
+    cached-idle LRU, where its contents stay valid until allocation
+    pressure evicts it (``evict_hook`` tells the trie to forget it first).
+    """
 
     def __init__(self, num_pages: int):
         if num_pages < 2:
@@ -49,56 +70,150 @@ class PagedBlockAllocator:
         # pop() takes from the end: seed the stack so pages come out
         # 1, 2, 3, ... on a fresh allocator.
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
-        self._owned: set = set()
+        self._ref: Dict[int, int] = {}
+        # Cached-but-unreferenced pages, oldest first (LRU eviction order).
+        self._idle: "OrderedDict[int, None]" = OrderedDict()
+        # Pages registered in the prefix trie (referenced or idle).
+        self._cached: set = set()
+        # Called with the page id just before an idle page is recycled, so
+        # the prefix trie can drop the nodes that point at it.
+        self.evict_hook: Optional[Callable[[int], None]] = None
+        self.evictions = 0
 
     @property
     def num_free(self) -> int:
-        return len(self._free)
+        """Pages allocatable right now (free list + evictable idle)."""
+        return len(self._free) + len(self._idle)
 
     @property
     def num_allocated(self) -> int:
-        return len(self._owned)
+        """Pages with at least one reader."""
+        return len(self._ref)
+
+    @property
+    def num_idle(self) -> int:
+        """Cached pages with no readers (evictable under pressure)."""
+        return len(self._idle)
 
     @staticmethod
     def pages_needed(n_tokens: int, page_size: int) -> int:
         return -(-n_tokens // page_size) if n_tokens > 0 else 0
 
+    def _evict_one(self) -> None:
+        page, _ = self._idle.popitem(last=False)  # oldest first
+        self._cached.discard(page)
+        self.evictions += 1
+        if self.evict_hook is not None:
+            self.evict_hook(page)
+        self._free.append(page)
+
     def allocate(self, n: int = 1) -> List[int]:
-        """Take ``n`` pages or raise :class:`OutOfPages` taking NONE —
-        partial grabs would leak on the error path."""
+        """Take ``n`` fresh pages (refcount 1 each) or raise
+        :class:`OutOfPages` taking NONE — partial grabs would leak on the
+        error path. Cached-idle pages are evicted LRU-first to satisfy the
+        request when the free list runs dry."""
         if n < 0:
             raise ValueError(f"cannot allocate {n} pages")
-        if n > len(self._free):
+        if n > self.num_free:
             raise OutOfPages(
-                f"need {n} pages, {len(self._free)} free "
+                f"need {n} pages, {len(self._free)} free + "
+                f"{len(self._idle)} cached-idle "
                 f"of {self.num_pages - 1} allocatable"
             )
-        pages = [self._free.pop() for _ in range(n)]
-        self._owned.update(pages)
+        pages = []
+        for _ in range(n):
+            if not self._free:
+                self._evict_one()
+            page = self._free.pop()
+            self._ref[page] = 1
+            pages.append(page)
         return pages
 
-    def free(self, pages: Sequence[int]) -> None:
-        for page in pages:
-            if page not in self._owned:
-                raise AssertionError(
-                    f"freeing page {page} that is not allocated "
-                    "(double free or foreign page)"
-                )
-            self._owned.discard(page)
+    def ref(self, page: int) -> None:
+        """Add a reader to ``page`` — either sharing a live page or
+        reactivating a cached-idle one (a prefix-cache hit)."""
+        if page in self._ref:
+            self._ref[page] += 1
+        elif page in self._idle:
+            del self._idle[page]
+            self._ref[page] = 1
+        else:
+            raise AssertionError(
+                f"ref of page {page} that is neither live nor cached-idle"
+            )
+
+    def refcount(self, page: int) -> int:
+        return self._ref.get(page, 0)
+
+    def unref(self, page: int) -> None:
+        """Drop one reader. At zero readers the page parks on the
+        cached-idle LRU when the trie registered it, else frees."""
+        count = self._ref.get(page)
+        if count is None:
+            raise AssertionError(
+                f"unref of page {page} that has no readers "
+                "(double free or foreign page)"
+            )
+        if count > 1:
+            self._ref[page] = count - 1
+            return
+        del self._ref[page]
+        if page in self._cached:
+            self._idle[page] = None  # most-recently-used end
+        else:
             self._free.append(page)
 
+    def free(self, pages: Sequence[int]) -> None:
+        """Drop one reader from each page (block-table release)."""
+        for page in pages:
+            self.unref(page)
+
+    def mark_cached(self, page: int) -> None:
+        """The prefix trie registered ``page``: at refcount 0 it will idle
+        (content retained) instead of freeing."""
+        assert page in self._ref or page in self._idle, (
+            f"mark_cached on page {page} that is not live"
+        )
+        self._cached.add(page)
+
+    def touch(self, page: int) -> None:
+        """LRU-touch a cached-idle page (trie hit on an existing node)."""
+        if page in self._idle:
+            self._idle.move_to_end(page)
+
     def check_invariants(self) -> None:
-        """Free + owned partition the allocatable pages exactly."""
+        """Free + referenced + cached-idle partition the allocatable pages
+        exactly; every cached page is live or idle; refcounts positive."""
         free_set = set(self._free)
+        idle_set = set(self._idle)
+        ref_set = set(self._ref)
         assert len(free_set) == len(self._free), "duplicate page in free list"
         assert NULL_PAGE not in free_set, "null page leaked into free list"
-        assert NULL_PAGE not in self._owned, "null page was allocated"
-        assert not (free_set & self._owned), (
-            f"pages both free and owned: {free_set & self._owned}"
+        assert NULL_PAGE not in ref_set, "null page was allocated"
+        assert NULL_PAGE not in idle_set, "null page in the idle pool"
+        assert not (free_set & ref_set), (
+            f"pages both free and referenced: {free_set & ref_set}"
         )
-        assert len(free_set) + len(self._owned) == self.num_pages - 1, (
-            f"page leak: {len(free_set)} free + {len(self._owned)} owned "
-            f"!= {self.num_pages - 1} allocatable"
+        assert not (free_set & idle_set), (
+            f"pages both free and cached-idle: {free_set & idle_set}"
+        )
+        assert not (idle_set & ref_set), (
+            f"pages both cached-idle and referenced: {idle_set & ref_set}"
+        )
+        assert all(c >= 1 for c in self._ref.values()), (
+            "non-positive refcount"
+        )
+        assert self._cached <= (ref_set | idle_set), (
+            f"trie-registered pages neither live nor idle: "
+            f"{self._cached - ref_set - idle_set}"
+        )
+        assert idle_set <= self._cached, (
+            f"idle pages not registered in the trie: {idle_set - self._cached}"
+        )
+        total = len(free_set) + len(ref_set) + len(idle_set)
+        assert total == self.num_pages - 1, (
+            f"page leak: {len(free_set)} free + {len(ref_set)} referenced "
+            f"+ {len(idle_set)} idle != {self.num_pages - 1} allocatable"
         )
 
 
@@ -125,8 +240,10 @@ class BlockTable:
         return grow
 
     def release(self, allocator: PagedBlockAllocator) -> int:
-        """Return every page to the allocator (retire/preempt); returns the
-        count released. No device-side work: stale contents are masked."""
+        """Drop this table's reader from every page (retire/preempt);
+        returns the count released. No device-side work: a page with other
+        readers lives on, a trie-registered page idles with its contents
+        intact, anything else frees (stale contents are masked)."""
         n = len(self.pages)
         if n:
             allocator.free(self.pages)
@@ -143,3 +260,177 @@ class BlockTable:
         row = np.full((width,), NULL_PAGE, np.int32)
         row[: len(self.pages)] = self.pages
         return row
+
+
+class PrefixCache:
+    """Hash-trie over page-aligned token prefixes -> physical pages.
+
+    Nodes live at full-page granularity: the child key is
+    ``(parent_node_id, tuple(page_size tokens))``, so two prompts share a
+    node exactly when they share that page-aligned prefix — token content is
+    compared exactly (no hash-collision corruption). Each node pins one
+    physical page of already-computed K/V. A retired request additionally
+    registers its final *partial* page under the last full node, keyed by
+    its (< page_size) token tuple; a later request may extend it, with the
+    scheduler copy-on-writing when more than one extender holds it.
+
+    Lookup walks full-page children greedily, then tries the longest
+    matching partial child, never consuming a request's last token (the
+    decode step must be fed at least one). Every page returned is ref'd on
+    behalf of the caller. Registration dedupes: if a node already exists
+    for the same (parent, tokens), the existing page wins and the caller's
+    page stays private (freed normally at release).
+
+    Eviction is driven by the allocator: when allocation pressure recycles
+    a cached-idle page, ``_on_evict`` removes every trie entry pointing at
+    it. Descendants of an evicted node become unreachable and drain off the
+    LRU naturally — readers are unaffected either way because block tables
+    hold refs independently of the trie.
+    """
+
+    ROOT = 0
+
+    def __init__(self, allocator: PagedBlockAllocator, page_size: int):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.allocator = allocator
+        self.page_size = page_size
+        self._next_id = 1
+        # (parent_id, full-page token tuple) -> (node_id, page)
+        self._full: Dict[Tuple[int, Tuple[int, ...]], Tuple[int, int]] = {}
+        # parent_id -> {partial token tuple -> page}
+        self._partial: Dict[int, Dict[Tuple[int, ...], int]] = {}
+        # page -> list of trie entries pointing at it (a page can carry a
+        # partial node and later the full node that extends it in place).
+        self._by_page: Dict[int, List[tuple]] = {}
+        allocator.evict_hook = self._on_evict
+        self.lookups = 0
+        self.hits = 0  # lookups that matched at least one token
+        self.tokens_hit = 0
+        self.tokens_missed = 0
+
+    # ------------------------------------------------------------- queries
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self._full) + sum(len(d) for d in self._partial.values())
+
+    def _walk(self, tokens: Sequence[int], limit: int):
+        """Longest cached match of ``tokens[:limit]``: yields the full-page
+        chain then at most one partial page. Returns
+        ``(pages, matched, node)`` WITHOUT taking refs."""
+        pages: List[int] = []
+        node = self.ROOT
+        matched = 0
+        page_size = self.page_size
+        while matched + page_size <= limit:
+            entry = self._full.get(
+                (node, tuple(tokens[matched : matched + page_size]))
+            )
+            if entry is None:
+                break
+            node, page = entry
+            pages.append(page)
+            matched += page_size
+        best_len = 0
+        best_page = None
+        for ptoks, page in self._partial.get(node, {}).items():
+            m = len(ptoks)
+            if (
+                m > best_len
+                and matched + m <= limit
+                and tuple(tokens[matched : matched + m]) == ptoks
+            ):
+                best_len, best_page = m, page
+        if best_page is not None:
+            pages.append(best_page)
+            matched += best_len
+        return pages, matched, node
+
+    def peek(self, tokens: Sequence[int]) -> int:
+        """How many leading tokens of ``tokens`` (capped at ``len - 1``)
+        are cached right now — admission's feasibility estimate. Takes no
+        refs and does not touch the LRU."""
+        _, matched, _ = self._walk(tokens, max(0, len(tokens) - 1))
+        return matched
+
+    def lookup(self, tokens: Sequence[int]):
+        """Match the longest cached prefix of ``tokens`` (never the last
+        token), ref every matched page for the caller, and return
+        ``(pages, n_cached_tokens, last_full_node_id)``."""
+        limit = max(0, len(tokens) - 1)
+        pages, matched, node = self._walk(tokens, limit)
+        for page in pages:
+            self.allocator.ref(page)
+        self.lookups += 1
+        if matched:
+            self.hits += 1
+        self.tokens_hit += matched
+        self.tokens_missed += limit - matched
+        return pages, matched, node
+
+    # ---------------------------------------------------------- mutation
+
+    def register_full(
+        self, parent: int, tokens: Tuple[int, ...], page: int
+    ) -> Tuple[int, bool]:
+        """Register a freshly filled full page under ``parent``. If the
+        node already exists the existing page wins (the caller's page stays
+        private); returns ``(node_id, registered)``."""
+        assert len(tokens) == self.page_size, (
+            f"full node needs {self.page_size} tokens, got {len(tokens)}"
+        )
+        key = (parent, tokens)
+        entry = self._full.get(key)
+        if entry is not None:
+            self.allocator.touch(entry[1])
+            return entry[0], False
+        node_id = self._next_id
+        self._next_id += 1
+        self._full[key] = (node_id, page)
+        self._by_page.setdefault(page, []).append(("full", key))
+        self.allocator.mark_cached(page)
+        return node_id, True
+
+    def register_partial(
+        self, parent: int, tokens: Tuple[int, ...], page: int
+    ) -> bool:
+        """Register a retiring request's final partial page (``< page_size``
+        tokens) under ``parent``. First writer wins on identical content."""
+        if not tokens:
+            return False
+        assert len(tokens) < self.page_size, (
+            f"partial node must hold < {self.page_size} tokens"
+        )
+        children = self._partial.setdefault(parent, {})
+        if tokens in children:
+            self.allocator.touch(children[tokens])
+            return False
+        children[tokens] = page
+        self._by_page.setdefault(page, []).append(("partial", parent, tokens))
+        self.allocator.mark_cached(page)
+        return True
+
+    def _on_evict(self, page: int) -> None:
+        """Allocation pressure recycled ``page``: forget every trie entry
+        pointing at it before its contents are overwritten."""
+        for entry in self._by_page.pop(page, []):
+            if entry[0] == "full":
+                self._full.pop(entry[1], None)
+            else:
+                children = self._partial.get(entry[1])
+                if children is not None:
+                    children.pop(entry[2], None)
+                    if not children:
+                        del self._partial[entry[1]]
+
+    def stats(self) -> Dict[str, float]:
+        looked = self.tokens_hit + self.tokens_missed
+        return {
+            "prefix_lookups": self.lookups,
+            "prefix_hits": self.hits,
+            "prefix_tokens_hit": self.tokens_hit,
+            "prefix_tokens_missed": self.tokens_missed,
+            "prefix_hit_rate": self.tokens_hit / looked if looked else 0.0,
+            "prefix_nodes": self.num_nodes,
+        }

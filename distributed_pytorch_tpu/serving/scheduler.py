@@ -25,10 +25,25 @@ Design decisions, in the order they bite:
 * **Chunks are power-of-two sized** (greedy decomposition, capped at
   ``max_prefill_chunk``), so the engine compiles at most log2(cap)+1 prefill
   variants — the "one compilation per shape bucket" contract.
+* **Prefill starts at the first uncached token**: with a
+  :class:`~.kv_cache.PrefixCache` attached, admission looks the request's
+  tokens up in the trie and adopts (refs) every matched page, so a shared
+  system prompt is prefilled once fleet-wide. A writer about to extend a
+  SHARED page (refcount > 1 — concurrent extenders of a cached partial
+  page) gets a copy-on-write entry in the plan first; pages it owns alone
+  are extended in place.
 * **Preempted sequences keep their generated tokens** and re-enter the
-  waiting queue at their original priority; on re-admission the whole
-  prompt+generated prefix is re-prefilled. With per-request fold_in RNG the
-  resumed continuation reproduces the identical token stream.
+  waiting queue at their original priority; on re-admission the prefix
+  cache usually re-serves the pages they just released (release only idles
+  registered pages), so re-prefill cost shrinks to the uncached tail.
+* **Decode results may resolve a step late** (the engine's overlapped
+  loop): :meth:`note_decode_dispatched` advances the host-known state
+  (cache position, a PENDING placeholder token) at dispatch, and
+  :meth:`resolve_decoded` fills in the sampled value when the device
+  readback lands. Everything the planner needs (page pressure, budget,
+  max_new_tokens) is host-known at dispatch; only stop-token detection
+  waits for the value, costing at most one speculative decode step that
+  :meth:`resolve_decoded` rolls back.
 """
 
 from __future__ import annotations
@@ -43,7 +58,13 @@ from distributed_pytorch_tpu.serving.kv_cache import (
     BlockTable,
     OutOfPages,
     PagedBlockAllocator,
+    PrefixCache,
 )
+
+# Placeholder for a sampled token whose device readback has not landed yet
+# (overlapped stepping). Never a valid vocab id; never visible through
+# poll() — ``generated`` only ever holds resolved values.
+PENDING_TOKEN = -1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,7 +93,8 @@ class Request:
     """One in-flight generation request. ``tokens`` = prompt + generated;
     ``len_cached`` counts how many of them have K/V in the paged cache.
     Invariant while in DECODE state: ``len_cached == len(tokens) - 1`` — the
-    next decode step feeds ``tokens[len_cached]`` and appends the sample."""
+    next decode step feeds ``tokens[len_cached]`` and appends the sample
+    (as :data:`PENDING_TOKEN` until the readback resolves it)."""
 
     req_id: int
     prompt: List[int]
@@ -87,6 +109,18 @@ class Request:
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
     preempt_count: int = 0
+    # Positions in ``tokens`` holding PENDING_TOKEN, oldest first — decode
+    # dispatches whose sampled value has not been read back yet.
+    pending_idx: List[int] = dataclasses.field(default_factory=list)
+    # Prefix-trie cursor: the node covering the first ``trie_pages`` full
+    # pages of ``tokens`` (matched at admission, advanced as pages fill).
+    trie_node: int = PrefixCache.ROOT
+    trie_pages: int = 0
+    # Tokens served from the prefix cache at FIRST admission (None until
+    # then; 0 = a clean miss) — the TTFT hit/miss split keys off this.
+    cached_prompt_tokens: Optional[int] = None
+    # Admission-time estimate of uncached prefill work (queue backpressure).
+    est_uncached: int = 0
 
     def __post_init__(self):
         if not self.tokens:
@@ -95,6 +129,12 @@ class Request:
     @property
     def n_generated(self) -> int:
         return len(self.generated)
+
+    @property
+    def n_issued(self) -> int:
+        """Sampled tokens requested from the device so far, including ones
+        whose readback is pending — the planner's max_new_tokens guard."""
+        return len(self.tokens) - len(self.prompt)
 
     @property
     def remaining_prefill(self) -> int:
@@ -107,10 +147,14 @@ class Request:
 
 @dataclasses.dataclass
 class StepPlan:
-    """One engine step's worth of device work: prefill chunks (executed in
-    order, each ``(slot, chunk_len)``), then one batched decode over
-    ``decode_slots``."""
+    """One engine step's worth of device work: copy-on-write page copies
+    (``(slot, src_page, dst_page)``, executed first), prefill chunks
+    (executed in order, each ``(slot, chunk_len)``), then one batched decode
+    over ``decode_slots``."""
 
+    copies: List[Tuple[int, int, int]] = dataclasses.field(
+        default_factory=list
+    )
     prefill: List[Tuple[int, int]] = dataclasses.field(default_factory=list)
     decode_slots: List[int] = dataclasses.field(default_factory=list)
 
@@ -118,13 +162,17 @@ class StepPlan:
     def empty(self) -> bool:
         return not self.prefill and not self.decode_slots
 
-
 def _pow2_floor(n: int) -> int:
     return 1 << (n.bit_length() - 1) if n > 0 else 0
 
 
 class Scheduler:
-    """Waiting queue + slot set + page-pressure policy (see module doc)."""
+    """Waiting queue + slot set + page-pressure policy (see module doc).
+
+    ``prefix_cache`` enables automatic prefix caching; ``debug=True`` runs
+    the O(num_pages) allocator invariant sweep after every
+    :meth:`schedule` call — kept on in tests, off on the serving hot path.
+    """
 
     def __init__(
         self,
@@ -135,6 +183,8 @@ class Scheduler:
         pages_per_seq: int,
         token_budget: int = 64,
         max_prefill_chunk: int = 32,
+        prefix_cache: Optional[PrefixCache] = None,
+        debug: bool = False,
     ):
         if token_budget < 1:
             raise ValueError(f"token_budget must be >= 1, got {token_budget}")
@@ -149,9 +199,12 @@ class Scheduler:
         self.pages_per_seq = pages_per_seq
         self.token_budget = token_budget
         self.max_prefill_chunk = max_prefill_chunk
+        self.prefix_cache = prefix_cache
+        self.debug = debug
         self.waiting: List[Request] = []  # kept sorted by req_id
         self.slots: List[Optional[Request]] = [None] * max_slots
         self.preemptions = 0
+        self.cow_copies = 0
 
     # ------------------------------------------------------------- queries
 
@@ -175,6 +228,19 @@ class Scheduler:
     def _admit(self, req: Request, slot: int) -> None:
         req.slot = slot
         req.len_cached = 0
+        req.trie_node = PrefixCache.ROOT
+        req.trie_pages = 0
+        if self.prefix_cache is not None:
+            assert not req.table.pages, "admitting a request holding pages"
+            pages, matched, node = self.prefix_cache.lookup(req.tokens)
+            req.table.pages = pages
+            req.len_cached = matched
+            req.trie_node = node
+            req.trie_pages = matched // self.page_size
+            if req.cached_prompt_tokens is None:
+                req.cached_prompt_tokens = matched
+        elif req.cached_prompt_tokens is None:
+            req.cached_prompt_tokens = 0
         req.state = (
             RequestState.DECODE if req.remaining_prefill == 0
             else RequestState.PREFILL
@@ -182,8 +248,9 @@ class Scheduler:
         self.slots[slot] = req
 
     def _preempt(self, req: Request) -> None:
-        """Evict ``req`` back to the waiting queue: pages freed, generated
-        tokens KEPT (they re-prefill on re-admission)."""
+        """Evict ``req`` back to the waiting queue: page refs dropped
+        (registered pages idle with contents intact, so re-admission
+        usually re-matches them), generated tokens KEPT."""
         self.preemptions += 1
         req.preempt_count += 1
         req.table.release(self.allocator)
@@ -194,15 +261,49 @@ class Scheduler:
         self.add(req)
 
     def retire(self, req: Request, now: Optional[float] = None) -> None:
-        """Finished: free pages and the slot. Copy-free — the slot and its
-        stale cache pages are immediately reusable (masking handles the
-        rest)."""
+        """Finished: register the final partial page in the prefix trie
+        (full pages were registered as they filled), then drop every page
+        ref and the slot. Registered pages idle on the LRU — demoted, not
+        freed — so the next request with this prefix hits them; eviction
+        happens lazily under OutOfPages pressure."""
+        if self.prefix_cache is not None and req.slot is not None:
+            self._register_filled(req)
+            start = req.trie_pages * self.page_size
+            valid = req.len_cached
+            if req.pending_idx:
+                valid = min(valid, req.pending_idx[0])
+            if start < valid and req.trie_pages < len(req.table.pages):
+                self.prefix_cache.register_partial(
+                    req.trie_node,
+                    tuple(req.tokens[start:valid]),
+                    req.table.pages[req.trie_pages],
+                )
         req.table.release(self.allocator)
         if req.slot is not None:
             self.slots[req.slot] = None
+        elif req.state is RequestState.WAITING:
+            # Finished while preempted (stop token resolved post-eviction).
+            self.waiting.remove(req)
         req.slot = None
         req.state = RequestState.FINISHED
         req.finish_time = time.perf_counter() if now is None else now
+
+    def _reclaim_for(self, req: Request) -> bool:
+        """Free pages for ``req`` by preempting ONE strictly lower-priority
+        victim. Returns False — after preempting ``req`` itself — when no
+        such victim exists."""
+        victim = None
+        for cand in self.running:
+            if cand.req_id > req.req_id and (
+                victim is None or cand.req_id > victim.req_id
+            ):
+                victim = cand
+        if victim is None:
+            # req is the lowest-priority page-holder; it yields.
+            self._preempt(req)
+            return False
+        self._preempt(victim)
+        return True
 
     def _ensure_pages(self, req: Request, n_tokens: int) -> bool:
         """Cover ``n_tokens`` positions of ``req``'s table, preempting
@@ -213,29 +314,51 @@ class Scheduler:
                 req.table.ensure(n_tokens, self.page_size, self.allocator)
                 return True
             except OutOfPages:
-                victim = None
-                for cand in self.running:
-                    if cand.req_id > req.req_id and (
-                        victim is None or cand.req_id > victim.req_id
-                    ):
-                        victim = cand
-                if victim is None:
-                    # req is the lowest-priority page-holder; it yields.
-                    self._preempt(req)
+                if not self._reclaim_for(req):
                     return False
-                self._preempt(victim)
+
+    def _cow_write_page(self, req: Request, plan: StepPlan) -> bool:
+        """Guarantee ``req`` exclusively owns the page it is about to write
+        (position ``len_cached``). A shared page — refcount > 1, i.e.
+        concurrent extenders of a cached partial page — is copied first:
+        the plan gains a ``(slot, src, dst)`` device copy, the table swaps
+        to the fresh page, and the shared original keeps its other readers
+        and its trie registration. Returns False iff ``req`` was preempted
+        while reclaiming a page for the copy."""
+        if self.prefix_cache is None:
+            return True
+        while True:
+            idx = req.len_cached // self.page_size
+            if idx >= len(req.table.pages):
+                return True  # write lands on a page ensure() will allocate
+            page = req.table.pages[idx]
+            if self.allocator.refcount(page) <= 1:
+                return True
+            try:
+                (fresh,) = self.allocator.allocate(1)
+            except OutOfPages:
+                if not self._reclaim_for(req):
+                    return False
+                continue  # a victim's release may also have unshared it
+            plan.copies.append((req.slot, page, fresh))
+            req.table.pages[idx] = fresh
+            self.allocator.unref(page)
+            self.cow_copies += 1
+            return True
 
     # ------------------------------------------------------------ planning
 
     def schedule(self) -> StepPlan:
         """Build the next step's plan. Mutates scheduler state (admission,
-        page allocation, preemption); the engine then executes the device
-        work and reports back via :meth:`note_prefilled` /
-        :meth:`note_decoded`."""
+        prefix-cache lookup, page allocation, copy-on-write, preemption);
+        the engine then executes the device work and reports back via
+        :meth:`note_prefilled` / :meth:`note_decode_dispatched` /
+        :meth:`resolve_decoded`."""
         plan = StepPlan()
 
-        # 1. Admit waiting requests into free slots, oldest first. Pages are
-        # allocated lazily below, so admission itself cannot fail.
+        # 1. Admit waiting requests into free slots, oldest first. Pages
+        # beyond the prefix-cache match are allocated lazily below, so
+        # admission itself cannot fail.
         for slot in range(self.max_slots):
             if not self.waiting:
                 break
@@ -243,21 +366,33 @@ class Scheduler:
                 self._admit(self.waiting.pop(0), slot)
 
         # 2. Decode set reserves budget first: one token per running
-        # sequence, each guaranteed a page for its write position.
+        # sequence, each guaranteed exclusive ownership of (copy-on-write)
+        # and a page for its write position. Requests that already issued
+        # max_new_tokens sit out — their last readback resolves this step.
         budget = self.token_budget
         for req in sorted(self.running, key=lambda r: r.req_id):
-            if req.state is not RequestState.DECODE or budget <= 0:
+            if (
+                req.state is not RequestState.DECODE
+                or budget <= 0
+                or req.n_issued >= req.params.max_new_tokens
+            ):
                 continue
+            if not self._cow_write_page(req, plan):
+                continue  # req itself was preempted reclaiming copy space
             if self._ensure_pages(req, req.len_cached + 1):
                 plan.decode_slots.append(req.slot)
                 budget -= 1
 
         # 3. Remaining budget goes to prefill chunks, highest priority
         # first, power-of-two sized so compile variants stay bounded.
+        # Prefill starts at the first uncached token (len_cached covers the
+        # prefix-cache match).
         for req in sorted(self.running, key=lambda r: r.req_id):
-            if req.state is not RequestState.PREFILL:
+            if req.state is not RequestState.PREFILL or budget <= 0:
                 continue
             slot = req.slot
+            if not self._cow_write_page(req, plan):
+                continue  # preempted; nothing was planned for it yet
             planned = req.len_cached
             while budget > 0:
                 remaining = len(req.tokens) - 1 - planned
@@ -282,16 +417,43 @@ class Scheduler:
                     (s, c) for (s, c) in plan.prefill if s != slot
                 ]
         # A prefill allocation above may have preempted a (lower-priority)
-        # request that was already planned for decode — keep only slots
-        # still holding a DECODE-state request.
+        # request that was already planned for decode or a CoW copy — keep
+        # only entries whose slot still holds a live request (slots freed
+        # mid-schedule stay free until the next schedule's admission pass).
         plan.decode_slots = [
             s for s in plan.decode_slots
             if self.slots[s] is not None
             and self.slots[s].state is RequestState.DECODE
         ]
+        plan.copies = [
+            (s, src, dst) for (s, src, dst) in plan.copies
+            if self.slots[s] is not None
+        ]
+        if self.debug:
+            self.allocator.check_invariants()
         return plan
 
     # ----------------------------------------------------------- execution
+
+    def _register_filled(self, req: Request) -> None:
+        """Register every newly completed full page of ``req`` in the
+        prefix trie (dedup: an existing node for the same prefix wins and
+        the private page is simply not cached). Pages whose tokens are
+        still PENDING readback are skipped until resolved."""
+        if self.prefix_cache is None or req.slot is None:
+            return
+        page = self.page_size
+        valid = req.len_cached
+        if req.pending_idx:
+            valid = min(valid, req.pending_idx[0])
+        while (req.trie_pages + 1) * page <= valid:
+            k = req.trie_pages
+            req.trie_node, _ = self.prefix_cache.register_full(
+                req.trie_node,
+                tuple(req.tokens[k * page : (k + 1) * page]),
+                req.table.pages[k],
+            )
+            req.trie_pages = k + 1
 
     def note_prefilled(self, slot: int, chunk: int) -> None:
         req = self.slots[slot]
@@ -300,31 +462,74 @@ class Scheduler:
         assert req.len_cached <= len(req.tokens) - 1, (
             f"request {req.req_id} prefilled past its last token"
         )
+        self._register_filled(req)
         if req.remaining_prefill == 0:
             req.state = RequestState.DECODE
 
-    def note_decoded(
-        self, slot: int, token: int, now: Optional[float] = None
-    ) -> Optional[Request]:
-        """Record one decode-step output for ``slot``. Returns the request
-        when this token FINISHED it (caller retires + records metrics)."""
+    def note_decode_dispatched(self, slot: int) -> Request:
+        """One decode step was ISSUED for ``slot``: advance the host-known
+        state now (cache position, placeholder token) so the next schedule
+        can plan around it; the sampled value lands later via
+        :meth:`resolve_decoded`. Returns the request so the engine can pair
+        it with the readback even if the slot changes hands meanwhile."""
         req = self.slots[slot]
-        assert req is not None, f"decode result for empty slot {slot}"
+        assert req is not None, f"decode dispatch for empty slot {slot}"
         assert req.state is RequestState.DECODE
         req.len_cached += 1
         assert req.len_cached == len(req.tokens), (
             f"request {req.req_id} decode out of sync"
         )
-        req.tokens.append(int(token))
-        req.generated.append(int(token))
+        req.pending_idx.append(len(req.tokens))
+        req.tokens.append(PENDING_TOKEN)
+        return req
+
+    def resolve_decoded(
+        self, req: Request, token: int, now: Optional[float] = None
+    ) -> Optional[Request]:
+        """Fill in the sampled value for ``req``'s oldest pending decode.
+        Returns the request when this token FINISHED it (caller retires +
+        records metrics). Handles the overlap edge cases: a request already
+        finished by an earlier resolve discards this (speculative) value;
+        a stop-token finish rolls back any speculative dispatch issued
+        after it."""
+        if req.done:
+            # Speculative decode issued the step after a stop token — the
+            # value is discarded and the placeholder tail dropped.
+            if req.pending_idx:
+                pos = req.pending_idx.pop(0)
+                del req.tokens[pos:]
+            return None
+        pos = req.pending_idx.pop(0)
+        assert req.tokens[pos] == PENDING_TOKEN, (
+            f"request {req.req_id} resolve out of order"
+        )
+        token = int(token)
+        req.tokens[pos] = token
+        req.generated.append(token)
         if req.first_token_time is None:
             req.first_token_time = (
                 time.perf_counter() if now is None else now
             )
+        self._register_filled(req)
         stop = req.params.stop_token
         if (
             req.n_generated >= req.params.max_new_tokens
-            or (stop is not None and int(token) == stop)
+            or (stop is not None and token == stop)
         ):
+            # Roll back anything issued speculatively past the finish: the
+            # extra KV write is garbage beyond the sequence (masked, and
+            # its pages are released at retire).
+            del req.tokens[pos + 1 :]
+            req.pending_idx.clear()
+            if req.state is not RequestState.WAITING:
+                req.len_cached = len(req.tokens) - 1
             return req
         return None
+
+    def note_decoded(
+        self, slot: int, token: int, now: Optional[float] = None
+    ) -> Optional[Request]:
+        """Synchronous dispatch + resolve in one call — the non-overlapped
+        path and the scheduler-only tests."""
+        req = self.note_decode_dispatched(slot)
+        return self.resolve_decoded(req, token, now=now)

@@ -124,3 +124,45 @@ class TestReservoirHistogram:
         }
         assert s["step_time_s_count"] == 2
         assert s["step_time_s_mean"] == 2.0
+
+
+class TestReservoirGroup:
+    def _group(self, **kw):
+        from distributed_pytorch_tpu.metrics import ReservoirGroup
+
+        kw.setdefault("capacity", 64)
+        kw.setdefault("seed", 5)
+        return ReservoirGroup(("hit", "miss"), **kw)
+
+    def test_records_split_by_label(self):
+        g = self._group()
+        for v in (1.0, 2.0, 3.0):
+            g.record("hit", v)
+        g.record("miss", 10.0)
+        assert g["hit"].count == 3
+        assert g["miss"].count == 1
+        assert g["miss"].mean == 10.0
+
+    def test_unknown_label_rejected(self):
+        g = self._group()
+        with pytest.raises(KeyError):
+            g.record("typo", 1.0)
+
+    def test_summary_merges_prefixed_labels(self):
+        g = self._group()
+        g.record("hit", 2.0)
+        s = g.summary("ttft_s_")
+        assert s["ttft_s_hit_count"] == 1
+        assert s["ttft_s_hit_p50"] == 2.0
+        # unseen labels stay in the surface with count 0, not vanish
+        assert s["ttft_s_miss_count"] == 0
+
+    def test_labels_deterministic_and_independent(self):
+        a, b = self._group(), self._group()
+        for v in range(500):
+            a.record("hit", float(v % 13))
+            b.record("hit", float(v % 13))
+            a.record("miss", float(v % 7))
+            b.record("miss", float(v % 7))
+        assert a["hit"].quantile(0.95) == b["hit"].quantile(0.95)
+        assert a["miss"].quantile(0.95) == b["miss"].quantile(0.95)

@@ -17,6 +17,7 @@ from distributed_pytorch_tpu.serving import (
     InferenceEngine,
     OutOfPages,
     PagedBlockAllocator,
+    PrefixCache,
     QueueFull,
     Request,
     RequestTooLong,
@@ -114,7 +115,7 @@ class TestSchedulerInvariants:
         alloc = PagedBlockAllocator(17)
         sched = Scheduler(
             alloc, max_slots=4, page_size=2, pages_per_seq=8,
-            token_budget=8, max_prefill_chunk=4,
+            token_budget=8, max_prefill_chunk=4, debug=True,
         )
         next_id = 0
         live = {}
@@ -156,7 +157,7 @@ class TestSchedulerInvariants:
         alloc = PagedBlockAllocator(5)  # 4 usable pages
         sched = Scheduler(
             alloc, max_slots=2, page_size=2, pages_per_seq=4,
-            token_budget=8, max_prefill_chunk=4,
+            token_budget=8, max_prefill_chunk=4, debug=True,
         )
         reqs = [
             Request(req_id=i, prompt=[1, 2, 3],
@@ -181,6 +182,156 @@ class TestSchedulerInvariants:
         assert reqs[1].preempt_count > 0
         alloc.check_invariants()
         assert alloc.num_free == 4
+
+
+# ------------------------------------------------------------ prefix cache
+
+
+class TestPrefixCacheTrie:
+    def test_full_chain_lookup_refs_pages(self):
+        alloc = PagedBlockAllocator(8)
+        cache = PrefixCache(alloc, page_size=2)
+        p1, p2 = alloc.allocate(2)
+        n1, registered = cache.register_full(PrefixCache.ROOT, (1, 2), p1)
+        assert registered
+        n2, _ = cache.register_full(n1, (3, 4), p2)
+        alloc.free([p1, p2])  # refcount 0 -> cached-idle, not freed
+        assert alloc.num_idle == 2
+        pages, matched, node = cache.lookup([1, 2, 3, 4, 5])
+        assert pages == [p1, p2] and matched == 4 and node == n2
+        assert alloc.refcount(p1) == 1 and alloc.refcount(p2) == 1
+        alloc.check_invariants()
+
+    def test_lookup_never_consumes_last_token(self):
+        """The decode step must always be fed at least one real token, so
+        a fully cached prompt still leaves its final token uncached."""
+        alloc = PagedBlockAllocator(8)
+        cache = PrefixCache(alloc, page_size=2)
+        (p1,) = alloc.allocate(1)
+        cache.register_full(PrefixCache.ROOT, (1, 2), p1)
+        alloc.free([p1])
+        pages, matched, _ = cache.lookup([1, 2])  # limit is len - 1 = 1
+        assert pages == [] and matched == 0
+        assert alloc.num_idle == 1  # untouched
+
+    def test_partial_match_requires_complete_tuple(self):
+        """A prefix-of-partial hit would hand out a page whose registered
+        tail diverges from the new prompt — must be a miss."""
+        alloc = PagedBlockAllocator(8)
+        cache = PrefixCache(alloc, page_size=4)
+        (p1,) = alloc.allocate(1)
+        assert cache.register_partial(PrefixCache.ROOT, (7, 8, 9), p1)
+        alloc.free([p1])
+        pages, matched, _ = cache.lookup([7, 8, 1, 1, 1])
+        assert matched == 0 and pages == []
+        pages, matched, _ = cache.lookup([7, 8, 9, 1, 1])
+        assert matched == 3 and pages == [p1]
+        alloc.check_invariants()
+
+    def test_register_dedupes_and_existing_page_wins(self):
+        alloc = PagedBlockAllocator(8)
+        cache = PrefixCache(alloc, page_size=2)
+        p1, p2 = alloc.allocate(2)
+        n1, first = cache.register_full(PrefixCache.ROOT, (1, 2), p1)
+        n2, second = cache.register_full(PrefixCache.ROOT, (1, 2), p2)
+        assert first and not second and n1 == n2
+        alloc.free([p1, p2])
+        assert alloc.num_idle == 1  # p2 stayed private and freed normally
+        pages, _, _ = cache.lookup([1, 2, 3])
+        assert pages == [p1]
+        alloc.check_invariants()
+
+    def test_eviction_removes_trie_entries(self):
+        alloc = PagedBlockAllocator(4)  # 3 usable pages
+        cache = PrefixCache(alloc, page_size=2)
+        pages = alloc.allocate(3)
+        node = PrefixCache.ROOT
+        for i, p in enumerate(pages):
+            node, _ = cache.register_full(node, (i, i), p)
+        alloc.free(pages)
+        assert alloc.num_idle == 3
+        alloc.allocate(2)  # pressure: evicts the two LRU-oldest idle pages
+        assert alloc.evictions == 2
+        assert cache.num_nodes == 1
+        # the chain head was evicted first, so the survivor is unreachable
+        _, matched, _ = cache.lookup([0, 0, 1, 1, 2, 2, 9])
+        assert matched == 0
+        alloc.check_invariants()
+
+
+class TestCowAllocatorProperty:
+    PREFIXES = [[1, 2, 3, 4, 5, 6, 7], [1, 2, 3, 9, 9], [4, 4]]
+
+    def test_randomized_interleaving_no_leaks_refcounts_exact(self):
+        """1.2k randomized submit/prefill/decode/retire/preempt/evict
+        cycles over the refcounted CoW allocator with prefix caching on a
+        deliberately tiny pool: after every cycle the allocator invariants
+        hold AND every page's refcount equals the number of live block
+        tables holding it; at drain nothing leaked."""
+        rng = random.Random(99)
+        alloc = PagedBlockAllocator(21)
+        cache = PrefixCache(alloc, page_size=2)
+        sched = Scheduler(
+            alloc, max_slots=4, page_size=2, pages_per_seq=8,
+            token_budget=8, max_prefill_chunk=4,
+            prefix_cache=cache, debug=True,
+        )
+        next_id = 0
+        live = {}
+
+        def check_refcounts():
+            readers = {}
+            for req in sched.running:
+                for p in req.table.pages:
+                    readers[p] = readers.get(p, 0) + 1
+            for p in range(1, alloc.num_pages):
+                assert alloc.refcount(p) == readers.get(p, 0), (
+                    f"page {p}: refcount {alloc.refcount(p)} != "
+                    f"{readers.get(p, 0)} readers"
+                )
+
+        def drive_one():
+            plan = sched.schedule()
+            for slot, chunk in plan.prefill:
+                sched.note_prefilled(slot, chunk)
+            for slot in plan.decode_slots:
+                # tiny token alphabet so generated streams collide and the
+                # trie caches (and CoW-shares) decode-time pages too
+                done = sched.note_decoded(
+                    slot, token=rng.randrange(4), now=0.0
+                )
+                if done is not None:
+                    sched.retire(done, now=0.0)
+                    del live[done.req_id]
+
+        for _ in range(1200):
+            if rng.random() < 0.45 and len(live) < 40:
+                prefix = self.PREFIXES[rng.randrange(3)]
+                tail = [rng.randrange(48) for _ in range(rng.randint(0, 5))]
+                prompt = (prefix + tail)[:11]
+                req = Request(
+                    req_id=next_id, prompt=prompt,
+                    params=SamplingParams(
+                        max_new_tokens=rng.randint(1, 16 - len(prompt)),
+                    ),
+                )
+                live[next_id] = req
+                sched.add(req)
+                next_id += 1
+            drive_one()
+            alloc.check_invariants()
+            check_refcounts()
+        for _ in range(4000):
+            if not sched.has_work:
+                break
+            drive_one()
+        assert not sched.has_work and not live
+        alloc.check_invariants()
+        check_refcounts()
+        assert alloc.num_allocated == 0
+        assert alloc.num_free == 20, "pages leaked"
+        assert cache.stats()["prefix_hit_rate"] > 0
+        assert alloc.evictions > 0, "pool was sized to force eviction"
 
 
 # ------------------------------------------------------------- engine parity
@@ -280,6 +431,158 @@ class TestEngineParity:
         )
         eng.run()
         assert eng.poll(rid).generated == ref[:3]  # stop token included
+
+
+# ------------------------------------------------------ prefix-cache parity
+
+
+class TestPrefixCachingParity:
+    PREFIX = [5, 7, 11, 2, 9, 3, 8, 1]  # two full pages at page_size=4
+
+    def _engine(self, model, params, **kw):
+        kw.setdefault("max_slots", 4)
+        kw.setdefault("max_seq_len", 64)
+        kw.setdefault("page_size", 4)
+        kw.setdefault("token_budget", 16)
+        kw.setdefault("max_prefill_chunk", 8)
+        kw.setdefault("debug", True)
+        return InferenceEngine(model, params, **kw)
+
+    def test_cached_generation_identical_to_cold(self, model_and_params):
+        """A second request sharing the first's prompt prefix starts
+        prefill past the cached pages yet emits the exact offline
+        stream."""
+        model, params = model_and_params
+        p1 = self.PREFIX + [4, 6]
+        p2 = self.PREFIX + [2, 13]
+        ref1 = offline_greedy(model, params, p1, 6)
+        ref2 = offline_greedy(model, params, p2, 6)
+        eng = self._engine(model, params)
+        a = eng.submit(p1, SamplingParams(max_new_tokens=6))
+        eng.run()
+        assert eng.stats()["prefix_tokens_hit"] == 0  # cold start
+        b = eng.submit(p2, SamplingParams(max_new_tokens=6))
+        eng.run()
+        assert eng.poll(a).generated == ref1
+        assert eng.poll(b).generated == ref2
+        s = eng.stats()
+        assert s["prefix_tokens_hit"] >= len(self.PREFIX)
+        assert s["prefix_hit_rate"] > 0
+        assert s["cached_tokens_admitted"] >= len(self.PREFIX)
+        assert s["ttft_s_hit_count"] == 1 and s["ttft_s_miss_count"] == 1
+        eng.allocator.check_invariants()
+
+    def test_shared_partial_page_copy_on_write_parity(
+        self, model_and_params
+    ):
+        """Two multi-turn continuations both extend the SAME cached partial
+        page concurrently: the scheduler must copy-on-write for one of
+        them, and both still match offline decode exactly."""
+        model, params = model_and_params
+        base = [5, 7, 11, 2, 9]
+        ref0 = offline_greedy(model, params, base, 2)
+        eng = self._engine(model, params)
+        r0 = eng.submit(base, SamplingParams(max_new_tokens=2))
+        eng.run()
+        assert eng.poll(r0).generated == ref0
+        # 6 cached tokens = 1 full page + 2 in the retired partial page
+        hist = base + ref0[:1]
+        conts = [hist + [3], hist + [17]]
+        refs = [offline_greedy(model, params, p, 5) for p in conts]
+        ids = [
+            eng.submit(p, SamplingParams(max_new_tokens=5)) for p in conts
+        ]
+        eng.run()
+        for rid, ref in zip(ids, refs):
+            assert eng.poll(rid).generated == ref
+        assert eng.scheduler.cow_copies >= 1
+        assert eng.stats()["prefix_tokens_hit"] > 0
+        eng.allocator.check_invariants()
+        assert eng.allocator.num_allocated == 0
+
+    def test_eviction_under_pressure_keeps_parity(self, model_and_params):
+        """A pool too small to retain every retired prefix forces LRU
+        eviction of cached-idle pages; outputs stay exact throughout."""
+        model, params = model_and_params
+        eng = self._engine(
+            model, params, max_slots=2, max_seq_len=16, page_size=2,
+            num_pages=10, token_budget=8, max_prefill_chunk=4,
+        )
+        prompts = [[i, i + 1, i + 2] for i in range(0, 12, 3)]
+        refs = [offline_greedy(model, params, p, 5) for p in prompts]
+        ids = []
+        for p in prompts:
+            ids.append(eng.submit(p, SamplingParams(max_new_tokens=5)))
+            eng.run()
+        for rid, ref in zip(ids, refs):
+            assert eng.poll(rid).generated == ref
+        assert eng.allocator.evictions > 0
+        eng.allocator.check_invariants()
+
+    def test_feature_toggles_do_not_change_tokens(self, model_and_params):
+        """prefix_cache / overlap on or off is a pure perf choice: sampled
+        streams are bitwise identical across all four combinations."""
+        model, params = model_and_params
+        prompts = TestEngineParity.PROMPTS
+        outs = []
+        for kw in (
+            {},
+            {"prefix_cache": False},
+            {"overlap": False},
+            {"prefix_cache": False, "overlap": False},
+        ):
+            eng = self._engine(model, params, **kw)
+            ids = [
+                eng.submit(
+                    p,
+                    SamplingParams(
+                        max_new_tokens=14 - len(p), temperature=0.8, seed=3
+                    ),
+                )
+                for p in prompts
+            ]
+            eng.run()
+            outs.append([eng.poll(r).generated for r in ids])
+        assert outs[0] == outs[1] == outs[2] == outs[3]
+
+    def test_overlap_speculative_stop_leaves_no_leaks(
+        self, model_and_params
+    ):
+        """Under overlap a stop token is detected one step late; the
+        speculative dispatch past it must be rolled back without leaking
+        pages or placeholder tokens."""
+        model, params = model_and_params
+        ref = offline_greedy(model, params, [6, 1, 9, 9], 8)
+        stop = ref[2]
+        eng = self._engine(model, params, overlap=True)
+        rid = eng.submit(
+            [6, 1, 9, 9], SamplingParams(max_new_tokens=8, stop_token=stop)
+        )
+        eng.run()
+        assert eng.poll(rid).generated == ref[:3]
+        req = eng.requests[rid]
+        assert req.tokens == [6, 1, 9, 9] + ref[:3]
+        assert not req.pending_idx
+        assert eng.allocator.num_allocated == 0
+        eng.allocator.check_invariants()
+
+    def test_queue_token_budget_counts_only_uncached(self, model_and_params):
+        """max_queue_tokens bounds queued UNCACHED prefill work: a prompt
+        whose prefix is cached costs only its tail against the budget."""
+        model, params = model_and_params
+        long1 = self.PREFIX + [4, 6]
+        long2 = self.PREFIX + [2, 13]
+        eng = self._engine(model, params, max_queue_tokens=10)
+        eng.submit(long1, SamplingParams(max_new_tokens=4))
+        with pytest.raises(QueueFull):
+            eng.submit(long2, SamplingParams(max_new_tokens=4))
+        eng.run()
+        # PREFIX's pages are cached now: the same prompts cost ~1 uncached
+        # token each, so both fit the budget that just rejected one.
+        eng.submit(long2, SamplingParams(max_new_tokens=4))
+        eng.submit(self.PREFIX + [1, 1], SamplingParams(max_new_tokens=4))
+        eng.run()
+        assert eng.stats()["rejected_queue_full"] == 1
 
 
 # --------------------------------------------------------------- admission

@@ -473,6 +473,8 @@ def bench_serving(
     arrival_rate_hz: float = 20.0,
     seed: int = 0,
     shared_prefix_len: int = 24,
+    speculative: bool = False,
+    gamma: int = 4,
 ):
     """Continuous-batching serving benchmark: Poisson arrivals against the
     ``serving.InferenceEngine``, reporting throughput plus TTFT/TPOT/e2e
@@ -484,7 +486,17 @@ def bench_serving(
     prefix-heavy fleet shape; 0 disables). The SAME workload — identical
     prompts and arrival times — runs twice, prefix caching off then on, so
     the before/after rows in ``BENCH_SERVING.json`` isolate the cache: hit
-    rate, TTFT split by hit/miss, and the cached-vs-cold TTFT p50 ratio."""
+    rate, TTFT split by hit/miss, and the cached-vs-cold TTFT p50 ratio.
+
+    ``speculative=True`` instead holds prefix caching on and toggles
+    SPECULATIVE decoding off-vs-on over the identical workload, reporting
+    acceptance rate and the TPOT p50/p95 delta. Untrained random weights
+    admit no correlated small draft (any truncation decorrelates the
+    logits to chance, ~1/vocab acceptance), so the proxy drafts with the
+    target itself — acceptance exactly 1.0, measuring the ENGINE's
+    per-round amortization ceiling at this gamma: host scheduling, staging
+    and dispatch are paid once per round instead of once per token. With a
+    real (distilled) draft, the reported acceptance rate scales that win."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -515,11 +527,16 @@ def bench_serving(
     ]
     warm_rng = np.random.default_rng(seed + 1)
 
-    def run_pass(prefix_caching: bool):
+    def run_pass(prefix_caching: bool, spec: bool = False):
+        kw = {}
+        if spec:
+            kw.update(
+                draft_model=model, draft_params=params, gamma=gamma
+            )
         eng = InferenceEngine(
             model, params, max_slots=8, max_seq_len=64, page_size=8,
             token_budget=64, max_prefill_chunk=32, max_queue=n_requests,
-            prefix_cache=prefix_caching,
+            prefix_cache=prefix_caching, **kw,
         )
         # Warm the compile caches off the clock — one request per
         # power-of-two prefill bucket (a prompt of length c+1 prefills
@@ -534,7 +551,7 @@ def bench_serving(
             eng.run()
             assert eng.poll(warm).finished
             chunk *= 2
-        eng.metrics = ServingMetrics()
+        eng.metrics = ServingMetrics(speculative=eng.speculative)
         eng.admission.accepted = 0
         eng.admission.cached_tokens_admitted = 0
         if eng.prefix_cache is not None:
@@ -563,6 +580,7 @@ def bench_serving(
         stats = eng.stats()
         return {
             "prefix_caching": prefix_caching,
+            "speculative": spec,
             "stats": {
                 k: (round(v, 6) if isinstance(v, float) else v)
                 for k, v in stats.items()
@@ -591,6 +609,27 @@ def bench_serving(
             if on.get("ttft_s_p50") else None
         ),
     }
+    if speculative:
+        # Third pass: the prefix-cached workload again with speculative
+        # rounds. Row [1] (prefix on, spec off) is the control — same
+        # engine config, same workload, only the draft toggled.
+        rows.append(run_pass(True, spec=True))
+        spec_on = rows[2]["stats"]
+        out["mode"] = "serving_poisson_prefix_spec"
+        out["workload"] += f"_gamma{gamma}"
+        out["gamma"] = gamma
+        out["spec_acceptance_rate"] = spec_on.get("spec_acceptance_rate")
+        out["spec_tokens_per_verify_mean"] = spec_on.get(
+            "spec_tokens_per_verify_mean"
+        )
+        out["tpot_s_p50_spec_off"] = on.get("tpot_s_p50")
+        out["tpot_s_p50_spec_on"] = spec_on.get("tpot_s_p50")
+        out["tpot_s_p95_spec_off"] = on.get("tpot_s_p95")
+        out["tpot_s_p95_spec_on"] = spec_on.get("tpot_s_p95")
+        out["tpot_p50_speedup_spec"] = (
+            round(on["tpot_s_p50"] / spec_on["tpot_s_p50"], 4)
+            if spec_on.get("tpot_s_p50") else None
+        )
     path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_SERVING.json"
     )
@@ -740,6 +779,17 @@ def main():
         "shares (0 = fully distinct prompts)",
     )
     parser.add_argument(
+        "--speculative", action="store_true",
+        help="add a speculative-decoding pass to --serving (identical "
+        "workload, spec off-vs-on rows: acceptance rate + TPOT p50/p95 "
+        "delta)",
+    )
+    parser.add_argument(
+        "--gamma", type=int, default=4, metavar="G",
+        help="speculative chunk width for --speculative (draft proposals "
+        "per verify round)",
+    )
+    parser.add_argument(
         "--fake_devices", type=int, default=0, metavar="N",
         help="run on N virtual CPU devices instead of the real backend "
         "(the --scaling rig until a multi-chip slice exists)",
@@ -835,28 +885,31 @@ def run_benches(args, dev, peak):
         # Poisson load, prefix caching off then on over the identical
         # workload. One JSON line (the caching-on row is the headline);
         # full before/after percentiles in the file.
-        result = bench_serving(shared_prefix_len=args.shared_prefix_len)
-        s = result["rows"][1]["stats"]
-        print(
-            json.dumps(
-                {
-                    "metric": "serving_throughput_tok_per_sec",
-                    "value": round(s["tokens_per_sec"], 2),
-                    "unit": "tok/s",
-                    "vs_baseline": 1.0,
-                    "requests_completed": s["requests_completed"],
-                    "ttft_s_p50": s["ttft_s_p50"],
-                    "ttft_s_p95": s["ttft_s_p95"],
-                    "tpot_s_p50": s["tpot_s_p50"],
-                    "e2e_s_p95": s["e2e_s_p95"],
-                    "preemptions": s["preemptions"],
-                    "prefix_hit_rate": result["prefix_hit_rate"],
-                    "ttft_p50_speedup_cached": result[
-                        "ttft_p50_speedup_cached"
-                    ],
-                }
-            )
+        result = bench_serving(
+            shared_prefix_len=args.shared_prefix_len,
+            speculative=args.speculative, gamma=args.gamma,
         )
+        s = result["rows"][1]["stats"]
+        line = {
+            "metric": "serving_throughput_tok_per_sec",
+            "value": round(s["tokens_per_sec"], 2),
+            "unit": "tok/s",
+            "vs_baseline": 1.0,
+            "requests_completed": s["requests_completed"],
+            "ttft_s_p50": s["ttft_s_p50"],
+            "ttft_s_p95": s["ttft_s_p95"],
+            "tpot_s_p50": s["tpot_s_p50"],
+            "e2e_s_p95": s["e2e_s_p95"],
+            "preemptions": s["preemptions"],
+            "prefix_hit_rate": result["prefix_hit_rate"],
+            "ttft_p50_speedup_cached": result[
+                "ttft_p50_speedup_cached"
+            ],
+        }
+        if args.speculative:
+            line["spec_acceptance_rate"] = result["spec_acceptance_rate"]
+            line["tpot_p50_speedup_spec"] = result["tpot_p50_speedup_spec"]
+        print(json.dumps(line))
         return
 
     if args.window_sweep:

@@ -130,6 +130,24 @@ def decode_token_step(decode_model, params, cache, current, **apply_kwargs):
     return logits[:, -1, :], updated["cache"]
 
 
+def decode_chunk_step(decode_model, params, cache, current, **apply_kwargs):
+    """Like :func:`decode_token_step` but keeps EVERY position's logits:
+    ``(logits [B, T_step, V], cache)``. This is the speculative VERIFY
+    forward — the target scores all ``gamma`` proposal positions in one
+    chunked decode (per-position RoPE + intra-chunk causal mask come from
+    the decode path itself), so acceptance is decided for the whole chunk
+    from a single MXU-shaped program instead of ``gamma`` bandwidth-shaped
+    single-token steps."""
+    dtype = getattr(decode_model, "dtype", jnp.bfloat16)
+    logits, updated = decode_model.apply(
+        {"params": dequantize_pytree(params, dtype), "cache": cache},
+        current,
+        mutable=["cache"],
+        **apply_kwargs,
+    )
+    return logits, updated["cache"]
+
+
 def batch_sharding_placer(mesh: Mesh, data_axis: str, batch: int):
     """``(place, batch_sh, replicated)`` — THE decode placement rule,
     shared by :func:`generate`, :func:`beam_search`, and

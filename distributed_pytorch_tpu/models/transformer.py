@@ -279,15 +279,34 @@ class Attention(nn.Module):
     def _decode_step(self, q_raw, k_raw, v):
         """One autoregressive step: rotate q/k by their absolute positions,
         write k/v into the cache at the running index, attend q against the
-        valid cache prefix. ``q_raw``: [B, T_step, H, D] (T_step usually 1)."""
+        valid cache prefix. ``q_raw``: [B, T_step, H, D] (T_step usually 1).
+
+        ``cache_index`` may be a scalar (every row at the same offset — the
+        ``generate`` loop) or a ``[B]`` vector giving every row its OWN
+        offset — the speculative per-row-acceptance path, where rows advance
+        by their individual accepted counts. The vector path mirrors the
+        paged decode step: per-row RoPE positions, a scatter write at
+        (row, position), and a per-row visibility mask; out-of-range
+        positions (a fast row's replay region past the buffer) are dropped
+        by the scatter, and reads past a row's index are masked, so rolling
+        a row back IS lowering its index — no zeroing or copies."""
         cached_key = self.variable("cache", "cached_key", lambda: None)
         cached_value = self.variable("cache", "cached_value", lambda: None)
         cache_index = self.variable("cache", "cache_index", lambda: None)
         index = cache_index.value
         t_step = q_raw.shape[1]
         max_len = cached_key.value.shape[1]
+        per_row = index.ndim == 1  # [B] per-row offsets vs one scalar
+        if per_row and self.quantized_cache:
+            raise ValueError(
+                "per-row cache_index does not compose with quantized_cache "
+                "(the int8 write path slices at one shared offset)"
+            )
 
-        positions = index + jnp.arange(t_step)
+        if per_row:
+            positions = index[:, None] + jnp.arange(t_step)  # [B, T_step]
+        else:
+            positions = index + jnp.arange(t_step)  # [T_step]
         q = apply_rope(
             q_raw, positions=positions, theta=self.rope_theta,
             scale=self.rope_scale,
@@ -301,6 +320,19 @@ class Attention(nn.Module):
             keys, values = self._update_quantized_cache(
                 cached_key, cached_value, k, v, index
             )
+        elif per_row:
+            b, _, kv_h, d_h = k_raw.shape
+            rows = jnp.repeat(jnp.arange(b, dtype=jnp.int32), t_step)
+            flat_pos = positions.reshape(-1)
+            cached_key.value = cached_key.value.at[rows, flat_pos].set(
+                k.astype(cached_key.value.dtype).reshape(-1, kv_h, d_h),
+                mode="drop",
+            )
+            cached_value.value = cached_value.value.at[rows, flat_pos].set(
+                v.astype(cached_value.value.dtype).reshape(-1, kv_h, d_h),
+                mode="drop",
+            )
+            keys, values = cached_key.value, cached_value.value
         else:
             cached_key.value = jax.lax.dynamic_update_slice(
                 cached_key.value, k.astype(cached_key.value.dtype), (0, index, 0, 0)
@@ -312,9 +344,14 @@ class Attention(nn.Module):
         cache_index.value = index + t_step
         scale = q.shape[-1] ** -0.5
         # Position k is visible to step-q q when k <= index + q (and, with
-        # a sliding window, within the last `window` positions).
-        q_abs = (index + jnp.arange(t_step))[:, None]
-        k_abs = jnp.arange(max_len)[None, :]
+        # a sliding window, within the last `window` positions). Per-row
+        # indices make the mask [B, T_step, K] instead of [T_step, K].
+        if per_row:
+            q_abs = positions[:, :, None]  # [B, T_step, 1]
+            k_abs = jnp.arange(max_len)[None, None, :]
+        else:
+            q_abs = (index + jnp.arange(t_step))[:, None]
+            k_abs = jnp.arange(max_len)[None, :]
         visible = k_abs <= q_abs
         if self.window:
             visible = visible & (q_abs - k_abs < self.window)
@@ -331,7 +368,11 @@ class Attention(nn.Module):
         group = h // kv_heads
         qg = q.reshape(b, t_q, kv_heads, group, d)
         logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, keys) * scale
-        logits = jnp.where(visible[None, None, None], logits, NEG_INF)
+        mask = (
+            visible[:, None, None] if visible.ndim == 3  # [B,1,1,T,K]
+            else visible[None, None, None]
+        )
+        logits = jnp.where(mask, logits, NEG_INF)
         weights = jax.nn.softmax(
             logits.astype(jnp.float32), axis=-1
         ).astype(q.dtype)
@@ -375,15 +416,19 @@ class Attention(nn.Module):
             scale=self.rope_scale,
         )
 
-        # Scatter this step's K/V into (physical page, in-page offset). The
-        # logical page index is clipped to the table width: the engine
-        # guarantees real writes stay in range, so a clipped index can only
-        # belong to an inactive row, whose table maps everything to the null
-        # page anyway.
+        # Scatter this step's K/V into (physical page, in-page offset). A
+        # position at or past the row's table capacity — a speculative
+        # chunk's tail can overhang the final tokens of a sequence near
+        # max_seq_len — is routed to the reserved null page (id 0) instead
+        # of letting the clipped logical index alias into the row's LAST
+        # page, where it would clobber valid K/V at the same in-page
+        # offset. The null page absorbs the garbage exactly like inactive
+        # rows' writes; the visibility mask keeps it dead on every read.
         flat_pos = positions.reshape(-1)  # [S*T_step]
         logical = jnp.clip(flat_pos // page, 0, pages_per_seq - 1)
         rows = jnp.repeat(jnp.arange(s, dtype=jnp.int32), t_step)
         phys = block_tables[rows, logical]  # [S*T_step]
+        phys = jnp.where(flat_pos < pages_per_seq * page, phys, 0)
         offset = flat_pos % page
         cached_key.value = cached_key.value.at[phys, offset].set(
             k.astype(cached_key.value.dtype).reshape(-1, kv_heads, d)

@@ -29,6 +29,7 @@ from distributed_pytorch_tpu.serving.engine import InferenceEngine
 from distributed_pytorch_tpu.serving.kv_cache import (
     BlockTable,
     OutOfPages,
+    PagePoolGroup,
     PagedBlockAllocator,
     PrefixCache,
 )
@@ -48,6 +49,7 @@ __all__ = [
     "InferenceEngine",
     "OutOfPages",
     "PENDING_TOKEN",
+    "PagePoolGroup",
     "PagedBlockAllocator",
     "PrefixCache",
     "QueueFull",

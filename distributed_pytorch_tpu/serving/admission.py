@@ -119,9 +119,19 @@ class AdmissionController:
 
 
 class ServingMetrics:
-    """TTFT / TPOT / e2e reservoirs + exact throughput counters."""
+    """TTFT / TPOT / e2e reservoirs + exact throughput counters.
 
-    def __init__(self, reservoir_capacity: int = 1024):
+    ``speculative=True`` labels this engine's TPOT samples "spec" in the
+    mode split (so a spec-on and a spec-off run over the same workload can
+    be compared reservoir-to-reservoir) and is the mode whose verify
+    rounds feed :meth:`observe_verify` — per-round acceptance fraction and
+    emitted-token reservoirs plus exact proposed/accepted counters, the
+    numbers that say whether the draft is earning its keep."""
+
+    def __init__(
+        self, reservoir_capacity: int = 1024, speculative: bool = False
+    ):
+        self.speculative = speculative
         self.ttft = ReservoirHistogram(reservoir_capacity, seed=1)
         self.tpot = ReservoirHistogram(reservoir_capacity, seed=2)
         self.e2e = ReservoirHistogram(reservoir_capacity, seed=3)
@@ -130,6 +140,19 @@ class ServingMetrics:
         self.ttft_by_source = ReservoirGroup(
             ("hit", "miss"), reservoir_capacity, seed=4
         )
+        # Speculative-verify quality: per-round acceptance fraction (of
+        # gamma proposals) and tokens emitted per verify (1..gamma).
+        self.spec = ReservoirGroup(
+            ("acceptance_rate", "tokens_per_verify"),
+            reservoir_capacity,
+            seed=10,
+        )
+        self.tpot_by_mode = ReservoirGroup(
+            ("spec", "plain"), reservoir_capacity, seed=20
+        )
+        self.verify_rounds = 0
+        self.draft_proposed = 0
+        self.draft_accepted = 0
         self.tokens_generated = 0
         self.requests_completed = 0
         self.engine_steps = 0
@@ -138,6 +161,18 @@ class ServingMetrics:
     def observe_step(self, new_tokens: int) -> None:
         self.engine_steps += 1
         self.tokens_generated += new_tokens
+
+    def observe_verify(
+        self, accepted: int, emitted: int, gamma: int
+    ) -> None:
+        """One speculative verify round: ``accepted`` of ``gamma`` draft
+        proposals survived, ``emitted`` tokens entered the sequence
+        (accepted + the correction, capped at gamma)."""
+        self.verify_rounds += 1
+        self.draft_proposed += gamma
+        self.draft_accepted += accepted
+        self.spec.record("acceptance_rate", accepted / gamma)
+        self.spec.record("tokens_per_verify", float(emitted))
 
     def observe_finished(self, req: Request) -> None:
         self.requests_completed += 1
@@ -151,9 +186,12 @@ class ServingMetrics:
             if req.finish_time is not None:
                 self.e2e.record(req.finish_time - req.submit_time)
                 if req.n_generated > 1:
-                    self.tpot.record(
-                        (req.finish_time - req.first_token_time)
-                        / (req.n_generated - 1)
+                    tpot = (
+                        req.finish_time - req.first_token_time
+                    ) / (req.n_generated - 1)
+                    self.tpot.record(tpot)
+                    self.tpot_by_mode.record(
+                        "spec" if self.speculative else "plain", tpot
                     )
 
     def snapshot(self) -> Dict[str, float]:
@@ -173,5 +211,16 @@ class ServingMetrics:
         out.update(self.ttft.summary("ttft_s_"))
         out.update(self.ttft_by_source.summary("ttft_s_"))
         out.update(self.tpot.summary("tpot_s_"))
+        out.update(self.tpot_by_mode.summary("tpot_s_"))
         out.update(self.e2e.summary("e2e_s_"))
+        if self.speculative or self.verify_rounds:
+            out["verify_rounds"] = self.verify_rounds
+            out["draft_tokens_proposed"] = self.draft_proposed
+            out["draft_tokens_accepted"] = self.draft_accepted
+            out["spec_acceptance_rate"] = (
+                self.draft_accepted / self.draft_proposed
+                if self.draft_proposed
+                else 0.0
+            )
+            out.update(self.spec.summary("spec_"))
         return out

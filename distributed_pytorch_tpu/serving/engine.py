@@ -43,6 +43,28 @@ assignment, and preemption, so a preempted-then-resumed request reproduces
 its exact stream. Under overlap the fold index is the DISPATCH count
 (``n_issued``), which equals the generated count at the same point of the
 synchronous schedule.
+
+Speculative serving (``draft_model``): each scheduled decode becomes one
+draft+verify ROUND — gamma single-token draft steps propose a chunk, one
+gamma-wide chunked target forward verifies it, and every row emits its
+accepted prefix plus a correction (1..gamma tokens, per-row, no
+minimum-across-batch stall). The draft model keeps its own paged pool with
+the SAME (num_pages, page_size) geometry, governed by the same allocator
+and block tables, so one physical page id names the same token span in
+both pools and every allocation / refcount / CoW / eviction decision is
+made once; prefill chunks and CoW copies simply run against both pools.
+Rejected-token rollback is O(1) in both pools: ``len_cached`` stops at the
+emitted count and K/V written past it is dead by construction (attention
+masks positions >= seq_len, and the real continuation overwrites them
+write-then-attend next round). Rounds resolve synchronously — the host
+needs each row's accepted count to plan the next round — so ``overlap``
+composes differently here: the round is dispatched BEFORE the step's
+prefill chunks and its readback lands while they compute. Greedy rows emit
+exactly the target's argmax at every position (the chunked verify logits
+match the single-token path bitwise at f32), so a speculative engine is
+token-identical to the plain engine; sampled rows follow Leviathan et
+al.'s residual-resampling rule, keeping every emitted token exactly
+target-distributed.
 """
 
 from __future__ import annotations
@@ -57,6 +79,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_pytorch_tpu.generation import (
+    decode_chunk_step,
     decode_token_step,
     truncate_logits,
 )
@@ -66,6 +89,7 @@ from distributed_pytorch_tpu.serving.admission import (
 )
 from distributed_pytorch_tpu.serving.kv_cache import (
     PagedBlockAllocator,
+    PagePoolGroup,
     PrefixCache,
 )
 from distributed_pytorch_tpu.serving.scheduler import (
@@ -107,6 +131,14 @@ class InferenceEngine:
 
     ``top_k``/``top_p`` are engine-static (compiled into the decode step);
     temperature and seed are per-request (:class:`SamplingParams`).
+
+    ``draft_model``/``draft_params`` switch every decode to speculative
+    draft+verify rounds of ``gamma`` proposals (see module doc); the draft
+    must share the target's vocabulary and gets its own paged pool with
+    identical page geometry, moved in lockstep by the shared allocator.
+    Greedy requests stay token-identical to the plain engine; sampled
+    requests stay exactly target-distributed (but draw a different stream
+    than the plain engine — one uniform per proposal, not per token).
     """
 
     def __init__(
@@ -126,6 +158,9 @@ class InferenceEngine:
         top_p: float = 0.0,
         prefix_cache: bool = True,
         overlap: bool = True,
+        draft_model=None,
+        draft_params=None,
+        gamma: int = 4,
         debug: bool = False,
     ):
         if max_seq_len % page_size:
@@ -143,6 +178,22 @@ class InferenceEngine:
         self.overlap = overlap
         self._top_k = int(top_k)
         self._top_p = float(top_p)
+        self.speculative = draft_model is not None
+        if self.speculative:
+            if draft_params is None:
+                raise ValueError("draft_model requires draft_params")
+            if gamma < 1:
+                raise ValueError(f"gamma must be >= 1, got {gamma}")
+            if getattr(draft_model, "vocab_size", None) != getattr(
+                model, "vocab_size", None
+            ):
+                raise ValueError(
+                    f"draft vocab {getattr(draft_model, 'vocab_size', None)}"
+                    f" != target vocab {getattr(model, 'vocab_size', None)}"
+                    " — draft proposals index the target's distribution"
+                )
+        self.gamma = int(gamma) if self.speculative else 0
+        self.draft_params = draft_params
 
         self.decode_model = model.clone(
             decode=True, page_size=page_size, num_pages=num_pages
@@ -150,14 +201,27 @@ class InferenceEngine:
         # Size the paged pool from abstract shapes only (eval_shape traces
         # init without running it); token length 1 — pool shapes depend only
         # on (num_pages, page_size), never on the init input.
-        abstract = jax.eval_shape(
-            self.decode_model.init,
-            jax.random.PRNGKey(0),
-            jnp.zeros((max_slots, 1), jnp.int32),
-        )["cache"]
-        self.cache = jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype), abstract
-        )
+        def _zero_cache(decode_model):
+            abstract = jax.eval_shape(
+                decode_model.init,
+                jax.random.PRNGKey(0),
+                jnp.zeros((max_slots, 1), jnp.int32),
+            )["cache"]
+            return jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), abstract
+            )
+
+        # The draft pool shares (num_pages, page_size) with the target pool
+        # — same page ids, same block tables, one allocator — so every page
+        # lifecycle decision moves both pools in lockstep. Head/width can
+        # differ freely; only the page GEOMETRY must match.
+        pools = {"target": _zero_cache(self.decode_model)}
+        if self.speculative:
+            self.draft_decode_model = draft_model.clone(
+                decode=True, page_size=page_size, num_pages=num_pages
+            )
+            pools["draft"] = _zero_cache(self.draft_decode_model)
+        self.pools = PagePoolGroup(**pools)
 
         self.allocator = PagedBlockAllocator(num_pages)
         self.prefix_cache = (
@@ -171,6 +235,7 @@ class InferenceEngine:
             token_budget=token_budget,
             max_prefill_chunk=max_prefill_chunk,
             prefix_cache=self.prefix_cache,
+            gamma=self.gamma,
             debug=debug,
         )
         self.admission = AdmissionController(
@@ -178,7 +243,7 @@ class InferenceEngine:
             max_request_tokens=max_seq_len,
             max_queue_tokens=max_queue_tokens,
         )
-        self.metrics = ServingMetrics()
+        self.metrics = ServingMetrics(speculative=self.speculative)
         self.requests: Dict[int, Request] = {}
         self._next_id = 0
         self._keys: Dict[int, jax.Array] = {}
@@ -203,6 +268,26 @@ class InferenceEngine:
         self._inflight: Optional[
             Tuple[jax.Array, List[int], List[Request]]
         ] = None
+
+    # Pool accessors: the target pool keeps its historical ``self.cache``
+    # name (the plain-engine hot path reads/writes it directly); the draft
+    # pool exists only on speculative engines.
+
+    @property
+    def cache(self):
+        return self.pools["target"]
+
+    @cache.setter
+    def cache(self, value):
+        self.pools["target"] = value
+
+    @property
+    def draft_cache(self):
+        return self.pools["draft"]
+
+    @draft_cache.setter
+    def draft_cache(self, value):
+        self.pools["draft"] = value
 
     # ------------------------------------------------------------- compiled
 
@@ -259,6 +344,152 @@ class InferenceEngine:
             )
 
         return jax.jit(run, donate_argnums=(0,))
+
+    @functools.lru_cache(maxsize=16)
+    def _draft_prefill_step(self, chunk: int):
+        """Draft-pool twin of :meth:`_prefill_step`: every prefill chunk
+        runs through BOTH models so the draft pool holds valid K/V for
+        exactly the positions the target pool does — including
+        trie-adopted pages, which were prefilled by both models when first
+        written and so stay adoptable in lockstep."""
+
+        def run(draft_params, draft_cache, tokens, table, length):
+            _, draft_cache = decode_token_step(
+                self.draft_decode_model, draft_params, draft_cache, tokens,
+                block_tables=table, seq_lens=length,
+            )
+            return draft_cache
+
+        return jax.jit(run, donate_argnums=(1,))
+
+    @functools.cached_property
+    def _spec_step(self):
+        """THE speculative round program — one compile for the engine's
+        lifetime, batched over all slots like :meth:`_decode_step`:
+
+        1. gamma single-token DRAFT steps (``fori_loop``) sample/argmax a
+           proposal chunk per row, writing draft K/V at positions
+           ``lens..lens+gamma-1`` and recording each step's filtered draft
+           distribution q for the acceptance ratio;
+        2. ONE gamma-wide chunked TARGET forward over
+           ``[x_t, d_0..d_{gamma-2}]`` at the same positions scores every
+           proposal (logits[:, j] decides position ``lens+j+1``);
+        3. per-row acceptance: greedy rows keep proposals matching the
+           target argmax; sampled rows accept d_i iff
+           ``u_i * q(d_i) < p(d_i)`` and resample the first rejection from
+           the residual ``max(p - q, 0)`` (exact target law, same rule as
+           offline ``speculative_generate``).
+
+        Returns ``(emitted [S, gamma], n_accepted [S])`` plus both updated
+        pools; row s's round contributes ``min(n_accepted[s]+1, gamma)``
+        tokens, ``emitted[s, :that]``. K/V past a row's emitted count is
+        rejected garbage in BOTH pools and needs no cleanup: reads mask
+        positions >= seq_len and the next round overwrites before
+        attending. Per-round sub-draws derive from the staged per-request
+        key: draft step i folds i, acceptance uniforms fold gamma, the
+        residual draw folds gamma+1 — batch-composition independent, like
+        everything else about sampling here."""
+        top_k, top_p = self._top_k, self._top_p
+        gamma = self.gamma
+        n_slots = self.max_slots
+        vocab = self.decode_model.vocab_size
+
+        def filtered(logits, temps):
+            # The distribution actually sampled from, f32 for the
+            # acceptance-ratio arithmetic (mirrors offline speculative.py).
+            safe_t = jnp.where(temps > 0, temps, 1.0)
+            shaped = safe_t.reshape((-1,) + (1,) * (logits.ndim - 1))
+            return jax.nn.softmax(
+                truncate_logits(logits / shaped, top_k, top_p).astype(
+                    jnp.float32
+                ),
+                axis=-1,
+            )
+
+        def run(params, draft_params, cache, draft_cache, tokens, tables,
+                lens, temps, keys):
+            rows = jnp.arange(n_slots)
+
+            def fold_all(i):
+                return jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+                    keys, i
+                )
+
+            # --- draft phase: propose gamma tokens per row -------------
+            buf = jnp.zeros((n_slots, gamma + 1), jnp.int32)
+            buf = buf.at[:, 0].set(tokens)
+            qbuf = jnp.zeros((n_slots, gamma, vocab), jnp.float32)
+
+            def draft_body(i, carry):
+                buf, qbuf, dcache = carry
+                cur = jax.lax.dynamic_slice_in_dim(buf, i, 1, axis=1)
+                logits, dcache = decode_token_step(
+                    self.draft_decode_model, draft_params, dcache, cur,
+                    block_tables=tables, seq_lens=lens + i,
+                )
+                q = filtered(logits, temps)  # [S, V]
+                sampled = jax.vmap(jax.random.categorical)(
+                    fold_all(i), jnp.log(q)
+                ).astype(jnp.int32)
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                nxt = jnp.where(temps > 0, sampled, greedy)
+                buf = buf.at[:, i + 1].set(nxt)
+                qbuf = jax.lax.dynamic_update_slice_in_dim(
+                    qbuf, q[:, None, :], i, axis=1
+                )
+                return buf, qbuf, dcache
+
+            buf, qbuf, draft_cache = jax.lax.fori_loop(
+                0, gamma, draft_body, (buf, qbuf, draft_cache)
+            )
+
+            # --- verify phase: one chunked target forward --------------
+            chunk = buf[:, :gamma]       # [x_t, d_0 .. d_{gamma-2}]
+            proposals = buf[:, 1:]       # [d_0 .. d_{gamma-1}]
+            t_logits, cache = decode_chunk_step(
+                self.decode_model, params, cache, chunk,
+                block_tables=tables, seq_lens=lens,
+            )
+            greedy_t = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+            p = filtered(t_logits, temps)  # [S, gamma, V]
+            px = jnp.take_along_axis(
+                p, proposals[..., None], axis=-1
+            )[..., 0]
+            qx = jnp.take_along_axis(
+                qbuf, proposals[..., None], axis=-1
+            )[..., 0]
+            u = jax.vmap(lambda k: jax.random.uniform(k, (gamma,)))(
+                fold_all(gamma)
+            )
+            # u < min(1, px/qx)  <=>  u*qx < px (q(x) > 0 a.s.).
+            accept = jnp.where(
+                temps[:, None] > 0, u * qx < px, proposals == greedy_t
+            )
+            n_acc = jnp.sum(
+                jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
+            )
+            # Correction at column ni = min(n_acc, gamma-1). Fully
+            # accepted rows route back to their own last proposal via the
+            # n_acc > ni select (no bonus token — matches offline).
+            ni = jnp.minimum(n_acc, gamma - 1)
+            p_n = jnp.take_along_axis(p, ni[:, None, None], axis=1)[:, 0]
+            q_n = jnp.take_along_axis(qbuf, ni[:, None, None], axis=1)[:, 0]
+            residual = jnp.maximum(p_n - q_n, 0.0)
+            has_mass = jnp.sum(residual, axis=-1, keepdims=True) > 0
+            res_dist = jnp.where(has_mass, residual, p_n)
+            resampled = jax.vmap(jax.random.categorical)(
+                fold_all(gamma + 1), jnp.log(res_dist)
+            ).astype(jnp.int32)
+            greedy_repl = jnp.take_along_axis(
+                greedy_t, ni[:, None], axis=1
+            )[:, 0]
+            replacement = jnp.where(temps > 0, resampled, greedy_repl)
+            kept = jnp.take_along_axis(proposals, ni[:, None], axis=1)[:, 0]
+            corrected = jnp.where(n_acc > ni, kept, replacement)
+            emitted = proposals.at[rows, ni].set(corrected)
+            return emitted, n_acc, cache, draft_cache
+
+        return jax.jit(run, donate_argnums=(2, 3))
 
     # ----------------------------------------------------------------- API
 
@@ -326,8 +557,11 @@ class InferenceEngine:
         plan = self.scheduler.schedule()
 
         for _slot, src, dst in plan.copies:
-            self.cache = self._copy_page(
-                self.cache,
+            # Copy-on-write fans out to every pool: the draft pool shares
+            # page ids with the target pool, so a page that splits, splits
+            # everywhere.
+            self.pools.copy_page(
+                self._copy_page,
                 jnp.asarray(src, jnp.int32),
                 jnp.asarray(dst, jnp.int32),
             )
@@ -339,6 +573,9 @@ class InferenceEngine:
                 self._resolve_inflight() if self._inflight is not None
                 else []
             )
+
+        if self.speculative:
+            return self._step_spec(plan)
 
         for slot, chunk in plan.prefill:
             req = self.scheduler.slots[slot]
@@ -410,6 +647,92 @@ class InferenceEngine:
         if not self.overlap and self._inflight is not None:
             finished.extend(self._resolve_inflight())
         self.metrics.observe_step(new_tokens=len(plan.decode_slots))
+        return finished
+
+    def _step_spec(self, plan) -> List[int]:
+        """Execute one speculative plan. The draft+verify round is
+        dispatched FIRST (device-async), the step's prefill chunks run
+        through both models while it computes, and only then does the host
+        block on the round's readback — speculative rounds must resolve
+        within their own step (the next schedule needs each row's accepted
+        count), so overlap here means hiding the sync under prefill rather
+        than deferring it a step like the plain path."""
+        dispatched = None
+        if plan.decode_slots:
+            self._stage_tables.fill(0)
+            self._stage_lens.fill(0)
+            for slot in plan.decode_slots:
+                req = self.scheduler.slots[slot]
+                pos = req.len_cached
+                # Synchronous resolution means no PENDING placeholders:
+                # the row's input is always a real token.
+                self._stage_tokens[slot] = req.tokens[pos]
+                self._stage_tables[slot] = req.table.as_row(
+                    self.pages_per_seq
+                )
+                self._stage_lens[slot] = pos
+                self._stage_temps[slot] = req.params.temperature
+                self._stage_keys[slot] = np.asarray(
+                    jax.random.fold_in(
+                        self._keys[req.req_id], req.n_issued
+                    ),
+                    np.uint32,
+                )
+            emitted, n_acc, self.cache, self.draft_cache = self._spec_step(
+                self.params, self.draft_params,
+                self.cache, self.draft_cache,
+                jnp.asarray(self._stage_tokens),
+                jnp.asarray(self._stage_tables),
+                jnp.asarray(self._stage_lens),
+                jnp.asarray(self._stage_temps),
+                jnp.asarray(self._stage_keys),
+            )
+            dispatched = (
+                emitted,
+                n_acc,
+                [(s, self.scheduler.slots[s]) for s in plan.decode_slots],
+            )
+
+        for slot, chunk in plan.prefill:
+            req = self.scheduler.slots[slot]
+            start = req.len_cached
+            tok = np.asarray(
+                [req.tokens[start : start + chunk]], np.int32
+            )
+            table = req.table.as_row(self.pages_per_seq)[None]
+            self.cache = self._prefill_step(chunk)(
+                self.params, self.cache, jnp.asarray(tok),
+                jnp.asarray(table), jnp.asarray([start], jnp.int32),
+            )
+            self.draft_cache = self._draft_prefill_step(chunk)(
+                self.draft_params, self.draft_cache, jnp.asarray(tok),
+                jnp.asarray(table), jnp.asarray([start], jnp.int32),
+            )
+            self.scheduler.note_prefilled(slot, chunk)
+
+        finished: List[int] = []
+        new_tokens = 0
+        if dispatched is not None:
+            emitted, n_acc, slot_reqs = dispatched
+            emitted_host = np.asarray(emitted)  # the ONE blocking sync
+            n_acc_host = np.asarray(n_acc)
+            now = time.perf_counter()
+            for slot, req in slot_reqs:
+                accepted = int(n_acc_host[slot])
+                n_emit = min(accepted + 1, self.gamma)
+                toks = [int(t) for t in emitted_host[slot, :n_emit]]
+                before = req.n_generated
+                done = self.scheduler.resolve_spec(req, toks, now=now)
+                self.metrics.observe_verify(
+                    accepted=accepted, emitted=n_emit, gamma=self.gamma
+                )
+                new_tokens += req.n_generated - before
+                if done is not None:
+                    self.scheduler.retire(done, now=now)
+                    self.metrics.observe_finished(done)
+                    self._keys.pop(done.req_id, None)
+                    finished.append(done.req_id)
+        self.metrics.observe_step(new_tokens=new_tokens)
         return finished
 
     def poll(self, req_id: int) -> RequestStatus:

@@ -27,6 +27,15 @@ prefilled once. Invariants that keep sharing copy-free and leak-proof:
   clobber each other's tokens. Pages with refcount 1 may be extended in
   place even while registered — appending beyond a registered prefix never
   changes the prefix content a future matcher reads.
+* **Draft pages move in lockstep with target pages** (speculative
+  decoding): the draft model's pool is built with the SAME
+  ``(num_pages, page_size)`` geometry, so one physical page id names the
+  same logical token span in BOTH pools (:class:`PagePoolGroup`). One
+  allocator and one block table per sequence then govern both pools at
+  once — allocate/ref/unref/retire/evict are decided once on the shared
+  id — and rejected-token rollback is O(1) in both pools for the same
+  reason retire is copy-free: reads past ``seq_len`` are masked, so stale
+  speculative K/V is dead by construction.
 """
 
 from __future__ import annotations
@@ -260,6 +269,61 @@ class BlockTable:
         row = np.full((width,), NULL_PAGE, np.int32)
         row[: len(self.pages)] = self.pages
         return row
+
+
+class PagePoolGroup:
+    """Named device page pools sharing ONE physical page-id space — the
+    ``"target"`` model's pool always, plus a ``"draft"`` pool when the
+    engine runs speculative decoding.
+
+    Every pool is built with the SAME ``(num_pages, page_size)`` geometry
+    (per-layer shapes ``[num_pages, page_size, Hkv, D]`` differ freely — a
+    draft model is narrower), so a physical page id names the same logical
+    token span in every pool. That is the whole lockstep mechanism: ONE
+    :class:`PagedBlockAllocator` and ONE :class:`BlockTable` per sequence
+    govern all pools at once — allocation, refcounting, prefix-cache
+    adoption, copy-on-write, and release are decided once on the shared id
+    and apply to target and draft K/V alike. The engine prefills and
+    decode-writes both pools for every position, so a page's draft K/V is
+    always exactly as valid as its target K/V, including pages resurrected
+    from the prefix trie by a later request.
+
+    Rejected-token rollback needs NO device work in any pool: the attention
+    visibility mask hides everything past a row's ``seq_len``, so lowering
+    the host-side ``len_cached`` IS the rollback — stale speculative K/V
+    (target's verify writes and the draft's proposal writes alike) is dead
+    by construction and simply overwritten when the real continuation is
+    fed (write-then-attend)."""
+
+    def __init__(self, **pools):
+        if "target" not in pools:
+            raise ValueError("PagePoolGroup needs at least a 'target' pool")
+        self.pools = dict(pools)
+
+    def __getitem__(self, name: str):
+        return self.pools[name]
+
+    def __setitem__(self, name: str, value) -> None:
+        if name not in self.pools:
+            raise KeyError(
+                f"unknown pool {name!r}; declared: {tuple(self.pools)}"
+            )
+        self.pools[name] = value
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.pools
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(self.pools)
+
+    def copy_page(self, copy_fn, src, dst) -> None:
+        """Fan the engine's compiled page-copy out over EVERY pool — the
+        device half of copy-on-write must clone a shared page's draft K/V
+        in the same step as its target K/V, or a later speculative write
+        through the fresh id would diverge the two pools."""
+        for name in self.pools:
+            self.pools[name] = copy_fn(self.pools[name], src, dst)
 
 
 class PrefixCache:

@@ -44,6 +44,20 @@ Design decisions, in the order they bite:
   max_new_tokens) is host-known at dispatch; only stop-token detection
   waits for the value, costing at most one speculative decode step that
   :meth:`resolve_decoded` rolls back.
+* **Speculative decode rows advance by a VARIABLE amount** (``gamma > 0``):
+  one scheduled "decode" is a whole draft+verify round that writes
+  ``gamma`` K/V positions and emits 1..gamma tokens, so the budget charges
+  ``gamma`` per running row and :meth:`_ensure_pages` covers the full
+  chunk (``len_cached + gamma``). Acceptance resolves PER ROW via
+  :meth:`resolve_spec` — a row that accepted its whole chunk advances by
+  gamma while its neighbor advances by 1; no minimum-across-batch stall.
+  Rollback of the rejected tail is free: ``len_cached`` simply advances by
+  the emitted count, and K/V written past it is masked (and overwritten
+  write-then-attend when the real continuation is fed). The prefix trie
+  only ever registers pages fully below ``len_cached``, so rejected
+  garbage can never be cached, and copy-on-write is decided on the one
+  page containing ``len_cached`` exactly as in the single-token path —
+  every later page a round touches was freshly allocated for this row.
 """
 
 from __future__ import annotations
@@ -169,9 +183,12 @@ def _pow2_floor(n: int) -> int:
 class Scheduler:
     """Waiting queue + slot set + page-pressure policy (see module doc).
 
-    ``prefix_cache`` enables automatic prefix caching; ``debug=True`` runs
-    the O(num_pages) allocator invariant sweep after every
-    :meth:`schedule` call — kept on in tests, off on the serving hot path.
+    ``prefix_cache`` enables automatic prefix caching; ``gamma > 0``
+    switches decode planning to speculative rounds (each scheduled decode
+    writes ``gamma`` K/V positions and resolves 1..gamma tokens via
+    :meth:`resolve_spec`); ``debug=True`` runs the O(num_pages) allocator
+    invariant sweep after every :meth:`schedule` call — kept on in tests,
+    off on the serving hot path.
     """
 
     def __init__(
@@ -184,10 +201,13 @@ class Scheduler:
         token_budget: int = 64,
         max_prefill_chunk: int = 32,
         prefix_cache: Optional[PrefixCache] = None,
+        gamma: int = 0,
         debug: bool = False,
     ):
         if token_budget < 1:
             raise ValueError(f"token_budget must be >= 1, got {token_budget}")
+        if gamma < 0:
+            raise ValueError(f"gamma must be >= 0, got {gamma}")
         if _pow2_floor(max_prefill_chunk) != max_prefill_chunk:
             raise ValueError(
                 f"max_prefill_chunk must be a power of two, got "
@@ -200,6 +220,7 @@ class Scheduler:
         self.token_budget = token_budget
         self.max_prefill_chunk = max_prefill_chunk
         self.prefix_cache = prefix_cache
+        self.gamma = gamma
         self.debug = debug
         self.waiting: List[Request] = []  # kept sorted by req_id
         self.slots: List[Optional[Request]] = [None] * max_slots
@@ -365,11 +386,16 @@ class Scheduler:
             if self.slots[slot] is None:
                 self._admit(self.waiting.pop(0), slot)
 
-        # 2. Decode set reserves budget first: one token per running
-        # sequence, each guaranteed exclusive ownership of (copy-on-write)
-        # and a page for its write position. Requests that already issued
+        # 2. Decode set reserves budget first: each running sequence
+        # charges its full device write — one token, or a gamma-wide
+        # speculative round — and is guaranteed exclusive ownership of
+        # (copy-on-write) and pages for every position it may touch. A
+        # round may overshoot the budget by at most cost-1; gating on
+        # budget <= 0 (not budget < cost) avoids livelock when
+        # token_budget < gamma. Requests that already issued
         # max_new_tokens sit out — their last readback resolves this step.
         budget = self.token_budget
+        cost = self.gamma if self.gamma else 1
         for req in sorted(self.running, key=lambda r: r.req_id):
             if (
                 req.state is not RequestState.DECODE
@@ -379,9 +405,16 @@ class Scheduler:
                 continue
             if not self._cow_write_page(req, plan):
                 continue  # req itself was preempted reclaiming copy space
-            if self._ensure_pages(req, req.len_cached + 1):
+            # A gamma-wide round may overhang max_seq_len (the needed
+            # positions always fit; only wasted chunk width runs past the
+            # end) — don't allocate pages for the overhang, the model
+            # routes those writes to the null page.
+            need = min(
+                req.len_cached + cost, self.pages_per_seq * self.page_size
+            )
+            if self._ensure_pages(req, need):
                 plan.decode_slots.append(req.slot)
-                budget -= 1
+                budget -= cost
 
         # 3. Remaining budget goes to prefill chunks, highest priority
         # first, power-of-two sized so compile variants stay bounded.
@@ -533,3 +566,43 @@ class Scheduler:
         path and the scheduler-only tests."""
         req = self.note_decode_dispatched(slot)
         return self.resolve_decoded(req, token, now=now)
+
+    def resolve_spec(
+        self, req: Request, tokens: List[int], now: Optional[float] = None
+    ) -> Optional[Request]:
+        """Apply one speculative verify round to ``req``: the accepted
+        draft tokens plus the correction, in order. Speculative rounds
+        resolve synchronously — the host needs the per-row accepted count
+        before it can plan the next round — so there are no PENDING
+        placeholders; every appended token advances ``len_cached`` with it
+        and the DECODE invariant (``len_cached == len(tokens) - 1``) holds
+        between rounds. Truncates at max_new_tokens / the stop token: the
+        fixed-gamma device program may emit past either, and the rejected
+        or overshoot K/V needs no cleanup (``len_cached`` simply stops
+        short; stale positions are masked and overwritten write-then-attend
+        by the real continuation). Returns the request when the round
+        finished it."""
+        assert req.state is RequestState.DECODE and not req.pending_idx, (
+            f"request {req.req_id} spec resolve in bad state"
+        )
+        assert req.len_cached == len(req.tokens) - 1, (
+            f"request {req.req_id} spec resolve out of sync"
+        )
+        finished = False
+        stop = req.params.stop_token
+        for token in tokens:
+            token = int(token)
+            req.tokens.append(token)
+            req.len_cached += 1
+            req.generated.append(token)
+            if req.first_token_time is None:
+                req.first_token_time = (
+                    time.perf_counter() if now is None else now
+                )
+            if req.n_generated >= req.params.max_new_tokens or (
+                stop is not None and token == stop
+            ):
+                finished = True
+                break
+        self._register_filled(req)
+        return req if finished else None

@@ -44,15 +44,17 @@ Design notes (TPU/XLA):
   invisible) and writes through ``dynamic_update_slice`` at the index, so
   stale K/V from rejected draft tokens is dead by construction — rolling
   back IS setting ``cache_index`` (`_set_cache_index`), O(1).
-* Batched rounds advance by the MINIMUM acceptance across rows (the cache
-  index is one scalar per layer, not per row). Exactness survives in both
-  modes: greedy rows re-derive the identical tokens next round
-  (determinism), and sampled rows stay exactly p-distributed because
-  whether a row's accepted-but-unfinalized trial is kept or discarded
-  depends only on OTHER rows' independent randomness — a discarded
-  position simply gets a fresh, equally-exact trial next round. The
-  expected speedup still decays with batch size; B=1 is the latency case
-  speculative decoding exists for.
+* Batched rounds advance PER ROW: every layer's ``cache_index`` is a [B]
+  vector (``models/transformer.py::Attention._decode_step`` scatter-writes
+  and masks at per-row offsets, the same machinery the paged serving path
+  uses), so each row keeps exactly its own accepted prefix + correction and
+  no row ever stalls on the batch minimum. A row that reaches the target
+  length freezes (its advance clamps at ``total_len - 1``) and keeps
+  proposing into the gamma-padded garbage region past its output — fixed
+  shapes are preserved, and the overshoot writes are dropped/masked.
+  Per-row keys (``fold_in(rng, t_row)``) keep every row's sampled stream
+  independent of the other rows' acceptance, so the emitted law is exactly
+  ``p`` row by row.
 * The per-round advance is capped at ``gamma`` (no "bonus" ``gamma+1``-th
   token on full acceptance): emitting it would advance past the draft
   cache's fill point and turn the next draft phase into a ragged catch-up
@@ -118,11 +120,12 @@ def speculative_generate(
     averaged over the batch, so ragged batches report the true mean;
     rounds that merely replay bucketed-down prompt tails count toward
     neither (their auto-accepted prompt positions would overstate draft
-    quality). A/R in [1, gamma] is the mean accepted chunk length (draft
-    quality x batch-min effect). R is a LOWER bound on the target's
-    chunked forwards (replay-only rounds run one too); with uniform
-    power-of-two prompt lengths the two coincide, and either way the
-    target ran far fewer forwards than A serial single-token steps.
+    quality). A/R in [1, gamma] is the mean accepted chunk length (pure
+    draft quality — acceptance is per row, so no batch-min decay). R is a
+    LOWER bound on the target's chunked forwards (replay-only rounds run
+    one too); with uniform power-of-two prompt lengths the two coincide,
+    and either way the target ran far fewer forwards than A serial
+    single-token steps.
 
     ``temperature > 0`` switches to SAMPLED speculative decoding
     (Leviathan et al. modified rejection sampling): the draft SAMPLES each
@@ -143,9 +146,10 @@ def speculative_generate(
     batch-sharded like ``generation.generate``: tokens, prompt lengths,
     and BOTH models' KV caches are placed ``P(data_axis)`` and the params
     replicated — the loop is pure jit, so GSPMD partitions it from the
-    placements alone (the batch-min ``jnp.min`` over rows becomes the one
-    cross-device collective per round). Output is token-for-token
-    identical to the single-device run (pinned by test).
+    placements alone (per-row advance leaves the loop condition's
+    ``jnp.min`` over row cursors as the one cross-device collective per
+    round). Output is token-for-token identical to the single-device run
+    (pinned by test).
     """
     if gamma < 1:
         raise ValueError(f"gamma must be >= 1, got {gamma}")
@@ -255,9 +259,22 @@ def _compiled_spec_run(target, draft, buf_len, gamma, prefill_len,
             )
             dcache = up["cache"]
 
+        rows_idx = jnp.arange(batch, dtype=jnp.int32)
+        # One base key per ROW (row index folded in): rows at the same
+        # cursor must still draw independently — vmapped categorical with
+        # identical keys would clone one sample across the batch.
+        row_keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+            rng, rows_idx
+        )
+
+        def fold_rows(keys, i):
+            # Per-row subkey: fold the same step tag into every row's key.
+            return jax.vmap(jax.random.fold_in, in_axes=(0, None))(keys, i)
+
         def draft_step(i, carry):
-            tokens, dcache, t, round_key = carry
-            current = jax.lax.dynamic_slice(tokens, (0, t + i), (batch, 1))
+            tokens, dcache, t, round_keys = carry
+            # ``t`` is [B]: every row reads/writes at its OWN cursor.
+            current = jnp.take_along_axis(tokens, (t + i)[:, None], axis=1)
             logits, up = draft.apply(
                 {"params": draft_params, "cache": dcache}, current,
                 mutable=["cache"],
@@ -267,43 +284,44 @@ def _compiled_spec_run(target, draft, buf_len, gamma, prefill_len,
                 # Propose x ~ q (the draft's filtered distribution); the
                 # full q row is recomputed at verify time in one chunked
                 # draft pass instead of being carried through this loop.
-                proposal = jax.random.categorical(
-                    jax.random.fold_in(round_key, i),
+                proposal = jax.vmap(jax.random.categorical)(
+                    fold_rows(round_keys, i),
                     truncate_logits(last / temperature, top_k, top_p),
                 ).astype(jnp.int32)
             else:
                 proposal = jnp.argmax(last, axis=-1).astype(jnp.int32)
             keep_prompt = (t + i + 1) < prompt_lengths
-            existing = jax.lax.dynamic_slice(
-                tokens, (0, t + i + 1), (batch, 1)
+            existing = jnp.take_along_axis(
+                tokens, (t + i + 1)[:, None], axis=1
             )[:, 0]
             nxt = jnp.where(keep_prompt, existing, proposal)
-            tokens = jax.lax.dynamic_update_slice(
-                tokens, nxt[:, None], (0, t + i + 1)
-            )
-            return tokens, up["cache"], t, round_key
+            tokens = tokens.at[rows_idx, t + i + 1].set(nxt)
+            return tokens, up["cache"], t, round_keys
 
         def body(carry):
             tokens, tcache, dcache, t, rounds, advanced = carry
-            round_key = jax.random.fold_in(rng, t)
-            # Round entry invariant: both cache_index == t; tokens[.., :t+1]
-            # are final (target-consistent).
+            # Per-row round keys: a row's draws depend only on its own
+            # (row, cursor) pair, so its sampled stream is independent of
+            # how fast the other rows accepted.
+            round_keys = jax.vmap(jax.random.fold_in)(row_keys, t)
+            # Round entry invariant: both caches' per-row cache_index == t;
+            # tokens[b, :t[b]+1] are final (target-consistent).
             tokens, dcache, _, _ = jax.lax.fori_loop(
-                0, gamma, draft_step, (tokens, dcache, t, round_key)
+                0, gamma, draft_step, (tokens, dcache, t, round_keys)
             )
-            # Target verifies the whole proposal in one chunked forward:
-            # positions t .. t+gamma-1 predict t+1 .. t+gamma.
-            chunk = jax.lax.dynamic_slice(tokens, (0, t), (batch, gamma))
+            # Target verifies every proposal in one chunked forward:
+            # row b's positions t[b] .. t[b]+gamma-1 predict
+            # t[b]+1 .. t[b]+gamma.
+            cols = jnp.arange(gamma, dtype=jnp.int32)[None, :]
+            chunk = jnp.take_along_axis(tokens, t[:, None] + cols, axis=1)
             logits, up = target.apply(
                 {"params": params, "cache": tcache}, chunk, mutable=["cache"]
             )
             tcache = up["cache"]
 
-            pos = t + 1 + jnp.arange(gamma)[None, :]  # positions decided
+            pos = t[:, None] + 1 + cols  # positions decided
             in_prompt = pos < prompt_lengths[:, None]
-            written = jax.lax.dynamic_slice(
-                tokens, (0, t + 1), (batch, gamma)
-            )
+            written = jnp.take_along_axis(tokens, pos, axis=1)
             if sampled:
                 # Full q rows in ONE chunked draft replay: rewind the draft
                 # cache to t and re-feed the same chunk (the K/V writes are
@@ -319,9 +337,9 @@ def _compiled_spec_run(target, draft, buf_len, gamma, prefill_len,
                 qf = filtered(dlogits)  # [B, gamma, V]
                 px = jnp.take_along_axis(pf, written[..., None], axis=-1)[..., 0]
                 qx = jnp.take_along_axis(qf, written[..., None], axis=-1)[..., 0]
-                u = jax.random.uniform(
-                    jax.random.fold_in(round_key, gamma), (batch, gamma)
-                )
+                u = jax.vmap(
+                    lambda key: jax.random.uniform(key, (gamma,))
+                )(fold_rows(round_keys, gamma))
                 # u < min(1, px/qx)  <=>  u*qx < px (q(x) > 0 a.s. — x was
                 # sampled from q). Prompt positions are given: auto-accept.
                 match = (u * qx < px) | in_prompt
@@ -329,45 +347,45 @@ def _compiled_spec_run(target, draft, buf_len, gamma, prefill_len,
                 g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 match = (written == g) | in_prompt
             n_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [B]
-            n = jnp.min(n_row)  # batch-min advance (see module docstring)
 
-            # Correction write at position t+n+1. Greedy: the target's own
-            # token. Sampled: a draw from the residual max(p - q, 0) — the
-            # distribution that makes the emitted token exactly p-law
-            # (falling back to p itself in the measure-zero p == q corner
-            # where the residual has no mass). When n == gamma the clamped
-            # write is a no-op (every row accepted column gamma-1, and
-            # n_row > ni routes those rows to their already-written token);
-            # rows that accepted beyond n keep their accepted token the
-            # same way.
-            ni = jnp.minimum(n, gamma - 1)
+            # Correction write at row b's position t[b]+n_row[b]+1. Greedy:
+            # the target's own token. Sampled: a draw from the residual
+            # max(p - q, 0) — the distribution that makes the emitted token
+            # exactly p-law (falling back to p itself in the measure-zero
+            # p == q corner where the residual has no mass). Rows with
+            # n_row == gamma accepted their whole chunk: the clamped ni
+            # routes them back to their already-written token via the
+            # n_row > ni select, so the write is a no-op for them.
+            ni = jnp.minimum(n_row, gamma - 1)  # [B]
             if sampled:
-                pf_n = jax.lax.dynamic_index_in_dim(
-                    pf, ni, axis=1, keepdims=False
-                )  # [B, V]
-                qf_n = jax.lax.dynamic_index_in_dim(
-                    qf, ni, axis=1, keepdims=False
-                )
+                pf_n = jnp.take_along_axis(
+                    pf, ni[:, None, None], axis=1
+                )[:, 0, :]  # [B, V]
+                qf_n = jnp.take_along_axis(
+                    qf, ni[:, None, None], axis=1
+                )[:, 0, :]
                 residual = jnp.maximum(pf_n - qf_n, 0.0)
                 has_mass = jnp.sum(residual, axis=-1, keepdims=True) > 0
                 res_dist = jnp.where(has_mass, residual, pf_n)
-                replacement = jax.random.categorical(
-                    jax.random.fold_in(round_key, gamma + 1),
+                replacement = jax.vmap(jax.random.categorical)(
+                    fold_rows(round_keys, gamma + 1),
                     jnp.log(res_dist),
                 ).astype(jnp.int32)
             else:
-                replacement = jax.lax.dynamic_index_in_dim(
-                    g, ni, axis=1, keepdims=False
-                )  # [B]: each row's own target token at the correction column
-            kept = jax.lax.dynamic_index_in_dim(
-                written, ni, axis=1, keepdims=False
-            )
+                replacement = jnp.take_along_axis(g, ni[:, None], axis=1)[
+                    :, 0
+                ]  # [B]: each row's own target token at its correction column
+            kept = jnp.take_along_axis(written, ni[:, None], axis=1)[:, 0]
             corrected = jnp.where(n_row > ni, kept, replacement)
-            tokens = jax.lax.dynamic_update_slice(
-                tokens, corrected[:, None], (0, t + ni + 1)
-            )
+            tokens = tokens.at[rows_idx, t + ni + 1].set(corrected)
 
-            t_new = t + jnp.minimum(n + 1, gamma)
+            # Per-row advance, clamped at the finish line: a row that
+            # reached total_len - 1 freezes there (its later proposals land
+            # in the gamma-padded buffer tail and are never emitted), so
+            # fast rows never outrun the buffer while slow rows catch up.
+            t_new = jnp.minimum(
+                t + jnp.minimum(n_row + 1, gamma), total_len - 1
+            )
             tcache = _set_cache_index(tcache, t_new)
             dcache = _set_cache_index(dcache, t_new)
             # Stats count only GENERATED positions: rounds replaying
@@ -377,7 +395,7 @@ def _compiled_spec_run(target, draft, buf_len, gamma, prefill_len,
             # p >= prompt_lengths[b]) and averaged over the batch, so a
             # ragged batch — where some rows are already generating while
             # others still replay their prompt — reports the true mean
-            # accepted chunk instead of the batch-max approximation.
+            # accepted chunk.
             per_row = jnp.clip(
                 t_new - jnp.maximum(t, prompt_lengths - 1), 0, t_new - t
             ).astype(jnp.float32)
@@ -387,9 +405,13 @@ def _compiled_spec_run(target, draft, buf_len, gamma, prefill_len,
                     advanced + gen_adv)
 
         def cond(carry):
-            return carry[3] < total_len - 1
+            # Run until EVERY row's cursor reaches the finish line — the
+            # one cross-row (and, sharded, cross-device) reduction per round.
+            return jnp.min(carry[3]) < total_len - 1
 
-        t0 = jnp.asarray(prefill_len - 1, jnp.int32)
+        t0 = jnp.full((batch,), prefill_len - 1, jnp.int32)
+        tcache = _set_cache_index(tcache, t0)
+        dcache = _set_cache_index(dcache, t0)
         tokens, _, _, _, rounds, advanced = jax.lax.while_loop(
             cond, body,
             (tokens, tcache, dcache, t0, jnp.zeros((), jnp.int32),

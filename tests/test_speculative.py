@@ -265,6 +265,38 @@ class TestValidation:
             )
 
 
+class TestPerRowAcceptance:
+    def test_batched_rounds_equal_slowest_solo_row(self):
+        """Acceptance is PER ROW: a batched run needs exactly as many
+        rounds as its slowest row needed alone. Under the old
+        minimum-across-rows rewind, one bad row dragged every row back and
+        the batched count exceeded the solo max."""
+        model = lm()
+        draft = lm(d_model=8, n_layers=1, n_heads=1, d_ff=16)
+        draft_params, _ = init(draft, key=11)
+        params, tokens = init(model, batch=4)
+        new = 12
+        solo_rounds = []
+        for b in range(tokens.shape[0]):
+            out_b, stats_b = speculative_generate(
+                model, params, draft, draft_params,
+                jnp.asarray(tokens[b : b + 1]), new, gamma=4,
+                return_stats=True,
+            )
+            solo_rounds.append(int(stats_b["rounds"]))
+        out, stats = speculative_generate(
+            model, params, draft, draft_params, jnp.asarray(tokens), new,
+            gamma=4, return_stats=True,
+        )
+        assert solo_rounds.count(solo_rounds[0]) < len(solo_rounds), (
+            "fixture rows all advance in lockstep — pick a worse draft"
+        )
+        assert int(stats["rounds"]) == max(solo_rounds)
+        # And batching never changes any row's tokens (greedy exactness).
+        ref = np.asarray(generate(model, params, jnp.asarray(tokens), new))
+        np.testing.assert_array_equal(np.asarray(out), ref)
+
+
 class TestStats:
     def test_advance_counts_cover_emitted_tokens(self):
         """rounds >= ceil(new/gamma); positions_advanced >= the emitted

@@ -5,7 +5,10 @@
 # non-empty latency metrics. A second scenario replays waves of requests
 # sharing a system-prompt prefix and asserts the prefix cache actually
 # hits (nonzero hit rate, cached tokens admitted, TTFT hit-reservoir
-# populated) with zero page leaks.
+# populated) with zero page leaks. A third scenario reruns the first
+# workload under SPECULATIVE decoding (a one-layer draft plus a self-draft
+# pass) and asserts greedy token parity with the plain engine, nonzero
+# acceptance, and zero leaks across both page pools.
 #
 #   bash tools/serving_smoke.sh
 #
@@ -108,5 +111,58 @@ print(
     f"hit_rate={s2['prefix_hit_rate']:.2f} "
     f"tokens_hit={s2['prefix_tokens_hit']} "
     f"cow_copies={s2['cow_copies']} evictions={s2['page_evictions']}"
+)
+
+# ---- scenario 3: speculative decoding must match the plain engine ----
+# Replay a fixed workload on a plain engine, then on speculative engines.
+# Greedy speculative serving is exact (not approximate): every request's
+# tokens must be identical. The self-draft pass pins acceptance == 1 and
+# multi-token advance; the independent one-layer draft exercises real
+# rejections/rollback and must STILL be token-identical.
+draft = TransformerLM(
+    vocab_size=128, d_model=32, n_layers=1, n_heads=2, d_ff=64,
+    dtype=jnp.float32,
+)
+draft_params = draft.init(
+    jax.random.PRNGKey(3), jnp.zeros((1, 8), jnp.int32)
+)["params"]
+
+prompts3 = [
+    rng.integers(0, 128, int(rng.integers(3, 10))).tolist()
+    for _ in range(6)
+]
+
+def replay(**kw):
+    e = InferenceEngine(
+        model, params, max_slots=4, max_seq_len=32, page_size=4,
+        token_budget=16, max_prefill_chunk=8, debug=True, **kw,
+    )
+    rids = [e.submit(p, SamplingParams(max_new_tokens=6)) for p in prompts3]
+    e.run()
+    return [e.poll(r).generated for r in rids], e
+
+plain, _ = replay()
+for name, dm, dp in (
+    ("one-layer draft", draft, draft_params),
+    ("self-draft", model, params),
+):
+    spec, e3 = replay(draft_model=dm, draft_params=dp, gamma=3)
+    assert spec == plain, f"speculative ({name}) diverged from plain engine"
+    s3 = e3.stats()
+    assert s3["verify_rounds"] > 0
+    assert s3["spec_acceptance_rate"] >= 0.0
+    assert s3["pages_allocated"] == 0, "pages leaked after spec drain"
+    e3.allocator.check_invariants()
+assert s3["spec_acceptance_rate"] == 1.0, (
+    "self-draft must accept every proposal"
+)
+assert s3["tokens_generated"] > s3["verify_rounds"], (
+    "full acceptance should advance multiple tokens per round"
+)
+
+print(
+    "[serving_smoke] PASS: speculative scenario, greedy parity across "
+    f"drafts, self-draft acceptance={s3['spec_acceptance_rate']:.2f} "
+    f"tokens/verify={s3['spec_tokens_per_verify_mean']:.2f}"
 )
 EOF

@@ -502,6 +502,7 @@ def bench_serving(
     import numpy as np
 
     from distributed_pytorch_tpu.models.transformer import TransformerLM
+    from distributed_pytorch_tpu.obs import Tracer
     from distributed_pytorch_tpu.serving import InferenceEngine, SamplingParams
     from distributed_pytorch_tpu.serving.admission import ServingMetrics
 
@@ -527,22 +528,25 @@ def bench_serving(
     ]
     warm_rng = np.random.default_rng(seed + 1)
 
-    def run_pass(prefix_caching: bool, spec: bool = False):
+    def run_pass(prefix_caching: bool, spec: bool = False,
+                 trace: bool = False):
         kw = {}
         if spec:
             kw.update(
                 draft_model=model, draft_params=params, gamma=gamma
             )
+        tracer = Tracer() if trace else None
         eng = InferenceEngine(
             model, params, max_slots=8, max_seq_len=64, page_size=8,
             token_budget=64, max_prefill_chunk=32, max_queue=n_requests,
-            prefix_cache=prefix_caching, **kw,
+            prefix_cache=prefix_caching, tracer=tracer, **kw,
         )
         # Warm the compile caches off the clock — one request per
         # power-of-two prefill bucket (a prompt of length c+1 prefills
         # exactly one c-chunk) plus the shared decode step — then reset the
         # accounting: TTFT must measure scheduling, not XLA compilation.
         chunk = 1
+        n_warm = 0
         while chunk <= 32:
             warm = eng.submit(
                 warm_rng.integers(0, 256, chunk + 1).tolist(),
@@ -550,6 +554,7 @@ def bench_serving(
             )
             eng.run()
             assert eng.poll(warm).finished
+            n_warm += 1
             chunk *= 2
         eng.metrics = ServingMetrics(speculative=eng.speculative)
         eng.admission.accepted = 0
@@ -578,16 +583,32 @@ def bench_serving(
                 time.sleep(min(arrivals[submitted] - now, 0.01))
         assert all(eng.poll(r).finished for r in ids)
         stats = eng.stats()
-        return {
+        row = {
             "prefix_caching": prefix_caching,
             "speculative": spec,
             "stats": {
                 k: (round(v, 6) if isinstance(v, float) else v)
                 for k, v in stats.items()
             },
+            # The unified-registry view of the same run — one source of
+            # truth (ServingMetrics + admission + allocator) rendered
+            # through MetricsRegistry.snapshot().
+            "registry": eng.registry.snapshot(),
         }
+        if tracer is not None:
+            # The tracer sees EVERY request the engine completed, including
+            # the n_warm compile-warm-up ones submitted before the metrics
+            # reset — the engine-truth span count is warm-up + measured.
+            row["trace_request_spans"] = tracer.spans_closed
+            row["trace_spans_expected"] = (
+                n_warm + stats["requests_completed"]
+            )
+        tokens = [eng.poll(r).generated for r in ids]
+        return row, tokens
 
-    rows = [run_pass(False), run_pass(True)]
+    row_off, _ = run_pass(False)
+    row_on, tokens_on = run_pass(True)
+    rows = [row_off, row_on]
     off, on = rows[0]["stats"], rows[1]["stats"]
     out = {
         "mode": "serving_poisson_prefix",
@@ -609,11 +630,32 @@ def bench_serving(
             if on.get("ttft_s_p50") else None
         ),
     }
+    # Observability-parity pass: the IDENTICAL prefix-cached workload with
+    # request tracing + step timeline enabled. The acceptance record:
+    # tokens must be bitwise-identical to the untraced pass, the per-request
+    # span count must equal completed requests, and the traced TPOT p50 sits
+    # next to the untraced one so the overhead is measured, not asserted.
+    row_traced, tokens_traced = run_pass(True, trace=True)
+    out["obs"] = {
+        "greedy_tokens_identical_with_tracing": tokens_traced == tokens_on,
+        "trace_request_spans": row_traced["trace_request_spans"],
+        "trace_spans_expected": row_traced["trace_spans_expected"],
+        "trace_spans_match": (
+            row_traced["trace_request_spans"]
+            == row_traced["trace_spans_expected"]
+        ),
+        "requests_completed": row_traced["stats"]["requests_completed"],
+        "tpot_s_p50_obs_off": on.get("tpot_s_p50"),
+        "tpot_s_p50_obs_on": row_traced["stats"].get("tpot_s_p50"),
+        "tokens_per_sec_obs_off": on.get("tokens_per_sec"),
+        "tokens_per_sec_obs_on": row_traced["stats"].get("tokens_per_sec"),
+    }
     if speculative:
         # Third pass: the prefix-cached workload again with speculative
         # rounds. Row [1] (prefix on, spec off) is the control — same
         # engine config, same workload, only the draft toggled.
-        rows.append(run_pass(True, spec=True))
+        row_spec, _ = run_pass(True, spec=True)
+        rows.append(row_spec)
         spec_on = rows[2]["stats"]
         out["mode"] = "serving_poisson_prefix_spec"
         out["workload"] += f"_gamma{gamma}"

@@ -79,6 +79,7 @@ from typing import Dict, List, Optional
 
 from distributed_pytorch_tpu.chaos import FaultProxy, get_plan as _get_fault_plan
 from distributed_pytorch_tpu.elastic.store import KVStoreClient, KVStoreServer
+from distributed_pytorch_tpu.obs import MetricsRegistry
 
 GEN_KEY = "tpurun/generation"  # bumped on every failure -> restart-the-world
 FATAL_KEY = "tpurun/fatal"  # set when restarts are exhausted or world aborts
@@ -401,6 +402,21 @@ class ElasticAgent:
         # the event; the monitor loop performs the store publish + worker
         # drain on its next 0.2s pass.
         self._drain_requested = threading.Event()
+        # Unified observability: restart/drain/chaos counters in an
+        # ``elastic_``-namespaced registry (push-style Counters — the run
+        # loop's locals keep their budget semantics, these are the export
+        # surface). Incremented alongside, never instead.
+        self.registry = MetricsRegistry(namespace="elastic")
+        self._c_spawns = self.registry.counter("spawns_total")
+        self._c_restarts = self.registry.counter("restarts_total")
+        self._c_preempt_restarts = self.registry.counter(
+            "preempt_restarts_total"
+        )
+        self._c_drains = self.registry.counter("drains_requested_total")
+        self.registry.gauge(
+            "chaos_faults_armed",
+            float(len(plan.faults)) if plan is not None else 0.0,
+        )
 
     def request_drain(self) -> bool:
         """Begin a graceful preemption drain (signal-handler safe: flag only).
@@ -409,6 +425,7 @@ class ElasticAgent:
         if self._drain_requested.is_set():
             return False
         self._drain_requested.set()
+        self._c_drains.inc()
         return True
 
     # ------------------------------------------------------------- heartbeat
@@ -692,7 +709,9 @@ class ElasticAgent:
                     )
                     return 143  # 128 + SIGTERM: conventional reclaim exit
                 spawns += 1
+                self._c_spawns.inc()
                 if preempt:
+                    self._c_preempt_restarts.inc()
                     print(
                         f"[tpurun] preempt detected (gen {generation}): "
                         f"{failure}; restart budget intact "
@@ -701,6 +720,7 @@ class ElasticAgent:
                     )
                     continue
                 restarts += 1
+                self._c_restarts.inc()
                 if restarts > cfg.max_restarts:
                     self.store.set(FATAL_KEY, f"node{cfg.node_rank}-restarts-exhausted")
                     print(

@@ -90,6 +90,52 @@ class ReservoirHistogram:
             f"{prefix}p99": self.quantile(0.99),
         }
 
+    def state(self) -> Dict[str, object]:
+        """JSON-serializable full state — exact aggregates plus the
+        reservoir samples — the unit of cross-host aggregation: each host
+        ships its state, one host folds them with :meth:`merge_state`."""
+        return {
+            "capacity": self.capacity,
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min if self.count else None,
+            "max": self.max if self.count else None,
+            "samples": list(self._samples),
+        }
+
+    def merge_state(self, state: Dict[str, object]) -> None:
+        """Fold another reservoir's :meth:`state` into this one. Exact
+        aggregates (count/sum/min/max) combine exactly; the merged
+        reservoir is a weighted subsample of the union — each retained
+        sample stands for ``stream_count / n_samples`` observations of its
+        source stream, and when the pooled samples overflow ``capacity``
+        they are downsampled without replacement by those weights
+        (Efraimidis-Spirakis keys on this reservoir's own RNG, so merges
+        stay deterministic per seed and merge order). Merging an empty
+        state is a no-op; merging INTO an empty reservoir adopts the
+        incoming samples."""
+        n = int(state["count"])
+        if n == 0:
+            return
+        my_count = self.count
+        theirs = [float(v) for v in state["samples"]]
+        self.count += n
+        self.sum += float(state["sum"])
+        self.min = min(self.min, float(state["min"]))
+        self.max = max(self.max, float(state["max"]))
+        pool = [
+            (v, my_count / len(self._samples)) for v in self._samples
+        ] + [(v, n / len(theirs)) for v in theirs]
+        if len(pool) <= self.capacity:
+            self._samples = [v for v, _ in pool]
+        else:
+            keyed = sorted(
+                pool,
+                key=lambda vw: self._rng.random() ** (1.0 / vw[1]),
+                reverse=True,
+            )
+            self._samples = [v for v, _ in keyed[: self.capacity]]
+
 
 class ReservoirGroup:
     """A fixed family of labeled :class:`ReservoirHistogram` reservoirs
@@ -132,6 +178,22 @@ class ReservoirGroup:
         for label, hist in self._hists.items():
             out.update(hist.summary(f"{prefix}{label}_"))
         return out
+
+    def state(self) -> Dict[str, Dict[str, object]]:
+        """Per-label :meth:`ReservoirHistogram.state` — the group's
+        cross-host aggregation unit."""
+        return {label: hist.state() for label, hist in self._hists.items()}
+
+    def merge_state(self, state: Dict[str, Dict[str, object]]) -> None:
+        """Fold another group's :meth:`state` in, label by label. Labels
+        are a fixed declared family, so an unknown incoming label is a
+        schema mismatch and raises (same contract as :meth:`record`)."""
+        for label, sub in state.items():
+            if label not in self._hists:
+                raise KeyError(
+                    f"unknown label {label!r}; declared: {self.labels}"
+                )
+            self._hists[label].merge_state(sub)
 
 
 class MetricLogger:

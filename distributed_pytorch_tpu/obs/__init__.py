@@ -1,0 +1,28 @@
+"""Unified observability: request tracing, step timelines, metrics registry.
+
+Three pieces, designed to be wired through hot paths at zero cost when
+disabled:
+
+* :class:`~.tracer.Tracer` / :data:`~.tracer.NULL_TRACER` — per-request
+  lifecycle spans and the engine step timeline, exported as Chrome/Perfetto
+  ``trace_event`` JSON;
+* :class:`~.registry.MetricsRegistry` — counters / gauges / labeled
+  reservoirs registered by every subsystem, rendered as structured JSON,
+  Prometheus text exposition, or merged across hosts.
+"""
+
+from distributed_pytorch_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    MetricsRegistry,
+)
+from distributed_pytorch_tpu.obs.tracer import NULL_TRACER, NullTracer, Tracer
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "MetricsRegistry",
+    "NULL_TRACER",
+    "NullTracer",
+    "Tracer",
+]

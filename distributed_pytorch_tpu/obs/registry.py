@@ -1,0 +1,282 @@
+"""Unified metrics registry: counters / gauges / reservoirs with labels.
+
+One export surface for every subsystem's numbers. The serving engine,
+allocator, admission controller, Trainer, and elastic agent each REGISTER
+their metrics here instead of growing another ad-hoc ``stats()`` dialect;
+the registry then renders them three ways:
+
+* :meth:`MetricsRegistry.snapshot` — structured JSON (``counters`` /
+  ``gauges`` / ``reservoirs``), the payload embedded in BENCH_SERVING.json
+  and asserted against engine ground truth in tests;
+* :meth:`MetricsRegistry.prometheus_text` — Prometheus text exposition
+  (counters/gauges as-is, reservoirs as ``summary`` with quantile labels);
+* :meth:`MetricsRegistry.merge` — cross-host aggregation: counters and
+  gauges sum, reservoirs merge sample-exactly via
+  :meth:`~distributed_pytorch_tpu.metrics.ReservoirHistogram.merge_state`,
+  so a fleet-wide p99 is computed over the union stream, not averaged
+  per-host percentiles (which would be meaningless).
+
+Registration is PULL-based: most metrics are registered as zero-arg
+callables resolved at snapshot time (``counter_fn`` / ``gauge_fn`` /
+``reservoir``), so the owning object keeps its counters as plain attributes
+— one source of truth, no double bookkeeping, and an object that is
+replaced wholesale (bench.py swaps ``engine.metrics`` after warm-up) stays
+correct as long as the callable re-resolves it. :class:`Counter` /
+:class:`Gauge` cover the push-style cases (the elastic agent's restart
+loop) where no long-lived owner exists.
+
+Naming convention: ``<namespace>_<subsystem>_<name>_<unit>[_total]`` —
+``_total`` marks monotonic counters (Prometheus idiom), units are spelled
+out (``_seconds``, never ``_s``), and label splits ride on the reservoir's
+``label`` key (``serving_ttft_seconds{source="hit"}``) rather than name
+suffixes.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, List, Optional, Union
+
+from distributed_pytorch_tpu.metrics import ReservoirGroup, ReservoirHistogram
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _sanitize(name: str) -> str:
+    name = _NAME_RE.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+class Counter:
+    """Push-style monotonic counter for owners without a metrics object."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Push-style settable gauge."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def set(self, value: float) -> None:
+        self.value = value
+
+
+class MetricsRegistry:
+    """Named collection of counters, gauges, and reservoir histograms.
+
+    ``namespace`` prefixes every metric name at export time
+    (``serving_...``, ``elastic_...``), so registries from different
+    subsystems can be merged or scraped side by side without collisions.
+    """
+
+    def __init__(self, namespace: str = ""):
+        self.namespace = _sanitize(namespace) if namespace else ""
+        # name -> zero-arg callable returning the current value.
+        self._counters: Dict[str, Callable[[], float]] = {}
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        # name -> (resolver, label_key or None). The resolver returns a
+        # ReservoirHistogram (label_key None) or a ReservoirGroup.
+        self._reservoirs: Dict[str, tuple] = {}
+
+    # --------------------------------------------------------- registration
+
+    def _check_new(self, name: str) -> str:
+        name = _sanitize(name)
+        if (
+            name in self._counters
+            or name in self._gauges
+            or name in self._reservoirs
+        ):
+            raise ValueError(f"metric {name!r} already registered")
+        return name
+
+    def counter(self, name: str) -> Counter:
+        """Create and register a push-style :class:`Counter`."""
+        c = Counter()
+        self.counter_fn(name, lambda: c.value)
+        return c
+
+    def counter_fn(self, name: str, fn: Callable[[], float]) -> None:
+        """Register a pull-style counter: ``fn`` is read at snapshot time
+        and must be monotonic over the owner's lifetime."""
+        self._counters[self._check_new(name)] = fn
+
+    def gauge(self, name: str, value: float = 0.0) -> Gauge:
+        """Create and register a push-style :class:`Gauge`."""
+        g = Gauge(value)
+        self.gauge_fn(name, lambda: g.value)
+        return g
+
+    def gauge_fn(self, name: str, fn: Callable[[], float]) -> None:
+        self._gauges[self._check_new(name)] = fn
+
+    def reservoir(
+        self,
+        name: str,
+        hist: Union[
+            ReservoirHistogram,
+            ReservoirGroup,
+            Callable[[], Union[ReservoirHistogram, ReservoirGroup]],
+        ],
+        label: Optional[str] = None,
+    ) -> None:
+        """Register a :class:`ReservoirHistogram` (``label=None``) or a
+        :class:`ReservoirGroup` (``label`` names the label dimension, e.g.
+        ``"source"``). Pass a zero-arg callable to re-resolve the object at
+        snapshot time (survives owners that replace their metrics object)."""
+        resolver = hist if callable(hist) else (lambda: hist)
+        self._reservoirs[self._check_new(name)] = (resolver, label)
+
+    # -------------------------------------------------------------- export
+
+    def _qualified(self, name: str) -> str:
+        return f"{self.namespace}_{name}" if self.namespace else name
+
+    @staticmethod
+    def _summary(hist: ReservoirHistogram) -> Dict[str, float]:
+        return hist.summary()
+
+    def snapshot(self, include_state: bool = False) -> Dict[str, dict]:
+        """Structured JSON view. ``include_state=True`` additionally embeds
+        each reservoir's sample state so :meth:`merge` can aggregate
+        percentiles sample-exactly across hosts."""
+        counters = {
+            self._qualified(n): fn() for n, fn in self._counters.items()
+        }
+        gauges = {self._qualified(n): fn() for n, fn in self._gauges.items()}
+        reservoirs: Dict[str, dict] = {}
+        states: Dict[str, dict] = {}
+        for name, (resolver, label) in self._reservoirs.items():
+            obj = resolver()
+            qname = self._qualified(name)
+            if label is None:
+                reservoirs[qname] = self._summary(obj)
+                if include_state:
+                    states[qname] = obj.state()
+            else:
+                reservoirs[qname] = {
+                    "label": label,
+                    "series": {
+                        value: self._summary(obj[value])
+                        for value in obj.labels
+                    },
+                }
+                if include_state:
+                    states[qname] = {"label": label, "series": obj.state()}
+        out = {
+            "counters": counters,
+            "gauges": gauges,
+            "reservoirs": reservoirs,
+        }
+        if include_state:
+            out["reservoir_states"] = states
+        return out
+
+    @classmethod
+    def merge(cls, snapshots: List[dict]) -> dict:
+        """Aggregate ``snapshot(include_state=True)`` payloads from several
+        processes into one snapshot of the same shape: counters and gauges
+        sum; reservoirs merge their sample states (exact count/sum/min/max,
+        reservoir-union percentiles) and re-render summaries. The multi-host
+        story: each host JSON-dumps its snapshot, host 0 gathers and merges."""
+        counters: Dict[str, float] = {}
+        gauges: Dict[str, float] = {}
+        merged_hists: Dict[str, object] = {}
+        labels: Dict[str, Optional[str]] = {}
+        for snap in snapshots:
+            for name, value in snap.get("counters", {}).items():
+                counters[name] = counters.get(name, 0) + value
+            for name, value in snap.get("gauges", {}).items():
+                gauges[name] = gauges.get(name, 0) + value
+            for name, state in snap.get("reservoir_states", {}).items():
+                if isinstance(state, dict) and "series" in state:
+                    labels.setdefault(name, state["label"])
+                    series = merged_hists.setdefault(name, {})
+                    for lab, sub in state["series"].items():
+                        hist = series.get(lab)
+                        if hist is None:
+                            hist = series[lab] = ReservoirHistogram(
+                                int(sub["capacity"])
+                            )
+                        hist.merge_state(sub)
+                else:
+                    labels.setdefault(name, None)
+                    hist = merged_hists.get(name)
+                    if hist is None:
+                        hist = merged_hists[name] = ReservoirHistogram(
+                            int(state["capacity"])
+                        )
+                    hist.merge_state(state)
+        reservoirs: Dict[str, dict] = {}
+        states: Dict[str, dict] = {}
+        for name, obj in merged_hists.items():
+            if labels[name] is None:
+                reservoirs[name] = cls._summary(obj)
+                states[name] = obj.state()
+            else:
+                reservoirs[name] = {
+                    "label": labels[name],
+                    "series": {
+                        lab: cls._summary(h) for lab, h in obj.items()
+                    },
+                }
+                states[name] = {
+                    "label": labels[name],
+                    "series": {lab: h.state() for lab, h in obj.items()},
+                }
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "reservoirs": reservoirs,
+            "reservoir_states": states,
+        }
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (one scrape body). Reservoirs render
+        as ``summary`` metrics: quantile-labeled samples plus ``_sum`` and
+        ``_count``; group labels become ordinary Prometheus labels."""
+        lines: List[str] = []
+
+        def emit_summary(qname, hist, extra=""):
+            for q in (0.5, 0.95, 0.99):
+                value = hist.quantile(q)
+                if value == value:  # skip NaN on empty reservoirs
+                    lines.append(
+                        f'{qname}{{{extra}quantile="{q}"}} {value}'
+                    )
+            suffix = "{" + extra.rstrip(",") + "}" if extra else ""
+            lines.append(f"{qname}_sum{suffix} {hist.sum}")
+            lines.append(f"{qname}_count{suffix} {hist.count}")
+
+        for name, fn in self._counters.items():
+            qname = self._qualified(name)
+            lines.append(f"# TYPE {qname} counter")
+            lines.append(f"{qname} {fn()}")
+        for name, fn in self._gauges.items():
+            qname = self._qualified(name)
+            lines.append(f"# TYPE {qname} gauge")
+            lines.append(f"{qname} {fn()}")
+        for name, (resolver, label) in self._reservoirs.items():
+            obj = resolver()
+            qname = self._qualified(name)
+            lines.append(f"# TYPE {qname} summary")
+            if label is None:
+                emit_summary(qname, obj)
+            else:
+                for value in obj.labels:
+                    emit_summary(
+                        qname, obj[value], extra=f'{label}="{value}",'
+                    )
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,292 @@
+"""Request lifecycle tracer + engine step timeline, Perfetto-exportable.
+
+Two timelines, one clock:
+
+* **Request spans** — one async span per accepted request, opened at
+  ``submit`` and closed at retire, with instant events for every lifecycle
+  transition in between: ``admit`` (slot, prefix-cache hit/miss, cached
+  token count), each ``prefill_chunk``, every resolved ``decode_token`` /
+  speculative ``verify_round`` (accepted-token counts), ``preempt``,
+  ``cow_copy``, and page ``evict`` pressure.
+* **Engine steps** — one duration slice per ``InferenceEngine.step()`` with
+  nested phase slices (``schedule`` / ``cow`` / ``prefill`` / ``dispatch``
+  / ``readback``) and per-step counter tracks (batch composition,
+  token-budget utilization, pages free/referenced/cached-idle, queue
+  depth).
+
+Export is Chrome ``trace_event`` JSON (:meth:`Tracer.to_perfetto` /
+:meth:`Tracer.save`) — load it at https://ui.perfetto.dev or
+``chrome://tracing``. Request spans are async events keyed by request id,
+so they line up under the engine-step track; timestamps are host
+``perf_counter`` microseconds from tracer construction, the same host
+clock ``jax.profiler`` stamps its XLA trace with, so a device trace
+captured over the same window lines up alongside.
+
+The disabled path is the null-object pattern: :data:`NULL_TRACER` is a
+shared :class:`NullTracer` whose every method is a no-op ``pass`` and whose
+``phase()`` returns a shared no-op context manager — no timestamps taken,
+no dicts built, no branches in the caller beyond an attribute load. The
+engine guards its per-step gauge *computation* behind ``tracer.enabled``
+so a disabled engine does zero extra work; serving outputs are
+bitwise-identical either way (pinned by tests).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, List
+
+# Perfetto process lanes: engine steps/phases under pid 1, request spans
+# under pid 2 — two top-level tracks that scroll together.
+_PID_ENGINE = 1
+_PID_REQUESTS = 2
+
+
+class _NullContext:
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CONTEXT = _NullContext()
+
+
+class NullTracer:
+    """Every method a no-op; ``enabled`` False so callers can skip gauge
+    computation entirely. One shared instance (:data:`NULL_TRACER`) serves
+    every disabled engine."""
+
+    __slots__ = ()
+    enabled = False
+
+    def begin_step(self) -> None:
+        pass
+
+    def end_step(self, **gauges) -> None:
+        pass
+
+    def phase(self, name: str) -> _NullContext:
+        return _NULL_CONTEXT
+
+    def request_begin(self, req_id: int, **attrs) -> None:
+        pass
+
+    def request_event(self, req_id: int, name: str, **attrs) -> None:
+        pass
+
+    def request_end(self, req_id: int, **attrs) -> None:
+        pass
+
+    def instant(self, name: str, **attrs) -> None:
+        pass
+
+
+NULL_TRACER = NullTracer()
+
+
+class _Phase:
+    """Context manager emitting one ``X`` (complete) slice on the engine
+    track; nested phases nest visually by time containment."""
+
+    __slots__ = ("_tracer", "_name", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str):
+        self._tracer = tracer
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = self._tracer._now_us()
+        return self
+
+    def __exit__(self, *exc):
+        tr = self._tracer
+        t1 = tr._now_us()
+        tr.events.append(
+            {
+                "name": self._name,
+                "cat": "engine",
+                "ph": "X",
+                "ts": self._t0,
+                "dur": t1 - self._t0,
+                "pid": _PID_ENGINE,
+                "tid": 0,
+                "args": {"step": tr.step_index},
+            }
+        )
+        return False
+
+
+class Tracer:
+    """Recording tracer. Construct one and hand it to
+    ``InferenceEngine(..., tracer=tracer)``; after the run,
+    :meth:`save` writes a Perfetto-loadable JSON trace.
+
+    Events accumulate in memory as ``trace_event`` dicts (microsecond
+    timestamps relative to construction). ``spans_opened`` /
+    ``spans_closed`` count request spans — a drained engine satisfies
+    ``spans_closed == requests completed``.
+    """
+
+    enabled = True
+
+    def __init__(self, clock: Callable[[], float] = time.perf_counter):
+        self._clock = clock
+        self._epoch = clock()
+        self.events: List[dict] = []
+        self.step_index = -1
+        self._step_t0 = 0.0
+        self.spans_opened = 0
+        self.spans_closed = 0
+
+    def _now_us(self) -> float:
+        return (self._clock() - self._epoch) * 1e6
+
+    # -------------------------------------------------------- engine steps
+
+    def begin_step(self) -> None:
+        self.step_index += 1
+        self._step_t0 = self._now_us()
+
+    def end_step(self, **gauges) -> None:
+        """Close the current step slice and sample every gauge onto its own
+        counter track (``ph: C``) at the step boundary."""
+        now = self._now_us()
+        self.events.append(
+            {
+                "name": "step",
+                "cat": "engine",
+                "ph": "X",
+                "ts": self._step_t0,
+                "dur": now - self._step_t0,
+                "pid": _PID_ENGINE,
+                "tid": 1,
+                "args": {"step": self.step_index, **gauges},
+            }
+        )
+        for name, value in gauges.items():
+            self.events.append(
+                {
+                    "name": name,
+                    "cat": "gauge",
+                    "ph": "C",
+                    "ts": now,
+                    "pid": _PID_ENGINE,
+                    "args": {"value": value},
+                }
+            )
+
+    def phase(self, name: str) -> _Phase:
+        return _Phase(self, name)
+
+    # ------------------------------------------------------- request spans
+
+    def request_begin(self, req_id: int, **attrs) -> None:
+        self.spans_opened += 1
+        self.events.append(
+            {
+                "name": "request",
+                "cat": "request",
+                "ph": "b",
+                "id": int(req_id),
+                "ts": self._now_us(),
+                "pid": _PID_REQUESTS,
+                "tid": 0,
+                "args": {"req_id": int(req_id), **attrs},
+            }
+        )
+
+    def request_event(self, req_id: int, name: str, **attrs) -> None:
+        self.events.append(
+            {
+                "name": name,
+                "cat": "request",
+                "ph": "n",
+                "id": int(req_id),
+                "ts": self._now_us(),
+                "pid": _PID_REQUESTS,
+                "tid": 0,
+                "args": attrs,
+            }
+        )
+
+    def request_end(self, req_id: int, **attrs) -> None:
+        self.spans_closed += 1
+        self.events.append(
+            {
+                "name": "request",
+                "cat": "request",
+                "ph": "e",
+                "id": int(req_id),
+                "ts": self._now_us(),
+                "pid": _PID_REQUESTS,
+                "tid": 0,
+                "args": attrs,
+            }
+        )
+
+    def instant(self, name: str, **attrs) -> None:
+        """Global instant event (page evictions, chaos marks)."""
+        self.events.append(
+            {
+                "name": name,
+                "cat": "engine",
+                "ph": "i",
+                "s": "g",
+                "ts": self._now_us(),
+                "pid": _PID_ENGINE,
+                "tid": 0,
+                "args": attrs,
+            }
+        )
+
+    # -------------------------------------------------------------- export
+
+    def to_perfetto(self) -> Dict[str, object]:
+        """Chrome ``trace_event`` document: recorded events plus process /
+        thread name metadata so the lanes are labeled in the UI."""
+        meta = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": _PID_ENGINE,
+                "args": {"name": "engine"},
+            },
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": _PID_ENGINE,
+                "tid": 0,
+                "args": {"name": "step phases"},
+            },
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": _PID_ENGINE,
+                "tid": 1,
+                "args": {"name": "steps"},
+            },
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": _PID_REQUESTS,
+                "args": {"name": "requests"},
+            },
+        ]
+        return {
+            "traceEvents": meta + self.events,
+            "displayTimeUnit": "ms",
+        }
+
+    def save(self, path: str) -> str:
+        """Write the Perfetto JSON trace to ``path``; returns the path."""
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_perfetto(), f)
+        return path

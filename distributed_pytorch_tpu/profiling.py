@@ -10,6 +10,15 @@ traces viewable in TensorBoard (XProf) or Perfetto. The wait/warmup/active step
 schedule is replicated host-side: tracing turns on after ``wait + warmup``
 steps and off ``active`` steps later. Per-host subdirectories replace the
 reference's per-device ``worker_name``.
+
+Inside the traced window every step is additionally wrapped in a
+``jax.profiler.StepTraceAnnotation`` named by the GLOBAL step number, so
+XProf's step-time view and the trace timeline attribute device work to
+specific optimizer steps (the reference's ``record_function`` analog).
+The annotation opens when a traced step begins and closes right before the
+``step()`` hook advances the schedule — exactly bracketing the work between
+hooks — and never leaks across the trace stop (the window transition closes
+it first).
 """
 
 from __future__ import annotations
@@ -31,15 +40,29 @@ class StepProfiler:
             ...train step...
             profiler.step()
         profiler.stop()
+
+    ``annotate=False`` drops the per-step ``StepTraceAnnotation`` markers
+    (the bare pre-annotation behavior) — the wait/warmup/active window is
+    identical either way.
     """
 
-    def __init__(self, logdir: str, *, wait: int = 1, warmup: int = 1, active: int = 5):
+    def __init__(
+        self,
+        logdir: str,
+        *,
+        wait: int = 1,
+        warmup: int = 1,
+        active: int = 5,
+        annotate: bool = True,
+    ):
         self.logdir = os.path.join(logdir, f"host_{jax.process_index()}")
         self.wait = wait
         self.warmup = warmup
         self.active = active
+        self.annotate = annotate
         self._step = 0
         self._tracing = False
+        self._annotation = None
 
     @property
     def trace_started_at(self) -> int:
@@ -48,16 +71,35 @@ class StepProfiler:
     def start(self) -> None:
         self._step = 0
         self._maybe_transition()
+        self._open_annotation()
 
     def step(self) -> None:
         """Call once per optimizer step (twin of ``profiler.step()``,
         reference ``multigpu_profile.py:71``)."""
+        self._close_annotation()
         self._step += 1
         self._maybe_transition()
+        self._open_annotation()
 
     def stop(self) -> None:
+        self._close_annotation()
         if self._tracing:
             self._stop_trace()
+
+    def _open_annotation(self) -> None:
+        """Bracket the upcoming step's work in a StepTraceAnnotation named
+        by the global step — only while the trace is live (annotations
+        outside a trace are dead weight on every batch)."""
+        if self._tracing and self.annotate:
+            self._annotation = jax.profiler.StepTraceAnnotation(
+                "train", step_num=self._step
+            )
+            self._annotation.__enter__()
+
+    def _close_annotation(self) -> None:
+        if self._annotation is not None:
+            self._annotation.__exit__(None, None, None)
+            self._annotation = None
 
     def _maybe_transition(self) -> None:
         begin = self.trace_started_at
@@ -70,5 +112,6 @@ class StepProfiler:
             self._stop_trace()
 
     def _stop_trace(self) -> None:
+        self._close_annotation()  # an annotation must not outlive its trace
         jax.profiler.stop_trace()
         self._tracing = False

@@ -117,6 +117,23 @@ class AdmissionController:
             "cached_tokens_admitted": self.cached_tokens_admitted,
         }
 
+    def register_into(self, registry) -> None:
+        """Expose the admission counters through a
+        :class:`~distributed_pytorch_tpu.obs.MetricsRegistry`."""
+        registry.counter_fn("admission_accepted_total", lambda: self.accepted)
+        registry.counter_fn(
+            "admission_rejected_queue_full_total",
+            lambda: self.rejected_queue_full,
+        )
+        registry.counter_fn(
+            "admission_rejected_too_long_total",
+            lambda: self.rejected_too_long,
+        )
+        registry.counter_fn(
+            "cached_tokens_admitted_total",
+            lambda: self.cached_tokens_admitted,
+        )
+
 
 class ServingMetrics:
     """TTFT / TPOT / e2e reservoirs + exact throughput counters.
@@ -193,6 +210,52 @@ class ServingMetrics:
                     self.tpot_by_mode.record(
                         "spec" if self.speculative else "plain", tpot
                     )
+
+    @staticmethod
+    def register_into(registry, get) -> None:
+        """Register the serving counters and latency reservoirs into a
+        :class:`~distributed_pytorch_tpu.obs.MetricsRegistry`. ``get`` is a
+        zero-arg callable returning the CURRENT metrics object — the bench
+        replaces ``engine.metrics`` wholesale after warm-up, so every
+        resolver goes through ``get()`` at snapshot time rather than
+        capturing one instance."""
+        registry.counter_fn("engine_steps_total", lambda: get().engine_steps)
+        registry.counter_fn(
+            "tokens_generated_total", lambda: get().tokens_generated
+        )
+        registry.counter_fn(
+            "requests_completed_total", lambda: get().requests_completed
+        )
+        registry.counter_fn(
+            "verify_rounds_total", lambda: get().verify_rounds
+        )
+        registry.counter_fn(
+            "draft_tokens_proposed_total", lambda: get().draft_proposed
+        )
+        registry.counter_fn(
+            "draft_tokens_accepted_total", lambda: get().draft_accepted
+        )
+        registry.gauge_fn(
+            "uptime_seconds", lambda: time.perf_counter() - get()._start
+        )
+        registry.gauge_fn(
+            "tokens_per_sec",
+            lambda: get().snapshot()["tokens_per_sec"],
+        )
+        registry.reservoir("ttft_seconds", lambda: get().ttft)
+        registry.reservoir("tpot_seconds", lambda: get().tpot)
+        registry.reservoir("e2e_seconds", lambda: get().e2e)
+        registry.reservoir(
+            "ttft_seconds_by_source",
+            lambda: get().ttft_by_source,
+            label="source",
+        )
+        registry.reservoir(
+            "tpot_seconds_by_mode", lambda: get().tpot_by_mode, label="mode"
+        )
+        registry.reservoir(
+            "spec_per_verify", lambda: get().spec, label="stat"
+        )
 
     def snapshot(self) -> Dict[str, float]:
         """One flat dict: counters + tokens/s + per-metric percentiles —

@@ -83,6 +83,8 @@ from distributed_pytorch_tpu.generation import (
     decode_token_step,
     truncate_logits,
 )
+from distributed_pytorch_tpu.obs import MetricsRegistry, Tracer
+from distributed_pytorch_tpu.obs.tracer import NULL_TRACER
 from distributed_pytorch_tpu.serving.admission import (
     AdmissionController,
     ServingMetrics,
@@ -162,6 +164,7 @@ class InferenceEngine:
         draft_params=None,
         gamma: int = 4,
         debug: bool = False,
+        tracer: Optional[Tracer] = None,
     ):
         if max_seq_len % page_size:
             raise ValueError(
@@ -223,7 +226,12 @@ class InferenceEngine:
             pools["draft"] = _zero_cache(self.draft_decode_model)
         self.pools = PagePoolGroup(**pools)
 
+        # Zero-cost-when-disabled observability handle: one shared null
+        # object serves every untraced engine — no timestamps, no dicts,
+        # bitwise-identical outputs (pinned by tests).
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         self.allocator = PagedBlockAllocator(num_pages)
+        self.allocator.tracer = self.tracer
         self.prefix_cache = (
             PrefixCache(self.allocator, page_size) if prefix_cache else None
         )
@@ -237,6 +245,7 @@ class InferenceEngine:
             prefix_cache=self.prefix_cache,
             gamma=self.gamma,
             debug=debug,
+            tracer=self.tracer,
         )
         self.admission = AdmissionController(
             max_queue=max_queue,
@@ -244,6 +253,7 @@ class InferenceEngine:
             max_queue_tokens=max_queue_tokens,
         )
         self.metrics = ServingMetrics(speculative=self.speculative)
+        self.registry = self._build_registry()
         self.requests: Dict[int, Request] = {}
         self._next_id = 0
         self._keys: Dict[int, jax.Array] = {}
@@ -268,6 +278,48 @@ class InferenceEngine:
         self._inflight: Optional[
             Tuple[jax.Array, List[int], List[Request]]
         ] = None
+
+    def _build_registry(self) -> MetricsRegistry:
+        """Every serving metric registered into one ``serving_``-namespaced
+        :class:`MetricsRegistry`: the :class:`ServingMetrics` counters and
+        latency reservoirs (resolved through ``self.metrics`` at snapshot
+        time, so swapping the metrics object — bench's warm-up reset —
+        stays correct), admission counters, scheduler pressure, and the
+        allocator's O(1) page-state gauges. Pull-based: the owning objects
+        keep their plain attributes as the single source of truth."""
+        reg = MetricsRegistry(namespace="serving")
+        ServingMetrics.register_into(reg, lambda: self.metrics)
+        self.admission.register_into(reg)
+        reg.counter_fn(
+            "preemptions_total", lambda: self.scheduler.preemptions
+        )
+        reg.counter_fn(
+            "cow_copies_total", lambda: self.allocator.cow_copies
+        )
+        reg.counter_fn(
+            "page_evictions_total", lambda: self.allocator.evictions
+        )
+        reg.gauge_fn(
+            "pages_free", lambda: self.allocator.counters()["pages_free"]
+        )
+        reg.gauge_fn(
+            "pages_referenced", lambda: self.allocator.num_allocated
+        )
+        reg.gauge_fn("pages_cached_idle", lambda: self.allocator.num_idle)
+        reg.gauge_fn("queue_depth", lambda: self.scheduler.num_waiting)
+        reg.gauge_fn(
+            "running_requests", lambda: len(self.scheduler.running)
+        )
+        if self.prefix_cache is not None:
+            pc = self.prefix_cache
+            reg.counter_fn("prefix_lookups_total", lambda: pc.lookups)
+            reg.counter_fn("prefix_hits_total", lambda: pc.hits)
+            reg.counter_fn("prefix_tokens_hit_total", lambda: pc.tokens_hit)
+            reg.counter_fn(
+                "prefix_tokens_missed_total", lambda: pc.tokens_missed
+            )
+            reg.gauge_fn("prefix_nodes", lambda: pc.num_nodes)
+        return reg
 
     # Pool accessors: the target pool keeps its historical ``self.cache``
     # name (the plain-engine hot path reads/writes it directly); the draft
@@ -526,6 +578,13 @@ class InferenceEngine:
         self._next_id += 1
         self.requests[req.req_id] = req
         self._keys[req.req_id] = jax.random.PRNGKey(params.seed)
+        if self.tracer.enabled:
+            self.tracer.request_begin(
+                req.req_id,
+                prompt_len=len(prompt),
+                max_new_tokens=params.max_new_tokens,
+                cached_tokens_at_submit=cached,
+            )
         self.scheduler.add(req)
         return req.req_id
 
@@ -542,6 +601,10 @@ class InferenceEngine:
             done = self.scheduler.resolve_decoded(
                 req, int(nxt_host[slot]), now=now
             )
+            if self.tracer.enabled:
+                self.tracer.request_event(
+                    req.req_id, "decode_token", n_generated=req.n_generated
+                )
             if done is not None:
                 self.scheduler.retire(done, now=now)
                 self.metrics.observe_finished(done)
@@ -549,104 +612,143 @@ class InferenceEngine:
                 finished.append(done.req_id)
         return finished
 
+    def _end_step_trace(self, plan) -> None:
+        """Close the tracer's step slice with the per-step gauges: batch
+        composition, token-budget utilization, page states, queue pressure.
+        Gauge computation happens ONLY here, behind ``tracer.enabled`` — a
+        disabled engine never takes this branch."""
+        cost = self.gamma if self.speculative else 1
+        used = sum(chunk for _s, chunk in plan.prefill) + (
+            len(plan.decode_slots) * cost
+        )
+        pages = self.allocator.counters()
+        self.tracer.end_step(
+            decode_rows=len(plan.decode_slots),
+            prefill_chunks=len(plan.prefill),
+            prefill_tokens=sum(chunk for _s, chunk in plan.prefill),
+            budget_utilization=used / self.scheduler.token_budget,
+            queue_depth=self.scheduler.num_waiting,
+            running_requests=len(self.scheduler.running),
+            pages_free=pages["pages_free"],
+            pages_referenced=pages["pages_referenced"],
+            pages_cached_idle=pages["pages_cached_idle"],
+        )
+
     def step(self) -> List[int]:
         """Run one engine iteration; returns ids of requests that FINISHED
         during it (under overlap, a finish surfaces on the step after its
         token was dispatched). A no-op (empty list) when nothing is queued,
         running, or in flight."""
-        plan = self.scheduler.schedule()
+        tr = self.tracer
+        tr.begin_step()
+        with tr.phase("schedule"):
+            plan = self.scheduler.schedule()
 
-        for _slot, src, dst in plan.copies:
-            # Copy-on-write fans out to every pool: the draft pool shares
-            # page ids with the target pool, so a page that splits, splits
-            # everywhere.
-            self.pools.copy_page(
-                self._copy_page,
-                jnp.asarray(src, jnp.int32),
-                jnp.asarray(dst, jnp.int32),
-            )
+        if plan.copies:
+            with tr.phase("cow"):
+                for _slot, src, dst in plan.copies:
+                    # Copy-on-write fans out to every pool: the draft pool
+                    # shares page ids with the target pool, so a page that
+                    # splits, splits everywhere.
+                    self.pools.copy_page(
+                        self._copy_page,
+                        jnp.asarray(src, jnp.int32),
+                        jnp.asarray(dst, jnp.int32),
+                    )
 
         if plan.empty:
             # Nothing to dispatch — drain the outstanding readback (e.g.
             # the final token of the last request) before reporting idle.
-            return (
-                self._resolve_inflight() if self._inflight is not None
-                else []
-            )
+            if self._inflight is not None:
+                with tr.phase("readback"):
+                    finished = self._resolve_inflight()
+            else:
+                finished = []
+            if tr.enabled:
+                self._end_step_trace(plan)
+            return finished
 
         if self.speculative:
             return self._step_spec(plan)
 
-        for slot, chunk in plan.prefill:
-            req = self.scheduler.slots[slot]
-            start = req.len_cached
-            tok = np.asarray(
-                [req.tokens[start : start + chunk]], np.int32
-            )
-            table = req.table.as_row(self.pages_per_seq)[None]
-            self.cache = self._prefill_step(chunk)(
-                self.params, self.cache, jnp.asarray(tok),
-                jnp.asarray(table), jnp.asarray([start], jnp.int32),
-            )
-            self.scheduler.note_prefilled(slot, chunk)
+        if plan.prefill:
+            with tr.phase("prefill"):
+                for slot, chunk in plan.prefill:
+                    req = self.scheduler.slots[slot]
+                    start = req.len_cached
+                    tok = np.asarray(
+                        [req.tokens[start : start + chunk]], np.int32
+                    )
+                    table = req.table.as_row(self.pages_per_seq)[None]
+                    self.cache = self._prefill_step(chunk)(
+                        self.params, self.cache, jnp.asarray(tok),
+                        jnp.asarray(table),
+                        jnp.asarray([start], jnp.int32),
+                    )
+                    self.scheduler.note_prefilled(slot, chunk)
 
         finished: List[int] = []
         dispatched = None
         if plan.decode_slots:
-            self._stage_tables.fill(0)
-            self._stage_lens.fill(0)
-            self._stage_use_prev.fill(0)
-            for slot in plan.decode_slots:
-                req = self.scheduler.slots[slot]
-                pos = req.len_cached
-                tok = req.tokens[pos]
-                if tok == PENDING_TOKEN:
-                    # Input is last step's still-in-flight sample: select
-                    # it device-side from ``prev``.
-                    self._stage_use_prev[slot] = 1
-                    self._stage_tokens[slot] = 0
-                else:
-                    self._stage_tokens[slot] = tok
-                self._stage_tables[slot] = req.table.as_row(
-                    self.pages_per_seq
+            with tr.phase("dispatch"):
+                self._stage_tables.fill(0)
+                self._stage_lens.fill(0)
+                self._stage_use_prev.fill(0)
+                for slot in plan.decode_slots:
+                    req = self.scheduler.slots[slot]
+                    pos = req.len_cached
+                    tok = req.tokens[pos]
+                    if tok == PENDING_TOKEN:
+                        # Input is last step's still-in-flight sample:
+                        # select it device-side from ``prev``.
+                        self._stage_use_prev[slot] = 1
+                        self._stage_tokens[slot] = 0
+                    else:
+                        self._stage_tokens[slot] = tok
+                    self._stage_tables[slot] = req.table.as_row(
+                        self.pages_per_seq
+                    )
+                    self._stage_lens[slot] = pos
+                    self._stage_temps[slot] = req.params.temperature
+                    self._stage_keys[slot] = np.asarray(
+                        jax.random.fold_in(
+                            self._keys[req.req_id], req.n_issued
+                        ),
+                        np.uint32,
+                    )
+                prev = (
+                    self._inflight[0] if self._inflight is not None
+                    else self._zero_prev
                 )
-                self._stage_lens[slot] = pos
-                self._stage_temps[slot] = req.params.temperature
-                self._stage_keys[slot] = np.asarray(
-                    jax.random.fold_in(
-                        self._keys[req.req_id], req.n_issued
-                    ),
-                    np.uint32,
+                nxt, self.cache = self._decode_step(
+                    self.params, self.cache,
+                    jnp.asarray(self._stage_tokens), prev,
+                    jnp.asarray(self._stage_use_prev),
+                    jnp.asarray(self._stage_tables),
+                    jnp.asarray(self._stage_lens),
+                    jnp.asarray(self._stage_temps),
+                    jnp.asarray(self._stage_keys),
                 )
-            prev = (
-                self._inflight[0] if self._inflight is not None
-                else self._zero_prev
-            )
-            nxt, self.cache = self._decode_step(
-                self.params, self.cache,
-                jnp.asarray(self._stage_tokens), prev,
-                jnp.asarray(self._stage_use_prev),
-                jnp.asarray(self._stage_tables),
-                jnp.asarray(self._stage_lens),
-                jnp.asarray(self._stage_temps),
-                jnp.asarray(self._stage_keys),
-            )
-            dispatched = (
-                nxt,
-                list(plan.decode_slots),
-                [
-                    self.scheduler.note_decode_dispatched(s)
-                    for s in plan.decode_slots
-                ],
-            )
+                dispatched = (
+                    nxt,
+                    list(plan.decode_slots),
+                    [
+                        self.scheduler.note_decode_dispatched(s)
+                        for s in plan.decode_slots
+                    ],
+                )
         # Resolve LAST step's tokens now — the np.asarray sync overlaps
         # with the decode dispatched above.
         if self._inflight is not None:
-            finished.extend(self._resolve_inflight())
+            with tr.phase("readback"):
+                finished.extend(self._resolve_inflight())
         self._inflight = dispatched
         if not self.overlap and self._inflight is not None:
-            finished.extend(self._resolve_inflight())
+            with tr.phase("readback"):
+                finished.extend(self._resolve_inflight())
         self.metrics.observe_step(new_tokens=len(plan.decode_slots))
+        if tr.enabled:
+            self._end_step_trace(plan)
         return finished
 
     def _step_spec(self, plan) -> List[int]:
@@ -657,82 +759,102 @@ class InferenceEngine:
         within their own step (the next schedule needs each row's accepted
         count), so overlap here means hiding the sync under prefill rather
         than deferring it a step like the plain path."""
+        tr = self.tracer
         dispatched = None
         if plan.decode_slots:
-            self._stage_tables.fill(0)
-            self._stage_lens.fill(0)
-            for slot in plan.decode_slots:
-                req = self.scheduler.slots[slot]
-                pos = req.len_cached
-                # Synchronous resolution means no PENDING placeholders:
-                # the row's input is always a real token.
-                self._stage_tokens[slot] = req.tokens[pos]
-                self._stage_tables[slot] = req.table.as_row(
-                    self.pages_per_seq
+            with tr.phase("dispatch"):
+                self._stage_tables.fill(0)
+                self._stage_lens.fill(0)
+                for slot in plan.decode_slots:
+                    req = self.scheduler.slots[slot]
+                    pos = req.len_cached
+                    # Synchronous resolution means no PENDING placeholders:
+                    # the row's input is always a real token.
+                    self._stage_tokens[slot] = req.tokens[pos]
+                    self._stage_tables[slot] = req.table.as_row(
+                        self.pages_per_seq
+                    )
+                    self._stage_lens[slot] = pos
+                    self._stage_temps[slot] = req.params.temperature
+                    self._stage_keys[slot] = np.asarray(
+                        jax.random.fold_in(
+                            self._keys[req.req_id], req.n_issued
+                        ),
+                        np.uint32,
+                    )
+                emitted, n_acc, self.cache, self.draft_cache = (
+                    self._spec_step(
+                        self.params, self.draft_params,
+                        self.cache, self.draft_cache,
+                        jnp.asarray(self._stage_tokens),
+                        jnp.asarray(self._stage_tables),
+                        jnp.asarray(self._stage_lens),
+                        jnp.asarray(self._stage_temps),
+                        jnp.asarray(self._stage_keys),
+                    )
                 )
-                self._stage_lens[slot] = pos
-                self._stage_temps[slot] = req.params.temperature
-                self._stage_keys[slot] = np.asarray(
-                    jax.random.fold_in(
-                        self._keys[req.req_id], req.n_issued
-                    ),
-                    np.uint32,
+                dispatched = (
+                    emitted,
+                    n_acc,
+                    [
+                        (s, self.scheduler.slots[s])
+                        for s in plan.decode_slots
+                    ],
                 )
-            emitted, n_acc, self.cache, self.draft_cache = self._spec_step(
-                self.params, self.draft_params,
-                self.cache, self.draft_cache,
-                jnp.asarray(self._stage_tokens),
-                jnp.asarray(self._stage_tables),
-                jnp.asarray(self._stage_lens),
-                jnp.asarray(self._stage_temps),
-                jnp.asarray(self._stage_keys),
-            )
-            dispatched = (
-                emitted,
-                n_acc,
-                [(s, self.scheduler.slots[s]) for s in plan.decode_slots],
-            )
 
-        for slot, chunk in plan.prefill:
-            req = self.scheduler.slots[slot]
-            start = req.len_cached
-            tok = np.asarray(
-                [req.tokens[start : start + chunk]], np.int32
-            )
-            table = req.table.as_row(self.pages_per_seq)[None]
-            self.cache = self._prefill_step(chunk)(
-                self.params, self.cache, jnp.asarray(tok),
-                jnp.asarray(table), jnp.asarray([start], jnp.int32),
-            )
-            self.draft_cache = self._draft_prefill_step(chunk)(
-                self.draft_params, self.draft_cache, jnp.asarray(tok),
-                jnp.asarray(table), jnp.asarray([start], jnp.int32),
-            )
-            self.scheduler.note_prefilled(slot, chunk)
+        if plan.prefill:
+            with tr.phase("prefill"):
+                for slot, chunk in plan.prefill:
+                    req = self.scheduler.slots[slot]
+                    start = req.len_cached
+                    tok = np.asarray(
+                        [req.tokens[start : start + chunk]], np.int32
+                    )
+                    table = req.table.as_row(self.pages_per_seq)[None]
+                    self.cache = self._prefill_step(chunk)(
+                        self.params, self.cache, jnp.asarray(tok),
+                        jnp.asarray(table),
+                        jnp.asarray([start], jnp.int32),
+                    )
+                    self.draft_cache = self._draft_prefill_step(chunk)(
+                        self.draft_params, self.draft_cache,
+                        jnp.asarray(tok), jnp.asarray(table),
+                        jnp.asarray([start], jnp.int32),
+                    )
+                    self.scheduler.note_prefilled(slot, chunk)
 
         finished: List[int] = []
         new_tokens = 0
         if dispatched is not None:
-            emitted, n_acc, slot_reqs = dispatched
-            emitted_host = np.asarray(emitted)  # the ONE blocking sync
-            n_acc_host = np.asarray(n_acc)
-            now = time.perf_counter()
-            for slot, req in slot_reqs:
-                accepted = int(n_acc_host[slot])
-                n_emit = min(accepted + 1, self.gamma)
-                toks = [int(t) for t in emitted_host[slot, :n_emit]]
-                before = req.n_generated
-                done = self.scheduler.resolve_spec(req, toks, now=now)
-                self.metrics.observe_verify(
-                    accepted=accepted, emitted=n_emit, gamma=self.gamma
-                )
-                new_tokens += req.n_generated - before
-                if done is not None:
-                    self.scheduler.retire(done, now=now)
-                    self.metrics.observe_finished(done)
-                    self._keys.pop(done.req_id, None)
-                    finished.append(done.req_id)
+            with tr.phase("readback"):
+                emitted, n_acc, slot_reqs = dispatched
+                emitted_host = np.asarray(emitted)  # the ONE blocking sync
+                n_acc_host = np.asarray(n_acc)
+                now = time.perf_counter()
+                for slot, req in slot_reqs:
+                    accepted = int(n_acc_host[slot])
+                    n_emit = min(accepted + 1, self.gamma)
+                    toks = [int(t) for t in emitted_host[slot, :n_emit]]
+                    before = req.n_generated
+                    done = self.scheduler.resolve_spec(req, toks, now=now)
+                    self.metrics.observe_verify(
+                        accepted=accepted, emitted=n_emit, gamma=self.gamma
+                    )
+                    if tr.enabled:
+                        tr.request_event(
+                            req.req_id, "verify_round",
+                            accepted=accepted, emitted=n_emit,
+                            n_generated=req.n_generated,
+                        )
+                    new_tokens += req.n_generated - before
+                    if done is not None:
+                        self.scheduler.retire(done, now=now)
+                        self.metrics.observe_finished(done)
+                        self._keys.pop(done.req_id, None)
+                        finished.append(done.req_id)
         self.metrics.observe_step(new_tokens=new_tokens)
+        if tr.enabled:
+            self._end_step_trace(plan)
         return finished
 
     def poll(self, req_id: int) -> RequestStatus:
@@ -777,3 +899,14 @@ class InferenceEngine:
         if self.prefix_cache is not None:
             out.update(self.prefix_cache.stats())
         return out
+
+    def save_trace(self, path: str) -> str:
+        """Write the Perfetto trace to ``path`` (see
+        :meth:`~distributed_pytorch_tpu.obs.Tracer.save`). Raises unless
+        the engine was constructed with a :class:`Tracer`."""
+        if not self.tracer.enabled:
+            raise RuntimeError(
+                "engine has no tracer; construct with "
+                "InferenceEngine(..., tracer=Tracer()) to record"
+            )
+        return self.tracer.save(path)

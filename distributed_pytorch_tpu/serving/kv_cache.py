@@ -88,21 +88,53 @@ class PagedBlockAllocator:
         # the prefix trie can drop the nodes that point at it.
         self.evict_hook: Optional[Callable[[int], None]] = None
         self.evictions = 0
+        # Copy-on-write page splits, counted here (the scheduler decides
+        # them, but the allocator is the page ledger of record) so the
+        # metrics registry reads every page-lifecycle counter off one
+        # object. note_cow() increments it.
+        self.cow_copies = 0
+        # O(1) running state counts, maintained at every page transition
+        # and cross-checked against the full sweep in check_invariants() —
+        # the gauges the engine exports every step without debug=True.
+        self._n_free = num_pages - 1
+        self._n_referenced = 0
+        self._n_idle = 0
+        # Optional tracer (duck-typed; NULL by default) so page evictions
+        # surface as instant events on the engine timeline.
+        from distributed_pytorch_tpu.obs.tracer import NULL_TRACER
+
+        self.tracer = NULL_TRACER
 
     @property
     def num_free(self) -> int:
         """Pages allocatable right now (free list + evictable idle)."""
-        return len(self._free) + len(self._idle)
+        return self._n_free + self._n_idle
 
     @property
     def num_allocated(self) -> int:
         """Pages with at least one reader."""
-        return len(self._ref)
+        return self._n_referenced
 
     @property
     def num_idle(self) -> int:
         """Cached pages with no readers (evictable under pressure)."""
-        return len(self._idle)
+        return self._n_idle
+
+    def counters(self) -> Dict[str, int]:
+        """O(1) gauge/counter snapshot — page-state populations (strict
+        free list vs cached-idle, unlike :attr:`num_free` which pools
+        them), plus the lifetime CoW-split and eviction counters."""
+        return {
+            "pages_free": self._n_free,
+            "pages_referenced": self._n_referenced,
+            "pages_cached_idle": self._n_idle,
+            "cow_copies": self.cow_copies,
+            "page_evictions": self.evictions,
+        }
+
+    def note_cow(self) -> None:
+        """The scheduler split a shared page copy-on-write."""
+        self.cow_copies += 1
 
     @staticmethod
     def pages_needed(n_tokens: int, page_size: int) -> int:
@@ -112,9 +144,12 @@ class PagedBlockAllocator:
         page, _ = self._idle.popitem(last=False)  # oldest first
         self._cached.discard(page)
         self.evictions += 1
+        self._n_idle -= 1
         if self.evict_hook is not None:
             self.evict_hook(page)
+        self.tracer.instant("page_evict", page=page)
         self._free.append(page)
+        self._n_free += 1
 
     def allocate(self, n: int = 1) -> List[int]:
         """Take ``n`` fresh pages (refcount 1 each) or raise
@@ -135,6 +170,8 @@ class PagedBlockAllocator:
                 self._evict_one()
             page = self._free.pop()
             self._ref[page] = 1
+            self._n_free -= 1
+            self._n_referenced += 1
             pages.append(page)
         return pages
 
@@ -146,6 +183,8 @@ class PagedBlockAllocator:
         elif page in self._idle:
             del self._idle[page]
             self._ref[page] = 1
+            self._n_idle -= 1
+            self._n_referenced += 1
         else:
             raise AssertionError(
                 f"ref of page {page} that is neither live nor cached-idle"
@@ -167,10 +206,13 @@ class PagedBlockAllocator:
             self._ref[page] = count - 1
             return
         del self._ref[page]
+        self._n_referenced -= 1
         if page in self._cached:
             self._idle[page] = None  # most-recently-used end
+            self._n_idle += 1
         else:
             self._free.append(page)
+            self._n_free += 1
 
     def free(self, pages: Sequence[int]) -> None:
         """Drop one reader from each page (block-table release)."""
@@ -223,6 +265,19 @@ class PagedBlockAllocator:
         assert total == self.num_pages - 1, (
             f"page leak: {len(free_set)} free + {len(ref_set)} referenced "
             f"+ {len(idle_set)} idle != {self.num_pages - 1} allocatable"
+        )
+        # The O(1) running gauges must agree with the sweep-derived truth —
+        # a drifted counter is as much a bug as a leaked page.
+        assert self._n_free == len(free_set), (
+            f"pages_free gauge drifted: {self._n_free} != {len(free_set)}"
+        )
+        assert self._n_referenced == len(ref_set), (
+            f"pages_referenced gauge drifted: "
+            f"{self._n_referenced} != {len(ref_set)}"
+        )
+        assert self._n_idle == len(idle_set), (
+            f"pages_cached_idle gauge drifted: "
+            f"{self._n_idle} != {len(idle_set)}"
         )
 
 

@@ -68,6 +68,7 @@ import enum
 import time
 from typing import List, Optional, Tuple
 
+from distributed_pytorch_tpu.obs.tracer import NULL_TRACER
 from distributed_pytorch_tpu.serving.kv_cache import (
     BlockTable,
     OutOfPages,
@@ -203,6 +204,7 @@ class Scheduler:
         prefix_cache: Optional[PrefixCache] = None,
         gamma: int = 0,
         debug: bool = False,
+        tracer=NULL_TRACER,
     ):
         if token_budget < 1:
             raise ValueError(f"token_budget must be >= 1, got {token_budget}")
@@ -222,10 +224,16 @@ class Scheduler:
         self.prefix_cache = prefix_cache
         self.gamma = gamma
         self.debug = debug
+        self.tracer = tracer
         self.waiting: List[Request] = []  # kept sorted by req_id
         self.slots: List[Optional[Request]] = [None] * max_slots
         self.preemptions = 0
-        self.cow_copies = 0
+
+    @property
+    def cow_copies(self) -> int:
+        """Lifetime copy-on-write splits (counted on the allocator — the
+        page ledger of record — since the registry reads them there)."""
+        return self.allocator.cow_copies
 
     # ------------------------------------------------------------- queries
 
@@ -267,6 +275,14 @@ class Scheduler:
             else RequestState.PREFILL
         )
         self.slots[slot] = req
+        if self.tracer.enabled:
+            self.tracer.request_event(
+                req.req_id, "admit",
+                slot=slot,
+                cached_tokens=req.len_cached,
+                hit=req.len_cached > 0,
+                readmission=req.preempt_count > 0,
+            )
 
     def _preempt(self, req: Request) -> None:
         """Evict ``req`` back to the waiting queue: page refs dropped
@@ -274,6 +290,12 @@ class Scheduler:
         usually re-matches them), generated tokens KEPT."""
         self.preemptions += 1
         req.preempt_count += 1
+        if self.tracer.enabled:
+            self.tracer.request_event(
+                req.req_id, "preempt",
+                n_generated=req.n_generated,
+                pages_released=len(req.table.pages),
+            )
         req.table.release(self.allocator)
         self.slots[req.slot] = None
         req.slot = None
@@ -308,6 +330,12 @@ class Scheduler:
         req.slot = None
         req.state = RequestState.FINISHED
         req.finish_time = time.perf_counter() if now is None else now
+        if self.tracer.enabled:
+            self.tracer.request_end(
+                req.req_id,
+                n_generated=req.n_generated,
+                preempt_count=req.preempt_count,
+            )
 
     def _reclaim_for(self, req: Request) -> bool:
         """Free pages for ``req`` by preempting ONE strictly lower-priority
@@ -364,7 +392,11 @@ class Scheduler:
             plan.copies.append((req.slot, page, fresh))
             req.table.pages[idx] = fresh
             self.allocator.unref(page)
-            self.cow_copies += 1
+            self.allocator.note_cow()
+            if self.tracer.enabled:
+                self.tracer.request_event(
+                    req.req_id, "cow_copy", src=page, dst=fresh
+                )
             return True
 
     # ------------------------------------------------------------ planning
@@ -491,6 +523,11 @@ class Scheduler:
     def note_prefilled(self, slot: int, chunk: int) -> None:
         req = self.slots[slot]
         assert req is not None, f"prefill completion for empty slot {slot}"
+        if self.tracer.enabled:
+            self.tracer.request_event(
+                req.req_id, "prefill_chunk",
+                chunk=chunk, start=req.len_cached,
+            )
         req.len_cached += chunk
         assert req.len_cached <= len(req.tokens) - 1, (
             f"request {req.req_id} prefilled past its last token"

@@ -111,6 +111,7 @@ class Trainer:
         partition_specs=None,
         keep_checkpoints: int = 0,
         dropout_seed: Optional[int] = None,
+        registry=None,
     ):
         self.model = model
         self.train_data = train_data
@@ -127,6 +128,17 @@ class Trainer:
         # percentiles are where stragglers, recompiles, and host stalls show
         # up — the mean hides them.
         self.step_times = ReservoirHistogram(1024)
+        # Optional unified-observability hookup: expose the step-time
+        # reservoir and global step through a shared MetricsRegistry
+        # alongside the serving/elastic metrics. Pull-based — the registry
+        # reads these attributes at snapshot time, nothing is double-booked.
+        if registry is not None:
+            registry.reservoir(
+                "trainer_step_time_seconds", lambda: self.step_times
+            )
+            registry.counter_fn(
+                "trainer_steps_total", lambda: int(self.state.step)
+            )
         self.log_every = log_every
         self.grad_accum = grad_accum
         # async_save: overlap snapshot disk writes with the next epoch's

@@ -71,6 +71,40 @@ class TestStepProfiler:
         assert not profiler._tracing
         assert any(p.endswith(".xplane.pb") for p in trace_files(logdir))
 
+    def test_annotations_do_not_disturb_window(self, tmp_path):
+        """With per-step StepTraceAnnotation markers on (the default), the
+        wait/warmup/active window transitions exactly as without them, every
+        annotation is closed by stop(), and the trace still lands."""
+        logdir = str(tmp_path / "log")
+        profiler = StepProfiler(
+            logdir, wait=2, warmup=1, active=3, annotate=True
+        )
+        step = jax.jit(lambda x: (x * 2.0).sum())
+        tracing_at, annotated_at = [], []
+        profiler.start()
+        for i in range(10):
+            jax.block_until_ready(step(jnp.arange(8.0) + i))
+            tracing_at.append(profiler._tracing)
+            annotated_at.append(profiler._annotation is not None)
+            profiler.step()
+        profiler.stop()
+        assert tracing_at == [False] * 3 + [True] * 3 + [False] * 4
+        # An annotation is open exactly while the trace is live — never
+        # outside the window, never left dangling after stop().
+        assert annotated_at == tracing_at
+        assert profiler._annotation is None, "annotation leaked past stop()"
+        assert any(p.endswith(".xplane.pb") for p in trace_files(logdir))
+
+    def test_annotations_off_matches_legacy(self, tmp_path):
+        """``annotate=False`` keeps the bare pre-annotation behavior: the
+        identical schedule window and no annotation object ever created."""
+        profiler = StepProfiler(
+            str(tmp_path / "log"), wait=1, warmup=1, active=2, annotate=False
+        )
+        tracing_at = run_steps(profiler, 6)
+        assert tracing_at == [False] * 2 + [True] * 2 + [False] * 2
+        assert profiler._annotation is None
+
     def test_trace_contains_step_ops(self, tmp_path):
         """The captured trace is parseable and non-trivial: it contains
         XLA execution events from the profiled steps."""

@@ -91,6 +91,19 @@ class TestPagedBlockAllocator:
         assert alloc.num_free == 7
 
 
+def assert_gauges_match_sweep(alloc):
+    """The O(1) page-state gauges (what the engine exports every step)
+    must equal an independent full sweep of the allocator's structures."""
+    c = alloc.counters()
+    assert c["pages_free"] == len(alloc._free)
+    assert c["pages_referenced"] == len(alloc._ref)
+    assert c["pages_cached_idle"] == len(alloc._idle)
+    assert (
+        c["pages_free"] + c["pages_referenced"] + c["pages_cached_idle"]
+        == alloc.num_pages - 1
+    )
+
+
 # ---------------------------------------------------------- scheduler props
 
 
@@ -135,6 +148,7 @@ class TestSchedulerInvariants:
             for req in plan:
                 del live[req.req_id]
             alloc.check_invariants()
+            assert_gauges_match_sweep(alloc)
             for req in live.values():
                 # every live table is page-aligned with what's cached
                 assert len(req.table) >= PagedBlockAllocator.pages_needed(
@@ -149,6 +163,7 @@ class TestSchedulerInvariants:
         assert not sched.has_work
         assert not live
         alloc.check_invariants()
+        assert_gauges_match_sweep(alloc)
         assert alloc.num_free == 16  # every allocatable page returned
 
     def test_preemption_only_evicts_lower_priority(self):
@@ -320,6 +335,7 @@ class TestCowAllocatorProperty:
                 next_id += 1
             drive_one()
             alloc.check_invariants()
+            assert_gauges_match_sweep(alloc)
             check_refcounts()
         for _ in range(4000):
             if not sched.has_work:
@@ -327,6 +343,7 @@ class TestCowAllocatorProperty:
             drive_one()
         assert not sched.has_work and not live
         alloc.check_invariants()
+        assert_gauges_match_sweep(alloc)
         check_refcounts()
         assert alloc.num_allocated == 0
         assert alloc.num_free == 20, "pages leaked"

@@ -8,7 +8,13 @@
 # populated) with zero page leaks. A third scenario reruns the first
 # workload under SPECULATIVE decoding (a one-layer draft plus a self-draft
 # pass) and asserts greedy token parity with the plain engine, nonzero
-# acceptance, and zero leaks across both page pools.
+# acceptance, and zero leaks across both page pools. A fourth scenario
+# runs with request tracing + the step timeline ON, asserts the tokens are
+# bitwise-identical to an untraced engine, validates the exported Perfetto
+# trace (well-formed JSON, per-request span count == completed requests,
+# step slices present), and cross-checks the unified metrics registry
+# against engine ground truth; CI uploads traces/serving_trace.json as a
+# build artifact.
 #
 #   bash tools/serving_smoke.sh
 #
@@ -164,5 +170,70 @@ print(
     "[serving_smoke] PASS: speculative scenario, greedy parity across "
     f"drafts, self-draft acceptance={s3['spec_acceptance_rate']:.2f} "
     f"tokens/verify={s3['spec_tokens_per_verify_mean']:.2f}"
+)
+
+# ---- scenario 4: tracing on -> identical tokens + valid Perfetto trace ----
+import json
+
+from distributed_pytorch_tpu.obs import Tracer
+
+prompts4 = [
+    rng.integers(0, 128, int(rng.integers(3, 10))).tolist()
+    for _ in range(6)
+]
+
+def replay4(tracer=None):
+    e = InferenceEngine(
+        model, params, max_slots=4, max_seq_len=32, page_size=4,
+        token_budget=16, max_prefill_chunk=8, tracer=tracer,
+    )
+    rids = [e.submit(p, SamplingParams(max_new_tokens=6)) for p in prompts4]
+    e.run()
+    return [e.poll(r).generated for r in rids], e
+
+untraced_tokens, _ = replay4()
+tracer = Tracer()
+traced_tokens, eng4 = replay4(tracer=tracer)
+assert traced_tokens == untraced_tokens, (
+    "tracing changed the generated tokens"
+)
+
+trace_path = eng4.save_trace("traces/serving_trace.json")
+with open(trace_path) as f:
+    doc = json.load(f)  # must be well-formed JSON
+events = doc["traceEvents"]
+n_done = eng4.metrics.requests_completed
+assert n_done == 6
+begins = [
+    ev for ev in events
+    if ev.get("ph") == "b" and ev.get("cat") == "request"
+]
+ends = [
+    ev for ev in events
+    if ev.get("ph") == "e" and ev.get("cat") == "request"
+]
+assert len(begins) == n_done, (
+    f"trace has {len(begins)} request spans, engine completed {n_done}"
+)
+assert len(ends) == n_done, "unclosed request spans in the trace"
+assert any(
+    ev.get("ph") == "X" and ev.get("name") == "step" for ev in events
+), "no engine step slices in the trace"
+assert any(
+    ev.get("ph") == "X" and ev.get("name") == "schedule" for ev in events
+), "no step phase slices in the trace"
+
+snap = eng4.registry.snapshot()
+assert snap["counters"]["serving_requests_completed_total"] == n_done
+assert snap["counters"]["serving_tokens_generated_total"] == sum(
+    len(t) for t in traced_tokens
+)
+assert snap["gauges"]["serving_pages_referenced"] == 0
+assert "serving_tokens_generated_total" in eng4.registry.prometheus_text()
+
+print(
+    "[serving_smoke] PASS: tracing scenario, tokens identical, "
+    f"{len(begins)} request spans == {n_done} completed, "
+    f"{len(events)} trace events -> {trace_path}"
 )
 EOF

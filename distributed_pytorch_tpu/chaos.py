@@ -37,10 +37,32 @@ import signal
 import socket
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, fields
 from typing import List, Optional
 
 ENV_VAR = "TPURUN_FAULT_PLAN"
+
+# Serving-engine fault kinds, matched against the phase hooks the
+# InferenceEngine step loop calls (see on_serving_phase): a fault fires at
+# the named moment WITHIN a step, not merely at a step boundary, so the
+# drill exercises the state a real fault would interrupt — an unresolved
+# draft+verify round, half-prefilled prompts, a backed-up waiting queue.
+_SERVING_KINDS = (
+    "kill_mid_verify",
+    "reclaim_under_queue_pressure",
+    "drain_mid_prefill",
+)
+
+# Which engine phase each serving kind fires in. "mid_verify" is emitted
+# right after the decode (or speculative draft+verify) dispatch and before
+# its readback — the device holds uncommitted work; "mid_prefill" before
+# the step's first prefill chunk; "step" at step entry (carries queue
+# depth, for pressure-conditioned faults).
+_SERVING_PHASE = {
+    "kill_mid_verify": "mid_verify",
+    "drain_mid_prefill": "mid_prefill",
+    "reclaim_under_queue_pressure": "step",
+}
 
 _KINDS = (
     "kill",
@@ -50,7 +72,18 @@ _KINDS = (
     "drain",
     "corrupt_snapshot",
     "store_partition",
-)
+) + _SERVING_KINDS
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a serving fault with ``mode="raise"`` — the in-process
+    stand-in for SIGKILL in pytest drills (the process "dies" by abandoning
+    the engine object mid-step; recovery must come from a snapshot)."""
+
+    def __init__(self, kind: str, step: int):
+        super().__init__(f"injected serving fault {kind!r} at step {step}")
+        self.kind = kind
+        self.step = step
 
 
 @dataclass
@@ -80,6 +113,24 @@ class Fault:
     ``corrupt_snapshot`` (truncate or bit-flip the just-written checkpoint
     file, per ``mode``), and ``store_partition`` (drop store connections for
     ``duration`` seconds — consumed by :class:`FaultProxy`, not by workers).
+
+    Serving kinds, fired from the inference engine's phase hooks rather than
+    :func:`on_step` (``at_step`` counts engine steps — ``on_serving_phase``
+    calls with phase ``"step"`` — and is a LOWER bound for these kinds: the
+    fault fires at the first matching phase on or after that step, since a
+    step without prefill chunks never reaches ``mid_prefill``):
+    ``kill_mid_verify`` (die after the decode / draft+verify dispatch,
+    before its readback), ``drain_mid_prefill`` (SIGTERM-with-notice lands
+    while prompts are half-prefilled), and ``reclaim_under_queue_pressure``
+    (a reclaim notice while the waiting queue holds at least ``min_queue``
+    requests; ``at_step`` optional — when unset, fires at the first step
+    under enough pressure). Serving faults
+    honor ``mode``: ``"hard"`` delivers the real signal (SIGKILL self for
+    kill, SIGTERM self for the two notice kinds — a
+    :class:`~distributed_pytorch_tpu.serving.elastic.DrainController` with an
+    installed handler turns that into a drain), while ``"raise"`` raises
+    :class:`InjectedFault` so in-process pytest drills can model death by
+    abandoning the engine mid-step.
     """
 
     kind: str
@@ -89,16 +140,30 @@ class Fault:
     at_save: Optional[int] = None
     at_time: Optional[float] = None
     duration: float = 0.0
-    mode: str = "flip"  # corrupt_snapshot: "flip" | "truncate"
+    mode: str = "flip"  # corrupt_snapshot: "flip"|"truncate"; serving: "hard"|"raise"
     exit_code: int = 13
+    min_queue: Optional[int] = None  # reclaim_under_queue_pressure threshold
 
     def __post_init__(self):
         if self.kind == "drain_at_step":
             self.kind = "drain"
         if self.kind not in _KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}; one of {_KINDS}")
-        if self.mode not in ("flip", "truncate"):
+        if self.kind in _SERVING_KINDS:
+            if self.mode == "flip":  # the dataclass default; serving = hard
+                self.mode = "hard"
+            if self.mode not in ("hard", "raise"):
+                raise ValueError(
+                    f"serving fault mode must be 'hard' or 'raise', "
+                    f"got {self.mode!r}"
+                )
+        elif self.mode not in ("flip", "truncate"):
             raise ValueError(f"unknown corrupt mode {self.mode!r}")
+        if self.min_queue is not None and self.kind != "reclaim_under_queue_pressure":
+            raise ValueError(
+                f"min_queue only applies to reclaim_under_queue_pressure, "
+                f"not {self.kind!r}"
+            )
 
 
 def corrupt_file(path: str, mode: str = "flip", seed: int = 0) -> None:
@@ -142,20 +207,51 @@ class FaultPlan:
         self.seed = int(seed)
         self._steps = 0
         self._saves = 0
+        self._serving_steps = 0
         self._fired: set = set()
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------- parsing
     @classmethod
     def from_spec(cls, spec: str) -> "FaultPlan":
-        """Inline JSON (starts with ``{``) or a path to a JSON file."""
+        """Inline JSON (starts with ``{``) or a path to a JSON file.
+
+        Validation names the offending entry: a plan typo'd into an env var
+        must fail loudly at parse time with the entry index and field, not
+        as a drill that silently never fires (or a bare TypeError from the
+        dataclass constructor)."""
         spec = spec.strip()
         if spec.startswith("{"):
             doc = json.loads(spec)
         else:
             with open(spec) as f:
                 doc = json.load(f)
-        faults = [Fault(**entry) for entry in doc.get("faults", [])]
+        entries = doc.get("faults", [])
+        if not isinstance(entries, list):
+            raise ValueError(
+                f"'faults' must be a list, got {type(entries).__name__}"
+            )
+        valid = {f.name for f in fields(Fault)}
+        faults = []
+        for i, entry in enumerate(entries):
+            if not isinstance(entry, dict):
+                raise ValueError(
+                    f"fault entry {i}: expected an object, "
+                    f"got {type(entry).__name__}"
+                )
+            unknown = sorted(set(entry) - valid)
+            if unknown:
+                raise ValueError(
+                    f"fault entry {i}: unknown field(s) "
+                    f"{', '.join(repr(k) for k in unknown)}; valid fields: "
+                    f"{', '.join(sorted(valid))}"
+                )
+            try:
+                faults.append(Fault(**entry))
+            except ValueError as e:
+                raise ValueError(
+                    f"fault entry {i} (kind={entry.get('kind')!r}): {e}"
+                ) from None
         return cls(faults, seed=doc.get("seed", 0))
 
     @classmethod
@@ -229,6 +325,65 @@ class FaultPlan:
                 flush=True,
             )
             corrupt_file(path, mode=fault.mode, seed=self.seed + i)
+
+    def on_serving_phase(self, phase: str, *, queue_depth: int = 0) -> None:
+        """Serving-engine chaos hook. The engine calls this at step entry
+        (``phase="step"``, advancing the serving step counter and carrying
+        the waiting-queue depth), before the step's first prefill chunk
+        (``"mid_prefill"``), and between the decode/verify dispatch and its
+        readback (``"mid_verify"``). Fires any due serving fault; exact
+        no-op for plans without serving kinds."""
+        if phase == "step":
+            with self._lock:
+                self._serving_steps += 1
+        step = self._serving_steps
+        for i, fault in enumerate(self.faults):
+            if fault.kind not in _SERVING_KINDS or i in self._fired:
+                continue
+            if _SERVING_PHASE[fault.kind] != phase:
+                continue
+            # For serving kinds at_step is a LOWER bound, not an exact
+            # match: mid-phase hooks only occur on steps that actually run
+            # that phase (e.g. no prefill chunks -> no mid_prefill call),
+            # so exact matching would let a fault silently never fire.
+            if fault.at_step is not None and step < fault.at_step:
+                continue
+            if fault.kind == "reclaim_under_queue_pressure":
+                need = fault.min_queue if fault.min_queue is not None else 1
+                if queue_depth < need:
+                    continue
+            if not self._identity_matches(fault):
+                continue
+            self._fired.add(i)
+            self._fire_serving(fault)
+
+    def _fire_serving(self, fault: Fault) -> None:
+        step = self._serving_steps
+        if fault.mode == "raise":
+            print(
+                f"[chaos] raising {fault.kind} at serving step {step}",
+                flush=True,
+            )
+            raise InjectedFault(fault.kind, step)
+        if fault.kind == "kill_mid_verify":
+            print(
+                f"[chaos] SIGKILL self mid-verify at serving step {step}",
+                flush=True,
+            )
+            os.kill(os.getpid(), signal.SIGKILL)
+        else:
+            # A reclaim/drain notice: same delivery as the training 'drain'
+            # kind, so one DrainController handler covers both halves.
+            drain_file = os.environ.get("TPURUN_DRAIN_FILE")
+            if drain_file:
+                with open(drain_file, "w") as f:
+                    f.write("chaos\n")
+            print(
+                f"[chaos] {fault.kind}: drain notice (SIGTERM self) "
+                f"at serving step {step}",
+                flush=True,
+            )
+            os.kill(os.getpid(), signal.SIGTERM)
 
     def _fire(self, fault: Fault) -> None:
         if fault.kind == "kill":
@@ -310,6 +465,12 @@ def on_snapshot_write(path: str) -> None:
     plan = get_plan()
     if plan is not None:
         plan.on_snapshot_write(path)
+
+
+def on_serving_phase(phase: str, queue_depth: int = 0) -> None:
+    plan = get_plan()
+    if plan is not None:
+        plan.on_serving_phase(phase, queue_depth=queue_depth)
 
 
 # ------------------------------------------------------------- FaultProxy

@@ -21,9 +21,20 @@ batching reproduces offline ``generate()`` token for token.
 from distributed_pytorch_tpu.serving.admission import (
     AdmissionController,
     AdmissionError,
+    EngineDraining,
     QueueFull,
     RequestTooLong,
     ServingMetrics,
+)
+from distributed_pytorch_tpu.serving.elastic import (
+    DrainController,
+    EngineSnapshot,
+    RequestSnapshot,
+    adopt_snapshot,
+    drain_engine,
+    publish_snapshot,
+    restore_engine,
+    snapshot_engine,
 )
 from distributed_pytorch_tpu.serving.engine import InferenceEngine
 from distributed_pytorch_tpu.serving.kv_cache import (
@@ -46,6 +57,9 @@ __all__ = [
     "AdmissionController",
     "AdmissionError",
     "BlockTable",
+    "DrainController",
+    "EngineDraining",
+    "EngineSnapshot",
     "InferenceEngine",
     "OutOfPages",
     "PENDING_TOKEN",
@@ -54,10 +68,16 @@ __all__ = [
     "PrefixCache",
     "QueueFull",
     "Request",
+    "RequestSnapshot",
     "RequestState",
     "RequestTooLong",
     "SamplingParams",
     "Scheduler",
     "ServingMetrics",
     "StepPlan",
+    "adopt_snapshot",
+    "drain_engine",
+    "publish_snapshot",
+    "restore_engine",
+    "snapshot_engine",
 ]

@@ -43,6 +43,14 @@ class RequestTooLong(AdmissionError):
     """prompt + max_new_tokens can never fit the per-sequence block table."""
 
 
+class EngineDraining(AdmissionError):
+    """The engine is draining (or closed) — no new work is accepted.
+
+    Distinct from :class:`QueueFull` on purpose: a full queue means "retry
+    here, later"; a draining engine means "retry ELSEWHERE, now" (the
+    load balancer should route to a live replica)."""
+
+
 class AdmissionController:
     """Bounded-queue gate in front of the scheduler."""
 
@@ -61,7 +69,17 @@ class AdmissionController:
         self.accepted = 0
         self.rejected_queue_full = 0
         self.rejected_too_long = 0
+        self.rejected_draining = 0
         self.cached_tokens_admitted = 0
+        self.draining = False
+
+    def close(self) -> None:
+        """Stop admitting — first act of the drain protocol (and of engine
+        close). Idempotent."""
+        self.draining = True
+
+    def reopen(self) -> None:
+        self.draining = False
 
     def check(
         self,
@@ -77,6 +95,11 @@ class AdmissionController:
         prefix-cache match for this prompt at submit time;
         ``queued_uncached_tokens`` the uncached prefill work already
         waiting — both feed the optional queue-token budget."""
+        if self.draining:
+            self.rejected_draining += 1
+            raise EngineDraining(
+                "engine is draining; no new requests accepted"
+            )
         if prompt_len < 1:
             self.rejected_too_long += 1
             raise RequestTooLong(
@@ -114,6 +137,7 @@ class AdmissionController:
             "accepted": self.accepted,
             "rejected_queue_full": self.rejected_queue_full,
             "rejected_too_long": self.rejected_too_long,
+            "rejected_draining": self.rejected_draining,
             "cached_tokens_admitted": self.cached_tokens_admitted,
         }
 
@@ -128,6 +152,10 @@ class AdmissionController:
         registry.counter_fn(
             "admission_rejected_too_long_total",
             lambda: self.rejected_too_long,
+        )
+        registry.counter_fn(
+            "admission_rejected_draining_total",
+            lambda: self.rejected_draining,
         )
         registry.counter_fn(
             "cached_tokens_admitted_total",

@@ -78,6 +78,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_pytorch_tpu import chaos
 from distributed_pytorch_tpu.generation import (
     decode_chunk_step,
     decode_token_step,
@@ -165,6 +166,7 @@ class InferenceEngine:
         gamma: int = 4,
         debug: bool = False,
         tracer: Optional[Tracer] = None,
+        trace_path: Optional[str] = None,
     ):
         if max_seq_len % page_size:
             raise ValueError(
@@ -253,6 +255,14 @@ class InferenceEngine:
             max_queue_tokens=max_queue_tokens,
         )
         self.metrics = ServingMetrics(speculative=self.speculative)
+        # Elastic lifecycle counters (serving/elastic.py increments the
+        # first three; close() flips _closed). Surfaced via the registry so
+        # a drill can cross-check them against ground truth.
+        self.drains = 0
+        self.restores = 0
+        self.requests_recovered = 0
+        self.trace_path = trace_path
+        self._closed = False
         self.registry = self._build_registry()
         self.requests: Dict[int, Request] = {}
         self._next_id = 0
@@ -292,6 +302,17 @@ class InferenceEngine:
         self.admission.register_into(reg)
         reg.counter_fn(
             "preemptions_total", lambda: self.scheduler.preemptions
+        )
+        reg.counter_fn("drains_total", lambda: self.drains)
+        reg.counter_fn("restores_total", lambda: self.restores)
+        reg.counter_fn(
+            "requests_recovered_total", lambda: self.requests_recovered
+        )
+        reg.counter_fn(
+            "requests_expired_total", lambda: self.scheduler.expired
+        )
+        reg.counter_fn(
+            "requests_cancelled_total", lambda: self.scheduler.cancelled
         )
         reg.counter_fn(
             "cow_copies_total", lambda: self.allocator.cow_copies
@@ -549,13 +570,17 @@ class InferenceEngine:
         self,
         prompt: Sequence[int],
         params: Optional[SamplingParams] = None,
+        metadata: Optional[dict] = None,
     ) -> int:
         """Queue one request; returns its id. Raises
-        :class:`~.admission.QueueFull` (backpressure) or
-        :class:`~.admission.RequestTooLong` (can never fit) — admission is
-        decided NOW, not at first schedule, and counts the currently-cached
-        prefix: a shared-prompt request costs only its uncached tail of
-        prefill work against the queue-token budget."""
+        :class:`~.admission.QueueFull` (backpressure),
+        :class:`~.admission.RequestTooLong` (can never fit), or
+        :class:`~.admission.EngineDraining` (drain/close in progress) —
+        admission is decided NOW, not at first schedule, and counts the
+        currently-cached prefix: a shared-prompt request costs only its
+        uncached tail of prefill work against the queue-token budget.
+        ``metadata`` is a tenant-opaque JSON-serializable dict carried
+        through scheduling (and the elastic snapshot) untouched."""
         params = params or SamplingParams()
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         cached = 0
@@ -574,6 +599,7 @@ class InferenceEngine:
             params=params,
             submit_time=time.perf_counter(),
             est_uncached=max(0, len(prompt) - 1 - cached),
+            metadata=metadata,
         )
         self._next_id += 1
         self.requests[req.req_id] = req
@@ -639,6 +665,9 @@ class InferenceEngine:
         during it (under overlap, a finish surfaces on the step after its
         token was dispatched). A no-op (empty list) when nothing is queued,
         running, or in flight."""
+        chaos.on_serving_phase(
+            "step", queue_depth=self.scheduler.num_waiting
+        )
         tr = self.tracer
         tr.begin_step()
         with tr.phase("schedule"):
@@ -672,6 +701,7 @@ class InferenceEngine:
             return self._step_spec(plan)
 
         if plan.prefill:
+            chaos.on_serving_phase("mid_prefill")
             with tr.phase("prefill"):
                 for slot, chunk in plan.prefill:
                     req = self.scheduler.slots[slot]
@@ -737,6 +767,10 @@ class InferenceEngine:
                         for s in plan.decode_slots
                     ],
                 )
+        if dispatched is not None:
+            # The dispatched decode is in flight, its readback not taken:
+            # the window a kill_mid_verify drill targets.
+            chaos.on_serving_phase("mid_verify")
         # Resolve LAST step's tokens now — the np.asarray sync overlaps
         # with the decode dispatched above.
         if self._inflight is not None:
@@ -801,8 +835,13 @@ class InferenceEngine:
                         for s in plan.decode_slots
                     ],
                 )
+        if dispatched is not None:
+            # Draft+verify round in flight, per-row acceptance unknown to
+            # the host — the state a kill_mid_verify drill interrupts.
+            chaos.on_serving_phase("mid_verify")
 
         if plan.prefill:
+            chaos.on_serving_phase("mid_prefill")
             with tr.phase("prefill"):
                 for slot, chunk in plan.prefill:
                     req = self.scheduler.slots[slot]
@@ -868,6 +907,69 @@ class InferenceEngine:
             preempt_count=req.preempt_count,
         )
 
+    def cancel(self, req_id: int) -> bool:
+        """Client-side cancellation: retire ``req_id`` mid-flight with the
+        CANCELLED terminal state and free its pages immediately. Partial
+        output stays pollable. Returns False when the request is unknown
+        or already terminal."""
+        req = self.requests.get(req_id)
+        if req is None:
+            return False
+        return self.scheduler.cancel(req)
+
+    # ------------------------------------------------------- elastic hooks
+
+    def stop_admission(self) -> None:
+        """First act of the drain protocol: submit() rejects with
+        :class:`~.admission.EngineDraining` from now on. Idempotent."""
+        self.admission.close()
+
+    def resume_admission(self) -> None:
+        self.admission.reopen()
+
+    def finish_inflight(self) -> List[int]:
+        """Resolve the outstanding overlapped decode dispatch, if any (the
+        one blocking readback), retiring whatever it finished. After this
+        no request holds a PENDING placeholder — the quiescent point the
+        snapshot codec and close() both need. Returns finished ids."""
+        if self._inflight is None:
+            return []
+        return self._resolve_inflight()
+
+    def drain(self):
+        """Stop admission, finish the in-flight step, and return an
+        :class:`~distributed_pytorch_tpu.serving.elastic.EngineSnapshot`
+        of every still-live request — the SIGTERM-with-notice protocol.
+        Convenience delegate; see ``serving/elastic.py`` for the pieces."""
+        from distributed_pytorch_tpu.serving.elastic import drain_engine
+
+        return drain_engine(self)
+
+    def close(self) -> None:
+        """Deterministic teardown: resolve the in-flight overlapped
+        dispatch (no dangling device readback), stop admission, cancel
+        every non-terminal request (pages back to the allocator), assert
+        via the allocator gauges that zero pages leaked, and flush the
+        tracer to ``trace_path`` when one was configured. Idempotent; runs
+        automatically on ``with InferenceEngine(...) as eng:`` exit."""
+        if self._closed:
+            return
+        self.finish_inflight()
+        self.stop_admission()
+        for req in list(self.scheduler.waiting) + self.scheduler.running:
+            self.scheduler.cancel(req)
+        self._closed = True
+        self.allocator.assert_quiescent()
+        if self.tracer.enabled and self.trace_path:
+            self.tracer.save(self.trace_path)
+
+    def __enter__(self) -> "InferenceEngine":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
     def run(self, max_steps: int = 10_000) -> List[int]:
         """Drive :meth:`step` until the engine drains; returns every
         request id finished along the way. ``max_steps`` bounds a scheduling
@@ -891,6 +993,11 @@ class InferenceEngine:
         out = self.metrics.snapshot()
         out.update(self.admission.counters())
         out["preemptions"] = self.scheduler.preemptions
+        out["expired"] = self.scheduler.expired
+        out["cancelled"] = self.scheduler.cancelled
+        out["drains"] = self.drains
+        out["restores"] = self.restores
+        out["requests_recovered"] = self.requests_recovered
         out["cow_copies"] = self.scheduler.cow_copies
         out["pages_free"] = self.allocator.num_free
         out["pages_allocated"] = self.allocator.num_allocated

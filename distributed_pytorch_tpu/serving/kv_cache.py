@@ -40,6 +40,7 @@ prefilled once. Invariants that keep sharing copy-free and leak-proof:
 
 from __future__ import annotations
 
+import hashlib
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -280,6 +281,18 @@ class PagedBlockAllocator:
             f"{self._n_idle} != {len(idle_set)}"
         )
 
+    def assert_quiescent(self) -> None:
+        """Teardown gate (engine close / post-drain): no page may still be
+        referenced. Cached-idle pages are fine — they are reclaimable and
+        die with the device arrays — but a nonzero referenced gauge here is
+        a leaked block table, the exact silent loss close() exists to
+        catch."""
+        assert self._n_referenced == 0, (
+            f"teardown leaked {self._n_referenced} referenced page(s): "
+            f"{sorted(self._ref)}"
+        )
+        self.check_invariants()
+
 
 class BlockTable:
     """One sequence's logical-page -> physical-page map."""
@@ -465,6 +478,33 @@ class PrefixCache:
             pages.append(best_page)
             matched += best_len
         return pages, matched, node
+
+    def key_chain(self, tokens: Sequence[int]) -> List[str]:
+        """Content-addressed keys for the page-aligned trie chain covering
+        ``tokens``' currently cached prefix — the KV metadata the elastic
+        snapshot records per request. Key ``i`` digests the first
+        ``(i+1) * page_size`` tokens (hash-chained, so each key commits to
+        the whole prefix, not just its own page): identical token prefixes
+        produce identical chains on ANY engine, letting a restore target
+        predict which pages its own trie will re-serve without shipping
+        device K/V. Takes no refs and does not touch the LRU."""
+        keys: List[str] = []
+        node = self.ROOT
+        matched = 0
+        prev = "root"
+        page_size = self.page_size
+        while matched + page_size <= len(tokens):
+            chunk = tuple(tokens[matched : matched + page_size])
+            entry = self._full.get((node, chunk))
+            if entry is None:
+                break
+            prev = hashlib.sha256(
+                (prev + "|" + ",".join(map(str, chunk))).encode()
+            ).hexdigest()[:16]
+            keys.append(prev)
+            node = entry[0]
+            matched += page_size
+        return keys
 
     def peek(self, tokens: Sequence[int]) -> int:
         """How many leading tokens of ``tokens`` (capped at ``len - 1``)

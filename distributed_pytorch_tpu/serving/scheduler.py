@@ -88,12 +88,16 @@ class SamplingParams:
     ``seed`` drives a per-request RNG folded with the token index, so a
     request's sampled stream is independent of batch composition and
     survives preemption. ``top_k``/``top_p`` are engine-level (static in the
-    compiled step), not per-request."""
+    compiled step), not per-request. ``deadline_s`` is a wall-clock budget
+    from submission: a request still unfinished after that many seconds is
+    retired with the EXPIRED terminal state at the next schedule pass and
+    its pages freed (partial output stays pollable)."""
 
     max_new_tokens: int = 16
     temperature: float = 0.0
     seed: int = 0
     stop_token: Optional[int] = None
+    deadline_s: Optional[float] = None
 
 
 class RequestState(enum.Enum):
@@ -101,6 +105,19 @@ class RequestState(enum.Enum):
     PREFILL = "prefill"
     DECODE = "decode"
     FINISHED = "finished"
+    # Terminal without completing: deadline elapsed / cancelled (client
+    # cancel, engine close). Partial output remains pollable; pages freed.
+    EXPIRED = "expired"
+    CANCELLED = "cancelled"
+
+
+# States from which a request never runs again. A late decode readback for a
+# terminal request resolves harmlessly (resolve_decoded discards the value).
+_TERMINAL = (
+    RequestState.FINISHED,
+    RequestState.EXPIRED,
+    RequestState.CANCELLED,
+)
 
 
 @dataclasses.dataclass
@@ -136,6 +153,10 @@ class Request:
     cached_prompt_tokens: Optional[int] = None
     # Admission-time estimate of uncached prefill work (queue backpressure).
     est_uncached: int = 0
+    # Tenant-opaque payload carried through scheduling untouched — and
+    # through the elastic snapshot/restore codec, so routing/billing context
+    # survives an engine migration. Must be JSON-serializable to snapshot.
+    metadata: Optional[dict] = None
 
     def __post_init__(self):
         if not self.tokens:
@@ -157,7 +178,7 @@ class Request:
 
     @property
     def done(self) -> bool:
-        return self.state is RequestState.FINISHED
+        return self.state in _TERMINAL
 
 
 @dataclasses.dataclass
@@ -228,6 +249,11 @@ class Scheduler:
         self.waiting: List[Request] = []  # kept sorted by req_id
         self.slots: List[Optional[Request]] = [None] * max_slots
         self.preemptions = 0
+        self.expired = 0
+        self.cancelled = 0
+        # Deadline sweeps cost a clock read + O(live) scan per schedule;
+        # skip them entirely until a deadline-bearing request shows up.
+        self._any_deadlines = False
 
     @property
     def cow_copies(self) -> int:
@@ -253,6 +279,8 @@ class Scheduler:
 
     def add(self, req: Request) -> None:
         bisect.insort(self.waiting, req, key=lambda r: r.req_id)
+        if req.params.deadline_s is not None:
+            self._any_deadlines = True
 
     def _admit(self, req: Request, slot: int) -> None:
         req.slot = slot
@@ -337,6 +365,60 @@ class Scheduler:
                 preempt_count=req.preempt_count,
             )
 
+    def cancel(
+        self,
+        req: Request,
+        state: RequestState = RequestState.CANCELLED,
+        now: Optional[float] = None,
+    ) -> bool:
+        """Terminal retirement WITHOUT completion — the one primitive that
+        deadline expiry, client cancellation, and engine close all share
+        (and that restore relies on to shed rows it cannot re-host). Frees
+        the request's pages immediately (trie-registered pages demote to
+        cached-idle, private ones free), vacates its slot or removes it
+        from the waiting queue, and marks the terminal state; generated
+        tokens stay pollable. ``pending_idx`` is deliberately KEPT: a
+        decode readback still in flight for this row resolves through
+        :meth:`resolve_decoded`'s discard branch. Returns False when the
+        request was already terminal."""
+        assert state in (RequestState.CANCELLED, RequestState.EXPIRED)
+        if req.done:
+            return False
+        if req.slot is not None:
+            req.table.release(self.allocator)
+            self.slots[req.slot] = None
+            req.slot = None
+        elif req.state is RequestState.WAITING:
+            self.waiting.remove(req)
+            req.table.release(self.allocator)  # empty by invariant
+        req.state = state
+        req.finish_time = time.perf_counter() if now is None else now
+        if state is RequestState.EXPIRED:
+            self.expired += 1
+        else:
+            self.cancelled += 1
+        if self.tracer.enabled:
+            self.tracer.request_end(
+                req.req_id,
+                terminal=state.value,
+                n_generated=req.n_generated,
+            )
+        return True
+
+    def expire_deadlines(self, now: Optional[float] = None) -> List[Request]:
+        """Retire every live request whose ``deadline_s`` has elapsed since
+        submission. Runs at the top of :meth:`schedule` (gated on any
+        deadline-bearing request existing), so an expired row's pages are
+        back in the pool before this step's planning needs them."""
+        now = time.perf_counter() if now is None else now
+        out: List[Request] = []
+        for req in list(self.waiting) + self.running:
+            dl = req.params.deadline_s
+            if dl is not None and now - req.submit_time >= dl:
+                if self.cancel(req, RequestState.EXPIRED, now=now):
+                    out.append(req)
+        return out
+
     def _reclaim_for(self, req: Request) -> bool:
         """Free pages for ``req`` by preempting ONE strictly lower-priority
         victim. Returns False — after preempting ``req`` itself — when no
@@ -408,6 +490,10 @@ class Scheduler:
         :meth:`note_prefilled` / :meth:`note_decode_dispatched` /
         :meth:`resolve_decoded`."""
         plan = StepPlan()
+
+        # 0. Deadline sweep — free expired rows' pages before planning.
+        if self._any_deadlines:
+            self.expire_deadlines()
 
         # 1. Admit waiting requests into free slots, oldest first. Pages
         # beyond the prefix-cache match are allocated lazily below, so

@@ -22,7 +22,12 @@ import numpy as np
 import pytest
 
 from distributed_pytorch_tpu import chaos
-from distributed_pytorch_tpu.chaos import Fault, FaultPlan, FaultProxy
+from distributed_pytorch_tpu.chaos import (
+    Fault,
+    FaultPlan,
+    FaultProxy,
+    InjectedFault,
+)
 
 pytestmark = pytest.mark.chaos
 
@@ -71,6 +76,69 @@ class TestFaultPlan:
     def test_unknown_kind_rejected(self):
         with pytest.raises(ValueError, match="unknown fault kind"):
             Fault(kind="meteor")
+
+    def test_from_spec_names_entry_and_field(self):
+        """Bad plans fail loudly with the entry index and offending field —
+        a chaos plan that silently drops a fault 'passes' every drill."""
+        with pytest.raises(ValueError, match="'faults' must be a list"):
+            FaultPlan.from_spec(json.dumps({"faults": {"kind": "kill"}}))
+        with pytest.raises(
+            ValueError, match=r"fault entry 1: expected an object, got str"
+        ):
+            FaultPlan.from_spec(
+                json.dumps({"faults": [{"kind": "kill"}, "kill"]})
+            )
+        with pytest.raises(
+            ValueError, match=r"fault entry 0: unknown field\(s\) 'at_stpe'"
+        ) as ei:
+            FaultPlan.from_spec(
+                json.dumps({"faults": [{"kind": "kill", "at_stpe": 3}]})
+            )
+        assert "valid fields:" in str(ei.value)  # lists the accepted names
+        with pytest.raises(
+            ValueError, match=r"fault entry 1 \(kind='meteor'\)"
+        ):
+            FaultPlan.from_spec(
+                json.dumps({"faults": [{"kind": "kill"}, {"kind": "meteor"}]})
+            )
+
+    def test_serving_kind_mode_and_min_queue_validation(self):
+        # Serving kinds default to "hard" (real signals) and accept only
+        # hard/raise — "flip" etc. are bitflip modes, not fault delivery.
+        assert Fault(kind="kill_mid_verify").mode == "hard"
+        with pytest.raises(ValueError, match="mode"):
+            Fault(kind="drain_mid_prefill", mode="truncate")
+        with pytest.raises(ValueError, match="min_queue"):
+            Fault(kind="kill_mid_verify", min_queue=2)
+
+    def test_serving_at_step_is_lower_bound(self):
+        """Mid-phase hooks only occur on steps that run that phase, so
+        at_step matches the FIRST occurrence at-or-after it — exact
+        matching would let a fault silently never fire."""
+        plan = FaultPlan(
+            [Fault(kind="drain_mid_prefill", at_step=3, mode="raise")]
+        )
+        for _ in range(4):  # steps 1-4: no prefill phase at exactly 3
+            plan.on_serving_phase("step")
+        plan.on_serving_phase("mid_verify")  # wrong phase: never matches
+        with pytest.raises(InjectedFault) as ei:
+            plan.on_serving_phase("mid_prefill")  # first chance, step 4 > 3
+        assert ei.value.kind == "drain_mid_prefill" and ei.value.step == 4
+        plan.on_serving_phase("mid_prefill")  # fire-once
+
+    def test_reclaim_waits_for_queue_pressure(self):
+        plan = FaultPlan(
+            [
+                Fault(
+                    kind="reclaim_under_queue_pressure",
+                    min_queue=2,
+                    mode="raise",
+                )
+            ]
+        )
+        plan.on_serving_phase("step", queue_depth=1)  # below threshold
+        with pytest.raises(InjectedFault):
+            plan.on_serving_phase("step", queue_depth=2)
 
     def test_kill_fires_at_exact_step_in_matching_process_only(self, tmp_path):
         script = textwrap.dedent(

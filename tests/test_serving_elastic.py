@@ -1,0 +1,525 @@
+"""Elastic serving tests: drain/snapshot/restore for the inference engine.
+
+The headline drill: arm one of the serving fault kinds, kill or drain the
+engine mid-step, restore the snapshot into a FRESH engine, and assert every
+request admitted before the fault finishes with greedy output token-identical
+to an uninterrupted run — across every prefix_cache x overlap x speculative
+combination. Restore is re-admission (prompt + generated tokens re-prefilled
+through the prefix cache), so parity here exercises the whole determinism
+story: fold-index sampling, pending-token rollback, CoW page sharing.
+
+Also covers the satellites: snapshot-codec round-trips over randomized
+mid-flight states, per-request deadlines (including rebasing across
+restore), and ``close()`` / context-manager teardown with leak detection.
+All on CPU (conftest pins JAX_PLATFORMS=cpu).
+"""
+
+import dataclasses
+import itertools
+import json
+import os
+import random
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_pytorch_tpu import chaos
+from distributed_pytorch_tpu.models.transformer import TransformerLM
+from distributed_pytorch_tpu.obs import Tracer
+from distributed_pytorch_tpu.serving import (
+    DrainController,
+    EngineDraining,
+    EngineSnapshot,
+    InferenceEngine,
+    SamplingParams,
+    drain_engine,
+    restore_engine,
+    snapshot_engine,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _fresh_chaos_plan():
+    """Arming tests set the env var themselves; reset the cached plan on
+    both sides so no plan leaks across tests (or from the environment)."""
+    chaos._reset()
+    yield
+    os.environ.pop(chaos.ENV_VAR, None)
+    chaos._reset()
+
+
+def tiny_lm(n_layers=2, **kw):
+    return TransformerLM(
+        vocab_size=48, d_model=16, n_layers=n_layers, n_heads=2, d_ff=32,
+        dtype=jnp.float32, **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def target_and_params():
+    # One layer (not the two the parity modules use): this module builds
+    # ~80 engines and each one re-jits its programs, so compile time — not
+    # step count — dominates its wall clock.
+    model = tiny_lm(n_layers=1)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def draft_and_params():
+    model = tiny_lm(n_layers=1)
+    params = model.init(
+        jax.random.PRNGKey(7), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+# Five prompts on two slots: real queue pressure, and the first two share a
+# page-aligned prefix so snapshots cover CoW/prefix-cache-shared pages.
+PROMPTS = [
+    [5, 7, 11, 2, 9, 3],
+    [5, 7, 11, 2, 1],
+    [2, 2, 3, 17, 40],
+    [6, 1, 9, 9],
+]
+MAX_NEW = 6
+ENGINE_KW = dict(
+    max_slots=2, max_seq_len=32, page_size=4, token_budget=16,
+    max_prefill_chunk=8, debug=True,
+)
+
+
+def make_engine(model, params, *, draft=None, **kw):
+    opts = dict(ENGINE_KW)
+    opts.update(kw)
+    if draft is not None:
+        dmodel, dparams = draft
+        opts.update(draft_model=dmodel, draft_params=dparams)
+    return InferenceEngine(model, params, **opts)
+
+
+def submit_all(eng, prompts=PROMPTS, **params_kw):
+    return [
+        eng.submit(p, SamplingParams(max_new_tokens=MAX_NEW, **params_kw))
+        for p in prompts
+    ]
+
+
+def counters(eng):
+    return eng.registry.snapshot()["counters"]
+
+
+@pytest.fixture(scope="module")
+def ref_outputs(target_and_params):
+    """Greedy outputs from one uninterrupted run. Output streams are
+    batch-, slot-, and toggle-invariant (the repo's parity tests prove it),
+    so a single reference serves every drill combination."""
+    model, params = target_and_params
+    eng = make_engine(model, params)
+    ids = submit_all(eng)
+    eng.run()
+    return {i: eng.poll(i).generated for i in ids}
+
+
+def arm(plan):
+    os.environ[chaos.ENV_VAR] = json.dumps(plan)
+    chaos._reset()
+
+
+def disarm():
+    os.environ.pop(chaos.ENV_VAR, None)
+    chaos._reset()
+
+
+# -------------------------------------------------------------- chaos drill
+
+
+FAULT_SPECS = {
+    # No notice: recovery point is the rolling snapshot. mode="raise" keeps
+    # the kill in-process (the hard mode — real SIGKILL — is exercised by
+    # tools/chaos_smoke.sh serving).
+    "kill_mid_verify": {"kind": "kill_mid_verify", "at_step": 4,
+                        "mode": "raise"},
+    # Notice kinds: hard mode sends a real SIGTERM to this process; the
+    # DrainController handler turns it into a clean between-steps drain.
+    "drain_mid_prefill": {"kind": "drain_mid_prefill", "at_step": 2},
+    "reclaim_under_queue_pressure": {
+        "kind": "reclaim_under_queue_pressure", "min_queue": 2,
+    },
+}
+
+COMBOS = list(itertools.product([True, False], repeat=3))
+
+
+class TestChaosDrill:
+    """The acceptance invariant: fault mid-step, restore on a fresh engine,
+    every admitted request token-identical to the uninterrupted run."""
+
+    @pytest.mark.parametrize(
+        "prefix_cache,overlap,speculative", COMBOS,
+        ids=[f"pc{int(a)}-ov{int(b)}-sp{int(c)}" for a, b, c in COMBOS],
+    )
+    @pytest.mark.parametrize("kind", sorted(FAULT_SPECS))
+    def test_fault_then_restore_token_parity(
+        self, tmp_path, target_and_params, draft_and_params, ref_outputs,
+        kind, prefix_cache, overlap, speculative,
+    ):
+        model, params = target_and_params
+        draft = draft_and_params if speculative else None
+        snap_path = str(tmp_path / "snap.json")
+
+        arm({"faults": [FAULT_SPECS[kind]]})
+        eng = make_engine(
+            model, params, draft=draft, prefix_cache=prefix_cache,
+            overlap=overlap,
+        )
+        ids = submit_all(eng)
+        faulted = False
+        try:
+            with DrainController(
+                eng, snapshot_path=snap_path, install_signal=True
+            ) as ctl:
+                ctl.drive(snapshot_every=2)
+        except chaos.InjectedFault as e:
+            assert e.kind == kind
+            faulted = True
+        disarm()
+
+        if kind == "kill_mid_verify":
+            # Engine died with no notice: recover from the last rolling
+            # snapshot (strictly older than the fault).
+            assert faulted, "kill_mid_verify never fired"
+            snap = EngineSnapshot.load(snap_path)
+        else:
+            # Notice kinds drain cleanly: no exception, snapshot written,
+            # admission closed, drain counted.
+            assert not faulted and ctl.drained
+            snap = ctl.snapshot
+            assert counters(eng)["serving_drains_total"] == 1
+            with pytest.raises(EngineDraining):
+                eng.submit([1, 2], SamplingParams(max_new_tokens=2))
+
+        assert snap.requests, "drill degenerate: nothing left to recover"
+        assert snap == EngineSnapshot.load(snap_path)
+
+        fresh = make_engine(
+            model, params, draft=draft, prefix_cache=prefix_cache,
+            overlap=overlap,
+        )
+        restored = restore_engine(fresh, snap)
+        fresh.run()
+        c = counters(fresh)
+        assert c["serving_restores_total"] == 1
+        assert c["serving_requests_recovered_total"] == len(restored)
+
+        # Union parity: ids still live at the snapshot finish on the fresh
+        # engine; ids that finished before it are polled where they died.
+        for i in ids:
+            src = fresh if i in restored else eng
+            st = src.poll(i)
+            assert st.state == "finished", (kind, i, st.state)
+            assert st.generated == ref_outputs[i], (
+                kind, prefix_cache, overlap, speculative, i,
+            )
+        assert fresh.allocator.num_allocated == 0
+        fresh.allocator.check_invariants()
+
+
+# ------------------------------------------------------- drain + codec
+
+
+class TestDrainAndCodec:
+    def test_clean_drain_restore_parity(self, target_and_params, ref_outputs):
+        model, params = target_and_params
+        eng = make_engine(model, params)
+        ids = submit_all(eng)
+        for _ in range(3):
+            eng.step()
+
+        snap = drain_engine(eng)
+        # The codec round-trips exactly (frozen dataclasses + JSON).
+        assert EngineSnapshot.from_json(snap.to_json()) == snap
+        assert snap.version == 1 and snap.next_id == len(ids)
+        assert counters(eng)["serving_drains_total"] == 1
+        with pytest.raises(EngineDraining):
+            eng.submit([3], SamplingParams(max_new_tokens=1))
+        assert counters(eng)["serving_admission_rejected_draining_total"] == 1
+
+        # KV metadata: committed counts bounded by the trimmed token count,
+        # trie keys bounded by the full pages of the prefix (the trie only
+        # holds pages the cache has registered so far).
+        for rec in snap.requests:
+            tokens = len(rec.prompt) + len(rec.generated)
+            assert 0 <= rec.kv_committed <= tokens
+            assert len(rec.trie_keys) <= tokens // ENGINE_KW["page_size"]
+
+        fresh = make_engine(model, params)
+        restored = restore_engine(fresh, snap)
+        fresh.run()
+        for i in ids:
+            src = fresh if i in restored else eng
+            assert src.poll(i).generated == ref_outputs[i]
+        # next_id carried over: new requests never outrank recovered ones.
+        assert fresh.submit([1, 2], SamplingParams(max_new_tokens=1)) >= len(
+            ids
+        )
+
+    def test_drain_idle_engine_is_empty_snapshot(self, target_and_params):
+        model, params = target_and_params
+        eng = make_engine(model, params)
+        snap = drain_engine(eng)
+        assert snap.requests == ()
+        assert eng.drains == 1
+
+    def test_restore_refuses_fingerprint_mismatch(self, target_and_params):
+        model, params = target_and_params
+        eng = make_engine(model, params)
+        submit_all(eng, prompts=PROMPTS[:1])
+        snap = drain_engine(eng)
+        fresh = make_engine(model, params)
+        bad = dataclasses.replace(snap, top_k=7)
+        with pytest.raises(ValueError, match="top_k"):
+            restore_engine(fresh, bad)
+        with pytest.raises(ValueError, match="version"):
+            EngineSnapshot.from_json(
+                snap.to_json().replace('"version":1', '"version":99')
+            )
+
+    def test_restore_refuses_duplicate_ids(self, target_and_params):
+        model, params = target_and_params
+        eng = make_engine(model, params)
+        submit_all(eng, prompts=PROMPTS[:2])
+        snap = drain_engine(eng)
+        fresh = make_engine(model, params)
+        restore_engine(fresh, snap)
+        with pytest.raises(ValueError, match="already"):
+            restore_engine(fresh, snap)
+
+    def test_restore_emits_tracer_events(self, target_and_params):
+        model, params = target_and_params
+        eng = make_engine(model, params)
+        submit_all(eng, prompts=PROMPTS[:2])
+        snap = drain_engine(eng)
+        tr = Tracer()
+        fresh = make_engine(model, params, tracer=tr)
+        restored = restore_engine(fresh, snap)
+        names = [e.get("name") for e in tr.events]
+        assert "restore" in names
+        assert tr.spans_opened == len(restored)
+
+
+# ----------------------------------------------- property: random states
+
+
+class TestSnapshotRoundTripProperty:
+    """Randomized engine states — mid-prefill chunks, live overlapped
+    dispatches (pending rollback), speculative rows, CoW-shared prefix
+    pages — must codec-round-trip and restore to token parity, leaking
+    nothing on the drained side."""
+
+    @pytest.mark.parametrize("trial", range(3))
+    def test_round_trip(
+        self, trial, target_and_params, draft_and_params,
+    ):
+        rng = random.Random(1000 + trial)
+        model, params = target_and_params
+        speculative = rng.random() < 0.5
+        kw = dict(
+            prefix_cache=rng.random() < 0.7,
+            overlap=rng.random() < 0.7,
+            draft=draft_and_params if speculative else None,
+        )
+        prompts = rng.sample(PROMPTS, rng.randint(2, len(PROMPTS)))
+        # Duplicate one prompt: identical prefixes force shared pages (and
+        # CoW splits once the copies diverge... they don't under greedy, so
+        # sharing persists into the snapshot).
+        prompts.append(list(prompts[0]))
+
+        ref_eng = make_engine(model, params, **kw)
+        ref_ids = submit_all(ref_eng, prompts=prompts)
+        ref_eng.run()
+        ref = {i: ref_eng.poll(i).generated for i in ref_ids}
+
+        eng = make_engine(model, params, **kw)
+        ids = submit_all(eng, prompts=prompts)
+        for _ in range(rng.randint(1, 6)):
+            if eng.scheduler.has_work or eng._inflight is not None:
+                eng.step()
+
+        # Snapshot WITHOUT finishing the in-flight dispatch: pending
+        # placeholder tokens must be rolled back, not serialized.
+        snap = snapshot_engine(eng)
+        assert EngineSnapshot.from_json(snap.to_json()) == snap
+        for rec in snap.requests:
+            assert -1 not in rec.generated  # PENDING_TOKEN never escapes
+
+        finished_before = [i for i in ids if eng.poll(i).state == "finished"]
+        eng.close()  # asserts zero leaked pages via allocator gauges
+
+        fresh = make_engine(model, params, **kw)
+        restored = restore_engine(fresh, snap)
+        assert sorted(restored + finished_before) == sorted(ids)
+        fresh.run()
+        for i in ids:
+            src = fresh if i in restored else eng
+            assert src.poll(i).generated == ref[i], (trial, i)
+        fresh.close()
+
+
+# ------------------------------------------------------------- deadlines
+
+
+class TestDeadlines:
+    def test_deadline_zero_expires_before_any_token(self, target_and_params):
+        model, params = target_and_params
+        eng = make_engine(model, params)
+        doomed = eng.submit(
+            PROMPTS[0], SamplingParams(max_new_tokens=MAX_NEW, deadline_s=0.0)
+        )
+        alive = eng.submit(PROMPTS[1], SamplingParams(max_new_tokens=4))
+        eng.run()
+        assert eng.poll(doomed).state == "expired"
+        assert eng.poll(doomed).generated == []
+        assert eng.poll(alive).state == "finished"
+        assert counters(eng)["serving_requests_expired_total"] == 1
+        assert eng.allocator.num_allocated == 0
+
+    def test_mid_flight_expiry_frees_pages(self, target_and_params):
+        model, params = target_and_params
+        eng = make_engine(model, params)
+        rid = eng.submit(
+            PROMPTS[0],
+            SamplingParams(max_new_tokens=MAX_NEW, deadline_s=3600.0),
+        )
+        for _ in range(3):
+            eng.step()
+        req = next(r for r in eng.scheduler.running if r.req_id == rid)
+        assert req.n_generated > 0
+        req.submit_time -= 7200.0  # age the request past its deadline
+        eng.run()
+        st = eng.poll(rid)
+        assert st.state == "expired"
+        assert 0 < len(st.generated) < MAX_NEW  # partial output retained
+        assert eng.allocator.num_allocated == 0
+
+    def test_deadline_rebased_across_restore(self, target_and_params):
+        model, params = target_and_params
+        eng = make_engine(model, params)
+        eng.submit(
+            PROMPTS[0],
+            SamplingParams(max_new_tokens=MAX_NEW, deadline_s=3600.0),
+        )
+        eng.step()
+        snap = drain_engine(eng)
+        (rec,) = snap.requests
+        assert rec.deadline_s == 3600.0 and rec.age_s >= 0.0
+
+        # A request restored OLDER than its deadline expires immediately:
+        # restore rebases submit_time to (now - age_s), not to now.
+        stale = dataclasses.replace(
+            snap, requests=(dataclasses.replace(rec, age_s=7200.0),)
+        )
+        fresh = make_engine(model, params)
+        (rid,) = restore_engine(fresh, stale)
+        fresh.run()
+        assert fresh.poll(rid).state == "expired"
+        assert counters(fresh)["serving_requests_expired_total"] == 1
+
+
+# ---------------------------------------------------------- close/teardown
+
+
+class TestClose:
+    def test_close_cancels_live_requests_and_quiesces(self, target_and_params):
+        model, params = target_and_params
+        eng = make_engine(model, params)
+        ids = submit_all(eng)
+        for _ in range(3):
+            eng.step()
+        eng.close()
+        states = {eng.poll(i).state for i in ids}
+        assert states <= {"finished", "cancelled"} and "cancelled" in states
+        assert eng.allocator.num_allocated == 0
+        assert counters(eng)["serving_requests_cancelled_total"] > 0
+        with pytest.raises(EngineDraining):
+            eng.submit([1], SamplingParams(max_new_tokens=1))
+        eng.close()  # idempotent
+
+    def test_context_manager_drains_overlap_pipeline(self, target_and_params):
+        model, params = target_and_params
+        with make_engine(model, params, overlap=True) as eng:
+            submit_all(eng, prompts=PROMPTS[:2])
+            for _ in range(4):
+                eng.step()
+            assert eng._inflight is not None or eng.scheduler.has_work
+        assert eng._inflight is None
+        assert eng.allocator.num_allocated == 0
+
+    def test_close_flushes_trace(self, tmp_path, target_and_params):
+        model, params = target_and_params
+        path = str(tmp_path / "trace.json")
+        with make_engine(
+            model, params, tracer=Tracer(), trace_path=path
+        ) as eng:
+            submit_all(eng, prompts=PROMPTS[:2])
+            eng.run()
+        with open(path) as f:
+            trace = json.load(f)
+        assert any(
+            e.get("name") == "step" for e in trace["traceEvents"]
+        )
+
+    def test_close_detects_leaked_pages(self, target_and_params):
+        model, params = target_and_params
+        eng = make_engine(model, params)
+        leak = eng.allocator.allocate(1)  # page the scheduler doesn't own
+        with pytest.raises(AssertionError, match="leak"):
+            eng.close()
+        eng.allocator.free(leak)
+
+
+# ----------------------------------------------------------- peer handoff
+
+
+class TestPeerHandoff:
+    @pytest.mark.slow
+    def test_publish_adopt_via_store(self, target_and_params, ref_outputs):
+        import socket
+
+        from distributed_pytorch_tpu.elastic.store import (
+            KVStoreClient,
+            KVStoreServer,
+        )
+        from distributed_pytorch_tpu.serving import (
+            adopt_snapshot,
+            publish_snapshot,
+        )
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        model, params = target_and_params
+        with KVStoreServer(port):
+            client = KVStoreClient("127.0.0.1", port)
+            eng = make_engine(model, params)
+            ids = submit_all(eng)
+            for _ in range(3):
+                eng.step()
+            snap = drain_engine(eng)
+            publish_snapshot(client, "drained/engine-0", snap)
+
+            peer = make_engine(model, params)
+            restored = adopt_snapshot(peer, client, "x-no-such-key")
+            assert restored == []
+            restored = adopt_snapshot(peer, client, "drained/engine-0")
+            peer.run()
+            for i in ids:
+                src = peer if i in restored else eng
+                assert src.poll(i).generated == ref_outputs[i]
+            # Adopt-once: the key is consumed.
+            assert client.get("drained/engine-0") is None

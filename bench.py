@@ -475,6 +475,7 @@ def bench_serving(
     shared_prefix_len: int = 24,
     speculative: bool = False,
     gamma: int = 4,
+    mesh_shapes: str = "",
 ):
     """Continuous-batching serving benchmark: Poisson arrivals against the
     ``serving.InferenceEngine``, reporting throughput plus TTFT/TPOT/e2e
@@ -496,19 +497,33 @@ def bench_serving(
     target itself — acceptance exactly 1.0, measuring the ENGINE's
     per-round amortization ceiling at this gamma: host scheduling, staging
     and dispatch are paid once per round instead of once per token. With a
-    real (distilled) draft, the reported acceptance rate scales that win."""
+    real (distilled) draft, the reported acceptance rate scales that win.
+
+    ``mesh_shapes`` (comma-separated ``DxM``, e.g. ``"1x1,1x8,2x4"``) adds
+    one pass per mesh geometry over the IDENTICAL workload — engine
+    sharded via ``make_serving_mesh`` — and appends per-shape tokens/sec +
+    TPOT p50/p95 rows under ``mesh_rows``. On the virtual-CPU rig these
+    are regression-tracking numbers (N "devices" share one host's cores),
+    not speedup claims; the row that matters everywhere is
+    ``greedy_tokens_match_unsharded``."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from distributed_pytorch_tpu.models.transformer import TransformerLM
     from distributed_pytorch_tpu.obs import Tracer
-    from distributed_pytorch_tpu.serving import InferenceEngine, SamplingParams
+    from distributed_pytorch_tpu.serving import (
+        InferenceEngine,
+        SamplingParams,
+        make_serving_mesh,
+    )
     from distributed_pytorch_tpu.serving.admission import ServingMetrics
 
     on_cpu = jax.devices()[0].platform == "cpu"
+    # n_heads 8 (head_dim 8) so every head dim divides a model axis up to
+    # 8 — the same model serves the unsharded rows and every mesh row.
     model = TransformerLM(
-        vocab_size=256, d_model=64, n_layers=2, n_heads=4, d_ff=256,
+        vocab_size=256, d_model=64, n_layers=2, n_heads=8, d_ff=256,
         dtype=jnp.float32 if on_cpu else jnp.bfloat16,
     )
     params = model.init(
@@ -529,7 +544,7 @@ def bench_serving(
     warm_rng = np.random.default_rng(seed + 1)
 
     def run_pass(prefix_caching: bool, spec: bool = False,
-                 trace: bool = False):
+                 trace: bool = False, mesh=None):
         kw = {}
         if spec:
             kw.update(
@@ -539,7 +554,7 @@ def bench_serving(
         eng = InferenceEngine(
             model, params, max_slots=8, max_seq_len=64, page_size=8,
             token_budget=64, max_prefill_chunk=32, max_queue=n_requests,
-            prefix_cache=prefix_caching, tracer=tracer, **kw,
+            prefix_cache=prefix_caching, tracer=tracer, mesh=mesh, **kw,
         )
         # Warm the compile caches off the clock — one request per
         # power-of-two prefill bucket (a prompt of length c+1 prefills
@@ -595,6 +610,9 @@ def bench_serving(
             # through MetricsRegistry.snapshot().
             "registry": eng.registry.snapshot(),
         }
+        if mesh is not None:
+            row["mesh"] = eng.mesh_fingerprint
+            row["sharded_programs"] = eng._sharded_programs
         if tracer is not None:
             # The tracer sees EVERY request the engine completed, including
             # the n_warm compile-warm-up ones submitted before the metrics
@@ -671,6 +689,42 @@ def bench_serving(
         out["tpot_p50_speedup_spec"] = (
             round(on["tpot_s_p50"] / spec_on["tpot_s_p50"], 4)
             if spec_on.get("tpot_s_p50") else None
+        )
+    if mesh_shapes:
+        # One pass per mesh geometry over the IDENTICAL workload (prefix
+        # caching on, spec off — same config as the headline row), greedy
+        # tokens cross-checked against the unsharded prefix-on pass.
+        mesh_rows = []
+        n_devices = len(jax.devices())
+        for shape in mesh_shapes.split(","):
+            d, m = (int(x) for x in shape.strip().split("x"))
+            if d * m > n_devices:
+                mesh_rows.append(
+                    {"mesh": f"{d}x{m}", "skipped": True,
+                     "reason": f"needs {d * m} devices, have {n_devices}"}
+                )
+                continue
+            row_mesh, tokens_mesh = run_pass(
+                True, mesh=make_serving_mesh(d, m)
+            )
+            s = row_mesh["stats"]
+            mesh_rows.append(
+                {
+                    "mesh": row_mesh["mesh"],
+                    "tokens_per_sec": s.get("tokens_per_sec"),
+                    "tpot_s_p50": s.get("tpot_s_p50"),
+                    "tpot_s_p95": s.get("tpot_s_p95"),
+                    "ttft_s_p50": s.get("ttft_s_p50"),
+                    "prefix_hit_rate": s.get("prefix_hit_rate"),
+                    "sharded_programs": row_mesh["sharded_programs"],
+                    "greedy_tokens_match_unsharded": (
+                        tokens_mesh == tokens_on
+                    ),
+                }
+            )
+        out["mesh_rows"] = mesh_rows
+        out["mesh_greedy_parity"] = all(
+            r.get("greedy_tokens_match_unsharded", True) for r in mesh_rows
         )
     path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_SERVING.json"
@@ -832,6 +886,13 @@ def main():
         "per verify round)",
     )
     parser.add_argument(
+        "--mesh", type=str, default="", metavar="SHAPES",
+        help="comma-separated DxM serving-mesh shapes (e.g. 1x1,1x8,2x4) "
+        "to additionally run the --serving workload on; appends per-shape "
+        "tokens/sec + TPOT rows to BENCH_SERVING.json (pair with "
+        "--fake_devices 8 on a single-device rig)",
+    )
+    parser.add_argument(
         "--fake_devices", type=int, default=0, metavar="N",
         help="run on N virtual CPU devices instead of the real backend "
         "(the --scaling rig until a multi-chip slice exists)",
@@ -930,6 +991,7 @@ def run_benches(args, dev, peak):
         result = bench_serving(
             shared_prefix_len=args.shared_prefix_len,
             speculative=args.speculative, gamma=args.gamma,
+            mesh_shapes=args.mesh,
         )
         s = result["rows"][1]["stats"]
         line = {
@@ -951,6 +1013,11 @@ def run_benches(args, dev, peak):
         if args.speculative:
             line["spec_acceptance_rate"] = result["spec_acceptance_rate"]
             line["tpot_p50_speedup_spec"] = result["tpot_p50_speedup_spec"]
+        if args.mesh:
+            line["mesh_shapes"] = [
+                r["mesh"] for r in result["mesh_rows"]
+            ]
+            line["mesh_greedy_parity"] = result["mesh_greedy_parity"]
         print(json.dumps(line))
         return
 

@@ -86,6 +86,9 @@ class NullTracer:
     def instant(self, name: str, **attrs) -> None:
         pass
 
+    def set_engine_label(self, label: str) -> None:
+        pass
+
 
 NULL_TRACER = NullTracer()
 
@@ -143,6 +146,14 @@ class Tracer:
         self._step_t0 = 0.0
         self.spans_opened = 0
         self.spans_closed = 0
+        self.engine_label: str = ""
+
+    def set_engine_label(self, label: str) -> None:
+        """Annotate the engine process lane (e.g. ``"mesh 2x4"``) — shows
+        up in the Perfetto process name so traces from differently-sharded
+        engines are tellable apart at a glance. Unset keeps the historical
+        plain ``engine`` name byte-for-byte."""
+        self.engine_label = str(label)
 
     def _now_us(self) -> float:
         return (self._clock() - self._epoch) * 1e6
@@ -250,12 +261,16 @@ class Tracer:
     def to_perfetto(self) -> Dict[str, object]:
         """Chrome ``trace_event`` document: recorded events plus process /
         thread name metadata so the lanes are labeled in the UI."""
+        engine_name = (
+            f"engine [{self.engine_label}]" if self.engine_label
+            else "engine"
+        )
         meta = [
             {
                 "name": "process_name",
                 "ph": "M",
                 "pid": _PID_ENGINE,
-                "args": {"name": "engine"},
+                "args": {"name": engine_name},
             },
             {
                 "name": "thread_name",

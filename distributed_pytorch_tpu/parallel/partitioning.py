@@ -51,6 +51,29 @@ TRANSFORMER_TP_RULES: Rules = (
 )
 
 
+def rules_on_axis(rules: Rules, axis: str) -> Rules:
+    """Rebind a single-axis rule table onto a different mesh-axis name.
+
+    :data:`TRANSFORMER_TP_RULES` names its sharded dims ``"tensor"`` (the
+    training-mesh convention); the serving mesh calls the same physical
+    axis ``"model"``. The split geometry is identical — only the label
+    changes — so consumers rebind the one rule table instead of keeping a
+    drifting copy per axis name.
+    """
+
+    def rebind(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, tuple):
+            return tuple(axis for _ in entry)
+        return axis
+
+    return tuple(
+        (pattern, P(*(rebind(entry) for entry in spec)))
+        for pattern, spec in rules
+    )
+
+
 def _path_str(path) -> str:
     parts = []
     for entry in path:

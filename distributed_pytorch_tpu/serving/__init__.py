@@ -44,6 +44,10 @@ from distributed_pytorch_tpu.serving.kv_cache import (
     PagedBlockAllocator,
     PrefixCache,
 )
+from distributed_pytorch_tpu.serving.mesh import (
+    make_serving_mesh,
+    mesh_fingerprint,
+)
 from distributed_pytorch_tpu.serving.scheduler import (
     PENDING_TOKEN,
     Request,
@@ -77,6 +81,8 @@ __all__ = [
     "StepPlan",
     "adopt_snapshot",
     "drain_engine",
+    "make_serving_mesh",
+    "mesh_fingerprint",
     "publish_snapshot",
     "restore_engine",
     "snapshot_engine",

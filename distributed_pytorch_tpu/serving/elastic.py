@@ -96,7 +96,10 @@ class EngineSnapshot:
     engine with different truncation would silently change sampled
     outputs, so :func:`restore_engine` refuses. ``next_id`` preserves the
     id space: request ids ARE priorities, and a restored engine must not
-    mint an id that outranks a recovered request."""
+    mint an id that outranks a recovered request. ``mesh`` is the
+    ``"DxM"`` geometry fingerprint (``"1x1"`` unsharded) — same refusal
+    logic: shards reorder float accumulation, so a sampled stream
+    recovered onto different geometry could silently diverge."""
 
     version: int
     page_size: int
@@ -106,6 +109,9 @@ class EngineSnapshot:
     speculative: bool
     next_id: int
     requests: Tuple[RequestSnapshot, ...]
+    # Defaulted-last for wire compatibility: version-1 snapshots written
+    # before mesh sharding existed decode as unsharded.
+    mesh: str = "1x1"
 
     # --------------------------------------------------------------- codec
 
@@ -121,6 +127,7 @@ class EngineSnapshot:
                 f"snapshot version {doc.get('version')!r} != "
                 f"{SNAPSHOT_VERSION}"
             )
+        doc.setdefault("mesh", "1x1")
         reqs = []
         for entry in doc["requests"]:
             entry = dict(entry)
@@ -215,6 +222,7 @@ def snapshot_engine(engine) -> EngineSnapshot:
         speculative=engine.speculative,
         next_id=engine._next_id,
         requests=tuple(recs),
+        mesh=engine.mesh_fingerprint,
     )
 
 
@@ -256,6 +264,13 @@ def restore_engine(engine, snapshot: EngineSnapshot) -> List[int]:
             f"top_p={snapshot.top_p}, engine compiled with "
             f"top_k={engine._top_k} top_p={engine._top_p} — sampled "
             "streams would diverge; restore onto a matching engine"
+        )
+    if snapshot.mesh != engine.mesh_fingerprint:
+        raise ValueError(
+            f"snapshot was taken on a {snapshot.mesh} mesh, restore "
+            f"target is {engine.mesh_fingerprint} — sharded reductions "
+            "reorder float accumulation, so recovered sampled streams "
+            "could silently diverge; restore onto matching geometry"
         )
     now = time.perf_counter()
     restored: List[int] = []

@@ -77,6 +77,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh
 
 from distributed_pytorch_tpu import chaos
 from distributed_pytorch_tpu.generation import (
@@ -94,6 +95,14 @@ from distributed_pytorch_tpu.serving.kv_cache import (
     PagedBlockAllocator,
     PagePoolGroup,
     PrefixCache,
+)
+from distributed_pytorch_tpu.serving.mesh import (
+    axis_sizes,
+    kv_pool_shardings,
+    mesh_fingerprint,
+    replicated,
+    serving_param_shardings,
+    validate_kv_heads,
 )
 from distributed_pytorch_tpu.serving.scheduler import (
     PENDING_TOKEN,
@@ -142,6 +151,19 @@ class InferenceEngine:
     Greedy requests stay token-identical to the plain engine; sampled
     requests stay exactly target-distributed (but draw a different stream
     than the plain engine — one uniform per proposal, not per token).
+
+    ``mesh`` (a ``("data", "model")`` mesh from
+    :func:`~distributed_pytorch_tpu.serving.mesh.make_serving_mesh`)
+    shards the whole device side: weights follow the Megatron rules
+    rebound onto ``model``, every per-layer KV page pool splits its
+    KV-head dim over ``model``, and all five compiled programs become
+    pjit-style sharded programs with explicit in/out shardings — the SPMD
+    partitioner inserts the collectives while the host-side allocator,
+    block tables, scheduler, and prefix trie stay byte-for-byte unchanged
+    (pages are metadata to them). ``mesh=None`` (default) keeps today's
+    single-device jit path untouched; a ``(1, 1)`` mesh is
+    bitwise-identical to it, larger meshes are greedy-token-identical
+    (sharded reductions reorder float accumulation).
     """
 
     def __init__(
@@ -164,6 +186,7 @@ class InferenceEngine:
         draft_model=None,
         draft_params=None,
         gamma: int = 4,
+        mesh: Optional[Mesh] = None,
         debug: bool = False,
         tracer: Optional[Tracer] = None,
         trace_path: Optional[str] = None,
@@ -200,6 +223,19 @@ class InferenceEngine:
         self.gamma = int(gamma) if self.speculative else 0
         self.draft_params = draft_params
 
+        # Mesh geometry is engine-static, like top_k/top_p: it is compiled
+        # into every program and fingerprinted into elastic snapshots.
+        # Head-divisibility is refused HERE (readable head counts), before
+        # the per-kernel divisibility pass in make_param_specs.
+        self.mesh = mesh
+        self.mesh_fingerprint = mesh_fingerprint(mesh)
+        self._data_size, self._model_size = axis_sizes(mesh)
+        self._sharded_programs = 0
+        if mesh is not None:
+            validate_kv_heads(model, mesh, role="target")
+            if self.speculative:
+                validate_kv_heads(draft_model, mesh, role="draft")
+
         self.decode_model = model.clone(
             decode=True, page_size=page_size, num_pages=num_pages
         )
@@ -228,12 +264,43 @@ class InferenceEngine:
             pools["draft"] = _zero_cache(self.draft_decode_model)
         self.pools = PagePoolGroup(**pools)
 
+        # Place the device state ONCE at init: params under the Megatron
+        # rules (rebound to "model"), every KV pool with heads split over
+        # "model", and one shared replicated sharding for the host-staged
+        # program inputs. The compiled programs' donated-cache out
+        # shardings keep the pools in place steady-state, so no resharding
+        # ever happens on the hot path.
+        if mesh is not None:
+            self._replicated = replicated(mesh)
+            self._param_shardings = serving_param_shardings(mesh, params)
+            self.params = jax.device_put(params, self._param_shardings)
+            if self.speculative:
+                self._draft_param_shardings = serving_param_shardings(
+                    mesh, draft_params
+                )
+                self.draft_params = jax.device_put(
+                    draft_params, self._draft_param_shardings
+                )
+            self._pool_shardings = {
+                name: kv_pool_shardings(mesh, self.pools[name])
+                for name in self.pools.names
+            }
+            for name in self.pools.names:
+                self.pools[name] = jax.device_put(
+                    self.pools[name], self._pool_shardings[name]
+                )
+
         # Zero-cost-when-disabled observability handle: one shared null
         # object serves every untraced engine — no timestamps, no dicts,
         # bitwise-identical outputs (pinned by tests).
         self.tracer = tracer if tracer is not None else NULL_TRACER
+        if mesh is not None and self.tracer.enabled:
+            # Unsharded traces stay byte-identical: the label is only set
+            # (and only serialized) for meshed engines.
+            self.tracer.set_engine_label(f"mesh {self.mesh_fingerprint}")
         self.allocator = PagedBlockAllocator(num_pages)
         self.allocator.tracer = self.tracer
+        self.allocator.pool_names = self.pools.names
         self.prefix_cache = (
             PrefixCache(self.allocator, page_size) if prefix_cache else None
         )
@@ -340,6 +407,16 @@ class InferenceEngine:
                 "prefix_tokens_missed_total", lambda: pc.tokens_missed
             )
             reg.gauge_fn("prefix_nodes", lambda: pc.num_nodes)
+        # Mesh geometry. The registry has no label support, so the shape
+        # label rides an info-style gauge (value pinned to 1.0, shape in
+        # the name) next to the numeric per-axis gauges; an unsharded
+        # engine reports 1/1/0 under serving_mesh_1x1_info.
+        reg.gauge_fn("data_axis_size", lambda: self._data_size)
+        reg.gauge_fn("model_axis_size", lambda: self._model_size)
+        reg.gauge_fn(
+            "sharded_program_count", lambda: self._sharded_programs
+        )
+        reg.gauge_fn(f"mesh_{self.mesh_fingerprint}_info", lambda: 1.0)
         return reg
 
     # Pool accessors: the target pool keeps its historical ``self.cache``
@@ -363,6 +440,26 @@ class InferenceEngine:
         self.pools["draft"] = value
 
     # ------------------------------------------------------------- compiled
+    #
+    # Every factory below branches once on ``self.mesh``: unsharded engines
+    # get the EXACT jit call they always had (the bitwise guarantee is the
+    # absence of any new annotation, not a (1,1) fast path), meshed engines
+    # get the same trace wrapped in explicit in/out shardings — params
+    # under SERVING_PARAM_RULES, pools under KV_POOL_SPEC, every
+    # host-staged operand and sampled output replicated. Donated caches
+    # keep their sharding on the way out, so device state never migrates
+    # after init. Each sharded compile bumps ``_sharded_programs`` (a
+    # registry gauge): lazily-built programs surface in obs exactly when
+    # they start existing.
+
+    def _sharded_jit(self, run, *, donate, in_shardings, out_shardings):
+        self._sharded_programs += 1
+        return jax.jit(
+            run,
+            donate_argnums=donate,
+            in_shardings=in_shardings,
+            out_shardings=out_shardings,
+        )
 
     @functools.cached_property
     def _decode_step(self):
@@ -389,7 +486,22 @@ class InferenceEngine:
             nxt = jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
             return nxt, cache
 
-        return jax.jit(run, donate_argnums=(1,))
+        if self.mesh is None:
+            return jax.jit(run, donate_argnums=(1,))
+        rep = self._replicated
+        pool = self._pool_shardings["target"]
+        # prev is device-resident feedback: it comes back replicated (out
+        # sharding below) and is consumed replicated, so the overlapped
+        # splice never adds a collective.
+        return self._sharded_jit(
+            run,
+            donate=(1,),
+            in_shardings=(
+                self._param_shardings, pool, rep, rep, rep, rep, rep, rep,
+                rep,
+            ),
+            out_shardings=(rep, pool),
+        )
 
     @functools.lru_cache(maxsize=16)
     def _prefill_step(self, chunk: int):
@@ -403,20 +515,42 @@ class InferenceEngine:
             )
             return cache
 
-        return jax.jit(run, donate_argnums=(1,))
+        if self.mesh is None:
+            return jax.jit(run, donate_argnums=(1,))
+        rep = self._replicated
+        pool = self._pool_shardings["target"]
+        return self._sharded_jit(
+            run,
+            donate=(1,),
+            in_shardings=(self._param_shardings, pool, rep, rep, rep),
+            out_shardings=pool,
+        )
 
     @functools.cached_property
     def _copy_page(self):
         """Copy one physical page across every layer's K/V pool — the
         device half of copy-on-write. Page ids are traced scalars, so this
-        compiles exactly once."""
+        compiles exactly once (per pool when meshed: pools differ in
+        sharding pytree, so the mesh path returns a pool-name -> program
+        mapping, which :meth:`PagePoolGroup.copy_page` accepts)."""
 
         def run(cache, src, dst):
             return jax.tree_util.tree_map(
                 lambda pool: pool.at[dst].set(pool[src]), cache
             )
 
-        return jax.jit(run, donate_argnums=(0,))
+        if self.mesh is None:
+            return jax.jit(run, donate_argnums=(0,))
+        rep = self._replicated
+        return {
+            name: self._sharded_jit(
+                run,
+                donate=(0,),
+                in_shardings=(self._pool_shardings[name], rep, rep),
+                out_shardings=self._pool_shardings[name],
+            )
+            for name in self.pools.names
+        }
 
     @functools.lru_cache(maxsize=16)
     def _draft_prefill_step(self, chunk: int):
@@ -433,7 +567,18 @@ class InferenceEngine:
             )
             return draft_cache
 
-        return jax.jit(run, donate_argnums=(1,))
+        if self.mesh is None:
+            return jax.jit(run, donate_argnums=(1,))
+        rep = self._replicated
+        pool = self._pool_shardings["draft"]
+        return self._sharded_jit(
+            run,
+            donate=(1,),
+            in_shardings=(
+                self._draft_param_shardings, pool, rep, rep, rep
+            ),
+            out_shardings=pool,
+        )
 
     @functools.cached_property
     def _spec_step(self):
@@ -562,7 +707,20 @@ class InferenceEngine:
             emitted = proposals.at[rows, ni].set(corrected)
             return emitted, n_acc, cache, draft_cache
 
-        return jax.jit(run, donate_argnums=(2, 3))
+        if self.mesh is None:
+            return jax.jit(run, donate_argnums=(2, 3))
+        rep = self._replicated
+        pool = self._pool_shardings["target"]
+        draft_pool = self._pool_shardings["draft"]
+        return self._sharded_jit(
+            run,
+            donate=(2, 3),
+            in_shardings=(
+                self._param_shardings, self._draft_param_shardings,
+                pool, draft_pool, rep, rep, rep, rep, rep,
+            ),
+            out_shardings=(rep, rep, pool, draft_pool),
+        )
 
     # ----------------------------------------------------------------- API
 

@@ -85,6 +85,11 @@ class PagedBlockAllocator:
         self._idle: "OrderedDict[int, None]" = OrderedDict()
         # Pages registered in the prefix trie (referenced or idle).
         self._cached: set = set()
+        # Names of the device pools this id space governs — one pool for a
+        # plain engine, ("target", "draft") under speculative decoding (the
+        # engine overwrites this from its PagePoolGroup). Page-leak
+        # diagnostics name them: one leaked id pins K/V in EVERY pool.
+        self.pool_names: Tuple[str, ...] = ("target",)
         # Called with the page id just before an idle page is recycled, so
         # the prefix trie can drop the nodes that point at it.
         self.evict_hook: Optional[Callable[[int], None]] = None
@@ -264,7 +269,8 @@ class PagedBlockAllocator:
         )
         total = len(free_set) + len(ref_set) + len(idle_set)
         assert total == self.num_pages - 1, (
-            f"page leak: {len(free_set)} free + {len(ref_set)} referenced "
+            f"page leak in pool(s) {'/'.join(self.pool_names)}: "
+            f"{len(free_set)} free + {len(ref_set)} referenced "
             f"+ {len(idle_set)} idle != {self.num_pages - 1} allocatable"
         )
         # The O(1) running gauges must agree with the sweep-derived truth —
@@ -286,10 +292,11 @@ class PagedBlockAllocator:
         referenced. Cached-idle pages are fine — they are reclaimable and
         die with the device arrays — but a nonzero referenced gauge here is
         a leaked block table, the exact silent loss close() exists to
-        catch."""
+        catch. One page id pins K/V in every governed pool, so the message
+        names them all (target vs target/draft)."""
         assert self._n_referenced == 0, (
-            f"teardown leaked {self._n_referenced} referenced page(s): "
-            f"{sorted(self._ref)}"
+            f"teardown leaked {self._n_referenced} referenced page(s) in "
+            f"pool(s) {'/'.join(self.pool_names)}: {sorted(self._ref)}"
         )
         self.check_invariants()
 
@@ -389,9 +396,14 @@ class PagePoolGroup:
         """Fan the engine's compiled page-copy out over EVERY pool — the
         device half of copy-on-write must clone a shared page's draft K/V
         in the same step as its target K/V, or a later speculative write
-        through the fresh id would diverge the two pools."""
+        through the fresh id would diverge the two pools. ``copy_fn`` is
+        one program shared by every pool, or a mapping pool-name ->
+        program when pools carry their own shardings (the mesh-sharded
+        engine compiles one per pool so in/out shardings stay explicit)."""
+        per_pool = isinstance(copy_fn, dict)
         for name in self.pools:
-            self.pools[name] = copy_fn(self.pools[name], src, dst)
+            fn = copy_fn[name] if per_pool else copy_fn
+            self.pools[name] = fn(self.pools[name], src, dst)
 
 
 class PrefixCache:

@@ -1,0 +1,176 @@
+"""Mesh-sharded serving: GSPMD placement for the continuous-batching engine.
+
+The serving engine's five compiled programs (decode, the prefill ladder,
+page copy, draft prefill, draft+verify) are pure jit programs over a
+device-resident state: the params and the per-layer KV page pools. Making
+them multi-chip is therefore a PLACEMENT problem, not a code change — the
+pjit recipe (PAPERS.md, arXiv 2204.06514): lay the chips out on a
+``("data", "model")`` mesh, annotate every program input/output with a
+:class:`~jax.sharding.NamedSharding`, and let the SPMD partitioner insert
+the collectives. This module owns those annotations:
+
+* **Weights** follow the Megatron split the training side already encodes
+  in :data:`~distributed_pytorch_tpu.parallel.partitioning
+  .TRANSFORMER_TP_RULES`, rebound from the training mesh's ``"tensor"``
+  axis name onto serving's ``"model"`` (:data:`SERVING_PARAM_RULES`) —
+  column-then-row attention/MLP splits, one all-reduce per block.
+* **KV page pools** ``[num_pages, page_size, Hkv, D]`` split the KV-head
+  dim over ``"model"`` (:func:`kv_pool_shardings`) — each model shard
+  writes and reads exactly the head slice its Q/K/V column shards
+  produce, so paged attention needs NO extra collective beyond the ones
+  the weight split already implies. Page IDs are replicated metadata: the
+  host-side allocator, block tables, scheduler, and prefix trie never see
+  the mesh.
+* **Everything else** (token rows, block-table batches, lengths,
+  temperatures, RNG keys, sampled outputs) is replicated
+  (:func:`replicated`); the unused ``data`` axis replicates the whole
+  engine, so every data replica holds identical tokens — the single-host
+  proxy for engine replicas riding the data axis.
+
+Exactness contract: a ``(1, 1)`` mesh compiles to the same math as the
+unsharded engine (bitwise-identical tokens); larger meshes reorder float
+reductions across shards, so cross-geometry parity is greedy-token
+(argmax) rather than bitwise — pinned by ``tests/test_serving_mesh.py``
+on the 8-virtual-CPU rig.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.tree_util as jtu
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_pytorch_tpu.parallel.mesh import make_mesh
+from distributed_pytorch_tpu.parallel.partitioning import (
+    TRANSFORMER_TP_RULES,
+    make_param_specs,
+    rules_on_axis,
+    specs_to_shardings,
+)
+
+#: The serving mesh's axis names, in mesh order: engine replicas ride
+#: ``data``, tensor-parallel shards ride ``model``.
+SERVING_AXES: Tuple[str, str] = ("data", "model")
+
+#: :data:`TRANSFORMER_TP_RULES` with every ``"tensor"`` occurrence rebound
+#: to the serving mesh's ``"model"`` axis.
+SERVING_PARAM_RULES = rules_on_axis(TRANSFORMER_TP_RULES, "model")
+
+#: Per-layer paged KV pools ``[num_pages, page_size, Hkv, D]`` split their
+#: KV-head dim; pages and in-page positions are never split (a physical
+#: page id must name the same token span on every shard — the host
+#: allocator hands out ids with no idea a mesh exists).
+KV_POOL_SPEC = P(None, None, "model", None)
+
+
+def make_serving_mesh(
+    data: int = 1,
+    model: int = 1,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A ``(data, model)`` mesh over the first ``data * model`` devices.
+
+    Unlike :func:`~distributed_pytorch_tpu.parallel.mesh.make_mesh` alone,
+    submeshes are allowed implicitly — a ``(1, 1)`` serving mesh on the
+    8-virtual-device test rig is the parity baseline, not a typo.
+    """
+    if data < 1 or model < 1:
+        raise ValueError(
+            f"mesh axes must be >= 1, got (data={data}, model={model})"
+        )
+    if devices is None:
+        devices = jax.devices()
+    need = data * model
+    if need > len(devices):
+        raise ValueError(
+            f"serving mesh ({data},{model}) needs {need} devices, "
+            f"have {len(devices)}"
+        )
+    return make_mesh(
+        {"data": data, "model": model}, devices=list(devices)[:need]
+    )
+
+
+def mesh_fingerprint(mesh: Optional[Mesh]) -> str:
+    """``"DxM"`` geometry string; ``"1x1"`` for an unsharded engine.
+
+    Threaded through ``EngineSnapshot`` so ``restore_engine`` can refuse a
+    geometry mismatch: shards reorder float accumulation, so a sampled
+    stream recovered onto different geometry could silently diverge."""
+    if mesh is None:
+        return "1x1"
+    shape = dict(mesh.shape)
+    return f"{shape.get('data', 1)}x{shape.get('model', 1)}"
+
+
+def axis_sizes(mesh: Optional[Mesh]) -> Tuple[int, int]:
+    """``(data_size, model_size)``; ``(1, 1)`` for an unsharded engine."""
+    if mesh is None:
+        return (1, 1)
+    shape = dict(mesh.shape)
+    return (shape.get("data", 1), shape.get("model", 1))
+
+
+def validate_kv_heads(model, mesh: Optional[Mesh], *, role: str = "target"):
+    """Up-front refusal when a model's heads cannot split over ``model``.
+
+    The KV pools shard dim 2 (``Hkv``) and the Q/K/V kernels shard their
+    head dims, so both ``Hkv`` and ``n_heads`` must divide the model-axis
+    size. :func:`~distributed_pytorch_tpu.parallel.partitioning
+    .make_param_specs` would also catch this at spec time, but its error
+    names a kernel path — this one names the head counts, which is what
+    the operator actually tunes."""
+    _, tp = axis_sizes(mesh)
+    if tp == 1:
+        return
+    n_heads = model.n_heads
+    n_kv = getattr(model, "n_kv_heads", 0)
+    kv_heads = n_kv or n_heads
+    if kv_heads % tp:
+        raise ValueError(
+            f"{role} model has Hkv={kv_heads} KV heads "
+            f"(n_kv_heads={n_kv}, n_heads={n_heads}) — not divisible by "
+            f"the mesh 'model' axis (size {tp}). The paged KV pools shard "
+            "heads over 'model', so Hkv % model_size must be 0; lower the "
+            "model axis or raise n_kv_heads"
+        )
+    if n_heads % tp:
+        raise ValueError(
+            f"{role} model has n_heads={n_heads} query heads — not "
+            f"divisible by the mesh 'model' axis (size {tp}); the Q "
+            "projection shards its head dim over 'model'"
+        )
+
+
+def serving_param_shardings(mesh: Mesh, params):
+    """NamedSharding pytree for a TransformerLM params tree on the serving
+    mesh — :data:`SERVING_PARAM_RULES` with up-front divisibility
+    validation (a readable shape error now beats XLA's at compile)."""
+    specs = make_param_specs(params, SERVING_PARAM_RULES, mesh=mesh)
+    return specs_to_shardings(mesh, specs)
+
+
+def kv_pool_shardings(mesh: Mesh, cache):
+    """NamedSharding pytree for one paged cache collection: every leaf is
+    a per-layer pool ``[num_pages, page_size, Hkv, D]`` and gets
+    :data:`KV_POOL_SPEC` (KV heads on ``model``)."""
+
+    def sharding(leaf):
+        if getattr(leaf, "ndim", 0) != 4:
+            raise ValueError(
+                "paged cache leaf has shape "
+                f"{getattr(leaf, 'shape', None)}; expected a 4-d "
+                "[num_pages, page_size, Hkv, D] pool"
+            )
+        return NamedSharding(mesh, KV_POOL_SPEC)
+
+    return jtu.tree_map(sharding, cache)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """The replicated sharding for host-staged program inputs (token rows,
+    block tables, lengths, temps, keys) and sampled-token outputs."""
+    return NamedSharding(mesh, P())

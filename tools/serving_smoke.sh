@@ -16,7 +16,14 @@
 # against engine ground truth; CI uploads traces/serving_trace.json as a
 # build artifact.
 #
-#   bash tools/serving_smoke.sh
+#   bash tools/serving_smoke.sh          # the four default scenarios
+#   bash tools/serving_smoke.sh mesh     # mesh-sharded scenario only
+#
+# The ``mesh`` scenario boots the engine on a (2,4) ("data","model") mesh
+# over 8 virtual CPU devices, replays a shared-prefix workload, and
+# asserts greedy-token parity against a (1,1) mesh AND the unsharded
+# engine, a nonzero prefix hit rate, the mesh gauges, and zero page
+# leaks.
 #
 # This is the CI end-to-end drill for the serving subsystem: engine +
 # scheduler + paged cache + prefix cache + admission metrics in one pass,
@@ -24,6 +31,86 @@
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+scenario="${1:-default}"
+
+if [ "$scenario" = "mesh" ]; then
+  env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" python - <<'EOF'
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_pytorch_tpu.models.transformer import TransformerLM
+from distributed_pytorch_tpu.serving import (
+    InferenceEngine,
+    SamplingParams,
+    make_serving_mesh,
+)
+
+assert len(jax.devices()) == 8, jax.devices()
+
+# n_heads 8 so every sharded dim divides the model axis of a (2,4) mesh.
+model = TransformerLM(
+    vocab_size=128, d_model=32, n_layers=2, n_heads=8, d_ff=64,
+    dtype=jnp.float32,
+)
+params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+rng = np.random.default_rng(0)
+system = rng.integers(0, 128, 12).tolist()
+waves = [
+    [
+        system + rng.integers(0, 128, int(rng.integers(2, 6))).tolist()
+        for _ in range(2)
+    ]
+    for _ in range(3)
+]
+
+def replay(mesh):
+    e = InferenceEngine(
+        model, params, max_slots=4, max_seq_len=32, page_size=4,
+        token_budget=16, max_prefill_chunk=8, debug=True, mesh=mesh,
+    )
+    rids = []
+    for wave in waves:  # later waves find earlier waves' pages cached
+        rids += [
+            e.submit(p, SamplingParams(max_new_tokens=4)) for p in wave
+        ]
+        e.run()
+    toks = [e.poll(r).generated for r in rids]
+    stats = e.stats()
+    gauges = e.registry.snapshot()["gauges"]
+    e.close()
+    e.allocator.check_invariants()
+    return toks, stats, gauges
+
+base, s0, _ = replay(None)
+one, s1, g1 = replay(make_serving_mesh(1, 1))
+sharded, s2, g2 = replay(make_serving_mesh(2, 4))
+
+assert one == base, "(1,1) mesh diverged from the unsharded engine"
+assert sharded == base, "(2,4) mesh diverged from the unsharded engine"
+for name, s in (("unsharded", s0), ("1x1", s1), ("2x4", s2)):
+    assert s["prefix_hit_rate"] > 0, (
+        f"{name}: shared-prefix workload produced no cache hits: {s}"
+    )
+    assert s["pages_allocated"] == 0, f"{name}: pages leaked after drain"
+assert g2["serving_data_axis_size"] == 2
+assert g2["serving_model_axis_size"] == 4
+assert g2["serving_mesh_2x4_info"] == 1.0
+assert g2["serving_sharded_program_count"] >= 2
+assert g1["serving_mesh_1x1_info"] == 1.0
+
+print(
+    "[serving_smoke] PASS: mesh scenario, greedy parity unsharded == "
+    f"1x1 == 2x4 over {len(base)} requests, "
+    f"hit_rate={s2['prefix_hit_rate']:.2f} "
+    f"sharded_programs={int(g2['serving_sharded_program_count'])}"
+)
+EOF
+  exit 0
+fi
 
 env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python - <<'EOF'
 import jax

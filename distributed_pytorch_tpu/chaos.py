@@ -359,6 +359,7 @@ class FaultPlan:
 
     def _fire_serving(self, fault: Fault) -> None:
         step = self._serving_steps
+        _notify_observers(fault.kind, step, fault.mode)
         if fault.mode == "raise":
             print(
                 f"[chaos] raising {fault.kind} at serving step {step}",
@@ -386,6 +387,7 @@ class FaultPlan:
             os.kill(os.getpid(), signal.SIGTERM)
 
     def _fire(self, fault: Fault) -> None:
+        _notify_observers(fault.kind, self._steps, fault.mode)
         if fault.kind == "kill":
             print(f"[chaos] SIGKILL self at step {self._steps}", flush=True)
             os.kill(os.getpid(), signal.SIGKILL)
@@ -439,6 +441,32 @@ class FaultPlan:
 _UNSET = object()
 _plan = _UNSET
 
+# Fault observers: callbacks invoked with (kind, step, mode) at the moment
+# a fault FIRES, before the signal/raise — the flight recorder's last
+# chance to dump a postmortem ahead of a SIGKILL. Observer exceptions are
+# swallowed: diagnostics must never mask the injected fault itself.
+_FAULT_OBSERVERS: list = []
+
+
+def add_fault_observer(fn) -> None:
+    """Register ``fn(kind, step, mode)`` to run when any fault fires."""
+    _FAULT_OBSERVERS.append(fn)
+
+
+def remove_fault_observer(fn) -> None:
+    try:
+        _FAULT_OBSERVERS.remove(fn)
+    except ValueError:
+        pass
+
+
+def _notify_observers(kind: str, step: int, mode: str) -> None:
+    for fn in list(_FAULT_OBSERVERS):
+        try:
+            fn(kind, step, mode)
+        except Exception:
+            pass
+
 
 def get_plan() -> Optional[FaultPlan]:
     """The process-wide plan from ``TPURUN_FAULT_PLAN``, parsed once and
@@ -453,6 +481,7 @@ def _reset() -> None:
     """Drop the cached plan (tests re-arm the env var within one process)."""
     global _plan
     _plan = _UNSET
+    del _FAULT_OBSERVERS[:]
 
 
 def on_step() -> None:

@@ -413,6 +413,18 @@ class ElasticAgent:
             "preempt_restarts_total"
         )
         self._c_drains = self.registry.counter("drains_requested_total")
+        # Goodput accounting, agent half: wall-clock lost to respawn
+        # churn — from the moment a generation's failure is classified to
+        # the next spawn — split by whether the restart was a free
+        # preemption or burned the failure budget.
+        self._c_restart_downtime = self.registry.counter(
+            "restart_downtime_seconds_total",
+            help="Wall-clock between failure classification and respawn",
+        )
+        self._c_preempt_downtime = self.registry.counter(
+            "preempt_downtime_seconds_total",
+            help="Restart downtime attributable to preemption drains",
+        )
         self.registry.gauge(
             "chaos_faults_armed",
             float(len(plan.faults)) if plan is not None else 0.0,
@@ -609,6 +621,10 @@ class ElasticAgent:
         # world for free.
         spawns = 0
         restarts = 0
+        # (monotonic time failure was classified, was it a preemption) —
+        # closed when the replacement WorkerGroup spawns, so the downtime
+        # counters cover terminate + re-rendezvous, the full gap.
+        fail_at: Optional[tuple] = None
         try:
             while True:
                 try:
@@ -655,6 +671,12 @@ class ElasticAgent:
                 group = self._group = WorkerGroup(
                     cfg, self.cmd, spawns, members=members
                 )
+                if fail_at is not None:
+                    downtime = time.monotonic() - fail_at[0]
+                    self._c_restart_downtime.inc(downtime)
+                    if fail_at[1]:
+                        self._c_preempt_downtime.inc(downtime)
+                    fail_at = None
                 failure = self._monitor(group, generation, members)
                 if failure is None:
                     # Local workers all succeeded; wait for every live agent.
@@ -710,6 +732,7 @@ class ElasticAgent:
                     return 143  # 128 + SIGTERM: conventional reclaim exit
                 spawns += 1
                 self._c_spawns.inc()
+                fail_at = (time.monotonic(), preempt)
                 if preempt:
                     self._c_preempt_restarts.inc()
                     print(

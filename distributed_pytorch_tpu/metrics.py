@@ -11,6 +11,7 @@ cadence.
 
 from __future__ import annotations
 
+import bisect
 import json
 import math
 import random
@@ -40,6 +41,10 @@ class ReservoirHistogram:
         self.capacity = capacity
         self._rng = random.Random(seed)
         self._samples: list = []
+        # Lazily-built sorted view, kept valid incrementally once a
+        # quantile has been read: per-step SLO evaluation would otherwise
+        # re-sort the full reservoir on every tick. None = not built.
+        self._ordered: Optional[list] = None
         self.count = 0
         self.sum = 0.0
         self.min = math.inf
@@ -53,10 +58,16 @@ class ReservoirHistogram:
         self.max = max(self.max, value)
         if len(self._samples) < self.capacity:
             self._samples.append(value)
+            if self._ordered is not None:
+                bisect.insort(self._ordered, value)
         else:
             j = self._rng.randrange(self.count)
             if j < self.capacity:
+                old = self._samples[j]
                 self._samples[j] = value
+                if self._ordered is not None:
+                    self._ordered.pop(bisect.bisect_left(self._ordered, old))
+                    bisect.insort(self._ordered, value)
 
     def quantile(self, q: float) -> float:
         """Linear-interpolated quantile over the reservoir; NaN when empty."""
@@ -64,7 +75,9 @@ class ReservoirHistogram:
             raise ValueError(f"quantile must be in [0, 1], got {q}")
         if not self._samples:
             return math.nan
-        ordered = sorted(self._samples)
+        if self._ordered is None:
+            self._ordered = sorted(self._samples)
+        ordered = self._ordered
         pos = q * (len(ordered) - 1)
         lo = int(pos)
         hi = min(lo + 1, len(ordered) - 1)
@@ -135,6 +148,7 @@ class ReservoirHistogram:
                 reverse=True,
             )
             self._samples = [v for v, _ in keyed[: self.capacity]]
+        self._ordered = None
 
 
 class ReservoirGroup:

@@ -1,6 +1,6 @@
-"""Unified observability: request tracing, step timelines, metrics registry.
+"""Unified observability: tracing, metrics, SLOs, goodput, flight recorder.
 
-Three pieces, designed to be wired through hot paths at zero cost when
+Five pieces, designed to be wired through hot paths at zero cost when
 disabled:
 
 * :class:`~.tracer.Tracer` / :data:`~.tracer.NULL_TRACER` — per-request
@@ -8,21 +8,61 @@ disabled:
   ``trace_event`` JSON;
 * :class:`~.registry.MetricsRegistry` — counters / gauges / labeled
   reservoirs registered by every subsystem, rendered as structured JSON,
-  Prometheus text exposition, or merged across hosts.
+  Prometheus text exposition, or merged across hosts;
+* :class:`~.flight.FlightRecorder` / :data:`~.flight.NULL_FLIGHT_RECORDER`
+  — a fixed-size ring of structured events dumped as a postmortem JSON on
+  faults, drains, crashes, and ``close()``; :func:`~.flight
+  .replay_to_tracer` turns a dump back into a Perfetto trace;
+* :class:`~.slo.SLOMonitor` — declarative latency/rate objectives with
+  multi-window burn-rate alerting over the registry's own metrics;
+* :class:`~.goodput.GoodputTracker` + the analytic FLOPs model — wall-clock
+  decomposed into productive vs wasted time, tokens/sec/device, and MFU.
 """
 
+from distributed_pytorch_tpu.obs.flight import (
+    NULL_FLIGHT_RECORDER,
+    FlightRecorder,
+    NullFlightRecorder,
+    replay_to_tracer,
+)
+from distributed_pytorch_tpu.obs.goodput import (
+    GoodputTracker,
+    causal_attention_flops,
+    peak_flops_per_chip,
+    resnet50_train_flops,
+    transformer_decode_flops_per_token,
+    transformer_train_flops,
+)
 from distributed_pytorch_tpu.obs.registry import (
     Counter,
     Gauge,
     MetricsRegistry,
 )
+from distributed_pytorch_tpu.obs.slo import (
+    SLObjective,
+    SLOMonitor,
+    default_serving_objectives,
+)
 from distributed_pytorch_tpu.obs.tracer import NULL_TRACER, NullTracer, Tracer
 
 __all__ = [
     "Counter",
+    "FlightRecorder",
     "Gauge",
+    "GoodputTracker",
     "MetricsRegistry",
+    "NULL_FLIGHT_RECORDER",
     "NULL_TRACER",
+    "NullFlightRecorder",
     "NullTracer",
+    "SLObjective",
+    "SLOMonitor",
     "Tracer",
+    "causal_attention_flops",
+    "default_serving_objectives",
+    "peak_flops_per_chip",
+    "replay_to_tracer",
+    "resnet50_train_flops",
+    "transformer_decode_flops_per_token",
+    "transformer_train_flops",
 ]

@@ -1,0 +1,189 @@
+"""Crash-dump flight recorder: a fixed-size ring of structured events.
+
+The tracer (:mod:`.tracer`) answers "where did the time go" for a run you
+*planned* to trace; the flight recorder answers "what was the engine doing
+right before it died" for the run you didn't. It keeps only the last
+``capacity`` events in a :class:`collections.deque` ring — recording is an
+append plus a float subtraction, cheap enough to leave on in production —
+and the engine dumps the ring as a postmortem JSON document on fault
+injection, SIGTERM drain, unhandled exceptions escaping
+``InferenceEngine.run()`` / ``DrainController.drive()``, and ``close()``.
+
+Events are flat dicts ``{"kind": ..., "t": seconds-since-construction,
+**fields}``. The recorded kinds mirror the tracer's vocabulary (``step``,
+``admit``, ``preempt``, ``retire``, ``page_evict``, ``chaos_fault``,
+``drain``, ``restore``, ``slo_alert``, ``exception``) so a dump can be
+replayed into a :class:`~distributed_pytorch_tpu.obs.tracer.Tracer` with
+:func:`replay_to_tracer` and opened in Perfetto for a visual postmortem.
+
+The disabled path is the null-object pattern, exactly like
+:data:`~distributed_pytorch_tpu.obs.tracer.NULL_TRACER`: every component
+holds :data:`NULL_FLIGHT_RECORDER` by default and the hot path costs one
+attribute load.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional, Union
+
+DUMP_VERSION = 1
+
+
+class NullFlightRecorder:
+    """Every method a no-op; ``enabled`` False so callers can skip field
+    computation entirely. One shared instance (:data:`NULL_FLIGHT_RECORDER`)
+    serves every disabled component."""
+
+    __slots__ = ()
+    enabled = False
+
+    def record(self, kind: str, **fields) -> None:
+        pass
+
+    def dump(self, reason: str = "manual", *, path=None, extra=None):
+        return None
+
+
+NULL_FLIGHT_RECORDER = NullFlightRecorder()
+
+
+class FlightRecorder:
+    """Bounded ring buffer of structured engine events.
+
+    ``capacity`` bounds memory: once full, each append silently drops the
+    oldest event (``dropped`` counts how many fell off the back, so a
+    postmortem reader knows the window is truncated). ``path``, when set,
+    is where :meth:`dump` writes by default — the engine dumps there
+    automatically on faults, drains, crashes, and ``close()``.
+    """
+
+    enabled = True
+
+    def __init__(
+        self,
+        capacity: int = 4096,
+        *,
+        path: Optional[str] = None,
+        clock: Callable[[], float] = time.perf_counter,
+    ):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.path = path
+        self._clock = clock
+        self._epoch = clock()
+        self._ring: "collections.deque[dict]" = collections.deque(
+            maxlen=self.capacity
+        )
+        self.recorded = 0
+        self.dropped = 0
+        self.dumps = 0
+
+    def record(self, kind: str, **fields) -> None:
+        """Append one event; O(1), drops the oldest event when full."""
+        if len(self._ring) == self.capacity:
+            self.dropped += 1
+        self.recorded += 1
+        event = {"kind": kind, "t": self._clock() - self._epoch}
+        event.update(fields)
+        self._ring.append(event)
+
+    def events(self) -> List[dict]:
+        """Snapshot of the ring, oldest first."""
+        return list(self._ring)
+
+    def dump(
+        self,
+        reason: str = "manual",
+        *,
+        path: Optional[str] = None,
+        extra: Optional[dict] = None,
+    ) -> dict:
+        """Serialize the ring as a postmortem document and (when a path is
+        known) write it atomically. Returns the document either way, so
+        callers about to SIGKILL themselves still get the dict."""
+        doc: Dict[str, object] = {
+            "version": DUMP_VERSION,
+            "reason": reason,
+            "dumped_at_s": self._clock() - self._epoch,
+            "recorded": self.recorded,
+            "dropped": self.dropped,
+            "capacity": self.capacity,
+            "events": self.events(),
+        }
+        if extra is not None:
+            doc["extra"] = extra
+        self.dumps += 1
+        target = path if path is not None else self.path
+        if target is not None:
+            parent = os.path.dirname(os.path.abspath(target))
+            os.makedirs(parent, exist_ok=True)
+            tmp = f"{target}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, default=str)
+            os.replace(tmp, target)
+        return doc
+
+
+def replay_to_tracer(dump: Union[dict, str], tracer=None):
+    """Rebuild a Perfetto-loadable trace from a postmortem dump.
+
+    ``dump`` may be the document dict, its JSON text, or a path to the
+    dump file. ``step`` events (which carry ``dur_s``) become complete
+    slices on the engine step track; everything else becomes an instant on
+    the phase track, so admit/preempt/evict/fault marks line up under the
+    step timeline exactly as a live trace would show them.
+
+    Returns the tracer (a fresh one unless passed in); call
+    ``to_perfetto()`` / ``save()`` on it for the Chrome trace-event JSON.
+    """
+    from distributed_pytorch_tpu.obs.tracer import _PID_ENGINE, Tracer
+
+    if isinstance(dump, str):
+        if os.path.exists(dump):
+            with open(dump) as f:
+                dump = json.load(f)
+        else:
+            dump = json.loads(dump)
+    if not isinstance(dump, dict) or "events" not in dump:
+        raise ValueError("not a flight-recorder dump: missing 'events'")
+    if tracer is None:
+        tracer = Tracer()
+    for event in dump["events"]:
+        kind = event.get("kind", "event")
+        t_us = float(event.get("t", 0.0)) * 1e6
+        args = {
+            k: v for k, v in event.items() if k not in ("kind", "t")
+        }
+        if kind == "step" and "dur_s" in event:
+            dur_us = float(event["dur_s"]) * 1e6
+            tracer.events.append(
+                {
+                    "name": "step",
+                    "cat": "flight",
+                    "ph": "X",
+                    "ts": t_us - dur_us,
+                    "dur": dur_us,
+                    "pid": _PID_ENGINE,
+                    "tid": 1,
+                    "args": args,
+                }
+            )
+        else:
+            tracer.events.append(
+                {
+                    "name": kind,
+                    "cat": "flight",
+                    "ph": "i",
+                    "s": "g",
+                    "ts": t_us,
+                    "pid": _PID_ENGINE,
+                    "tid": 0,
+                    "args": args,
+                }
+            )
+    return tracer

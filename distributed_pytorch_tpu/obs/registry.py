@@ -89,6 +89,8 @@ class MetricsRegistry:
         # name -> (resolver, label_key or None). The resolver returns a
         # ReservoirHistogram (label_key None) or a ReservoirGroup.
         self._reservoirs: Dict[str, tuple] = {}
+        # sanitized name -> HELP text for the Prometheus exposition.
+        self._help: Dict[str, str] = {}
 
     # --------------------------------------------------------- registration
 
@@ -102,25 +104,35 @@ class MetricsRegistry:
             raise ValueError(f"metric {name!r} already registered")
         return name
 
-    def counter(self, name: str) -> Counter:
+    def counter(self, name: str, help: str = "") -> Counter:
         """Create and register a push-style :class:`Counter`."""
         c = Counter()
-        self.counter_fn(name, lambda: c.value)
+        self.counter_fn(name, lambda: c.value, help=help)
         return c
 
-    def counter_fn(self, name: str, fn: Callable[[], float]) -> None:
+    def counter_fn(
+        self, name: str, fn: Callable[[], float], help: str = ""
+    ) -> None:
         """Register a pull-style counter: ``fn`` is read at snapshot time
         and must be monotonic over the owner's lifetime."""
-        self._counters[self._check_new(name)] = fn
+        name = self._check_new(name)
+        self._counters[name] = fn
+        if help:
+            self._help[name] = help
 
-    def gauge(self, name: str, value: float = 0.0) -> Gauge:
+    def gauge(self, name: str, value: float = 0.0, help: str = "") -> Gauge:
         """Create and register a push-style :class:`Gauge`."""
         g = Gauge(value)
-        self.gauge_fn(name, lambda: g.value)
+        self.gauge_fn(name, lambda: g.value, help=help)
         return g
 
-    def gauge_fn(self, name: str, fn: Callable[[], float]) -> None:
-        self._gauges[self._check_new(name)] = fn
+    def gauge_fn(
+        self, name: str, fn: Callable[[], float], help: str = ""
+    ) -> None:
+        name = self._check_new(name)
+        self._gauges[name] = fn
+        if help:
+            self._help[name] = help
 
     def reservoir(
         self,
@@ -131,18 +143,66 @@ class MetricsRegistry:
             Callable[[], Union[ReservoirHistogram, ReservoirGroup]],
         ],
         label: Optional[str] = None,
+        help: str = "",
     ) -> None:
         """Register a :class:`ReservoirHistogram` (``label=None``) or a
         :class:`ReservoirGroup` (``label`` names the label dimension, e.g.
         ``"source"``). Pass a zero-arg callable to re-resolve the object at
         snapshot time (survives owners that replace their metrics object)."""
         resolver = hist if callable(hist) else (lambda: hist)
-        self._reservoirs[self._check_new(name)] = (resolver, label)
+        name = self._check_new(name)
+        self._reservoirs[name] = (resolver, label)
+        if help:
+            self._help[name] = help
 
     # -------------------------------------------------------------- export
 
     def _qualified(self, name: str) -> str:
         return f"{self.namespace}_{name}" if self.namespace else name
+
+    def _resolve(self, name: str) -> str:
+        """Accept either the registered name or the namespace-qualified
+        one (as it appears in snapshots) — accessors take both."""
+        name = _sanitize(name)
+        prefix = f"{self.namespace}_" if self.namespace else ""
+        if (
+            prefix
+            and name.startswith(prefix)
+            and not (
+                name in self._counters
+                or name in self._gauges
+                or name in self._reservoirs
+            )
+        ):
+            return name[len(prefix):]
+        return name
+
+    def read_counter(self, name: str) -> float:
+        """Current value of a registered counter (by either name form)."""
+        return self._counters[self._resolve(name)]()
+
+    def read_gauge(self, name: str) -> float:
+        """Current value of a registered gauge (by either name form)."""
+        return self._gauges[self._resolve(name)]()
+
+    def read_quantile(
+        self, name: str, q: float, label_value: Optional[str] = None
+    ) -> float:
+        """Current quantile of a registered reservoir; ``label_value``
+        selects the series of a labeled group. NaN on empty reservoirs,
+        consistent with :meth:`ReservoirHistogram.quantile`."""
+        resolver, label = self._reservoirs[self._resolve(name)]
+        obj = resolver()
+        if label is not None:
+            if label_value is None:
+                raise ValueError(
+                    f"reservoir {name!r} is labeled by {label!r}; "
+                    "pass label_value"
+                )
+            if label_value not in obj.labels:
+                return float("nan")
+            obj = obj[label_value]
+        return obj.quantile(q)
 
     @staticmethod
     def _summary(hist: ReservoirHistogram) -> Dict[str, float]:
@@ -243,11 +303,34 @@ class MetricsRegistry:
             "reservoir_states": states,
         }
 
+    @staticmethod
+    def _escape_label(value: object) -> str:
+        """Escape a label VALUE per the exposition format: backslash,
+        double-quote, and newline must be backslash-escaped."""
+        return (
+            str(value)
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+
+    @staticmethod
+    def _escape_help(text: str) -> str:
+        """Escape HELP text: backslash and newline (quotes are legal)."""
+        return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
     def prometheus_text(self) -> str:
         """Prometheus text exposition (one scrape body). Reservoirs render
         as ``summary`` metrics: quantile-labeled samples plus ``_sum`` and
-        ``_count``; group labels become ordinary Prometheus labels."""
+        ``_count``; group labels become ordinary Prometheus labels. Every
+        metric gets ``# HELP`` / ``# TYPE`` headers and label values are
+        escaped, so real scrapers accept the body as-is."""
         lines: List[str] = []
+
+        def emit_head(name, qname, mtype):
+            text = self._help.get(name, qname)
+            lines.append(f"# HELP {qname} {self._escape_help(text)}")
+            lines.append(f"# TYPE {qname} {mtype}")
 
         def emit_summary(qname, hist, extra=""):
             for q in (0.5, 0.95, 0.99):
@@ -262,21 +345,22 @@ class MetricsRegistry:
 
         for name, fn in self._counters.items():
             qname = self._qualified(name)
-            lines.append(f"# TYPE {qname} counter")
+            emit_head(name, qname, "counter")
             lines.append(f"{qname} {fn()}")
         for name, fn in self._gauges.items():
             qname = self._qualified(name)
-            lines.append(f"# TYPE {qname} gauge")
+            emit_head(name, qname, "gauge")
             lines.append(f"{qname} {fn()}")
         for name, (resolver, label) in self._reservoirs.items():
             obj = resolver()
             qname = self._qualified(name)
-            lines.append(f"# TYPE {qname} summary")
+            emit_head(name, qname, "summary")
             if label is None:
                 emit_summary(qname, obj)
             else:
                 for value in obj.labels:
-                    emit_summary(
-                        qname, obj[value], extra=f'{label}="{value}",'
+                    extra = (
+                        f'{_sanitize(label)}="{self._escape_label(value)}",'
                     )
+                    emit_summary(qname, obj[value], extra=extra)
         return "\n".join(lines) + "\n"

@@ -1,0 +1,345 @@
+"""SLO burn-rate monitoring over the metrics registry.
+
+Declare objectives (:class:`SLObjective`) against metrics the registry
+already exports — latency objectives read a reservoir quantile (``TTFT
+p95 < 400ms``), rate objectives read a bad/total counter pair
+(``expired-rate < 1%``) — and feed :meth:`SLOMonitor.tick` once per
+engine step (the engine does this automatically when constructed with
+``slo=[...]``).
+
+Alerting is the SRE multi-window burn-rate recipe: each objective has an
+error *budget* (the tolerated bad fraction); the monitor computes the
+observed bad fraction over a short and a long sliding window and divides
+each by the budget to get a burn rate ("how many times faster than
+sustainable are we spending the budget"). The alert condition requires
+BOTH windows hot — ``burn_fast >= fast_burn AND burn_slow >= slow_burn``
+— so a single slow request can't page anyone (the long window hasn't
+accumulated) and a long-resolved incident stops alerting quickly (the
+short window has drained). Alerts fire on the rising edge and land in
+three places at once: a registry counter (``slo_<name>_alerts_total``),
+a tracer instant, and a flight-recorder event, so a postmortem dump
+shows exactly when the SLO went red relative to the engine timeline.
+
+Latency evaluation note: registry reservoirs are cumulative over the
+run, so each tick samples "is the quantile over threshold *now*" and the
+window aggregates those violation samples — a windowed violation ratio
+over a converging estimator, not per-window percentiles. That is the
+standard scrape-based approximation and exactly what a Prometheus
+recording rule over this exposition would see.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Deque, Dict, List, Optional, Sequence
+
+from distributed_pytorch_tpu.obs.flight import NULL_FLIGHT_RECORDER
+from distributed_pytorch_tpu.obs.tracer import NULL_TRACER
+
+
+@dataclass(frozen=True)
+class SLObjective:
+    """One declarative objective.
+
+    Latency form: set ``metric`` (a registry reservoir name), ``quantile``
+    and ``threshold_s`` — bad means "the quantile exceeds the threshold".
+    Rate form: set ``bad_counter`` and ``total_counter`` (registry counter
+    names) — bad fraction is the windowed delta ratio.
+
+    ``budget`` is the tolerated bad fraction (0.1 = 10% of ticks/requests
+    may be bad before the budget burns at rate 1.0). ``fast_burn`` /
+    ``slow_burn`` are the alerting thresholds over the ``fast_window_s`` /
+    ``slow_window_s`` sliding windows.
+    """
+
+    name: str
+    # latency objective
+    metric: Optional[str] = None
+    quantile: float = 0.95
+    threshold_s: Optional[float] = None
+    label: Optional[str] = None
+    # rate objective
+    bad_counter: Optional[str] = None
+    total_counter: Optional[str] = None
+    # budget + windows
+    budget: float = 0.1
+    fast_window_s: float = 5.0
+    slow_window_s: float = 60.0
+    fast_burn: float = 2.0
+    slow_burn: float = 1.0
+
+    def __post_init__(self):
+        latency = self.metric is not None
+        rate = self.bad_counter is not None
+        if latency == rate:
+            raise ValueError(
+                f"objective {self.name!r}: set exactly one of metric= "
+                "(latency) or bad_counter= (rate)"
+            )
+        if latency and self.threshold_s is None:
+            raise ValueError(
+                f"objective {self.name!r}: latency objective needs "
+                "threshold_s"
+            )
+        if rate and self.total_counter is None:
+            raise ValueError(
+                f"objective {self.name!r}: rate objective needs "
+                "total_counter"
+            )
+        if not (0.0 < self.budget <= 1.0):
+            raise ValueError(
+                f"objective {self.name!r}: budget must be in (0, 1]"
+            )
+        if self.fast_window_s > self.slow_window_s:
+            raise ValueError(
+                f"objective {self.name!r}: fast window must not exceed "
+                "the slow window"
+            )
+
+    @property
+    def kind(self) -> str:
+        return "latency" if self.metric is not None else "rate"
+
+
+class _Window:
+    """One sliding window advanced incrementally: O(1) amortized per
+    tick regardless of tick rate or window length. (A rescan-the-history
+    implementation makes every tick cost O(window_s / tick_interval) —
+    the monitor gets SLOWER the longer the engine runs, exactly the
+    observability tax the parity gate forbids.) Ticks must arrive in
+    nondecreasing time order, which the engine's step loop guarantees."""
+
+    __slots__ = ("window_s", "samples", "bad_sum", "base_bad", "base_total")
+
+    def __init__(self, window_s: float):
+        self.window_s = window_s
+        # latency: (t, bad 0/1). rate: (t, bad_cum, total_cum).
+        self.samples: Deque[tuple] = deque()
+        self.bad_sum = 0.0   # latency: running sum of retained 0/1 samples
+        self.base_bad = 0.0  # rate: cumulative counters at the sample just
+        self.base_total = 0.0  # BEFORE the oldest retained one (see below)
+
+    def push_latency(self, now: float, bad: float) -> float:
+        """Append one violation sample; return the window's bad fraction."""
+        self.samples.append((now, bad))
+        self.bad_sum += bad
+        cutoff = now - self.window_s
+        while self.samples[0][0] < cutoff:
+            self.bad_sum -= self.samples.popleft()[1]
+        return self.bad_sum / len(self.samples)
+
+    def push_rate(self, now: float, bad_cum: float, total_cum: float) -> float:
+        """Append one cumulative-counter sample; return the windowed delta
+        ratio. The baseline is the newest sample OLDER than the window
+        (counters start at zero, so the initial baseline is (0, 0)) — the
+        same convention a Prometheus ``increase()`` over this exposition
+        would use."""
+        self.samples.append((now, bad_cum, total_cum))
+        cutoff = now - self.window_s
+        while self.samples[0][0] < cutoff:
+            _, self.base_bad, self.base_total = self.samples.popleft()
+        d_total = total_cum - self.base_total
+        if d_total <= 0.0:
+            return 0.0
+        return max(0.0, bad_cum - self.base_bad) / d_total
+
+
+class _ObjectiveState:
+    """Per-objective sliding windows + alert edge state."""
+
+    __slots__ = (
+        "obj", "fast", "slow", "alerts", "g_fast", "g_slow", "g_firing",
+        "firing", "burn_fast", "burn_slow",
+    )
+
+    def __init__(self, obj, alerts, g_fast, g_slow, g_firing):
+        self.obj = obj
+        self.fast = _Window(obj.fast_window_s)
+        self.slow = _Window(obj.slow_window_s)
+        self.alerts = alerts
+        self.g_fast = g_fast
+        self.g_slow = g_slow
+        self.g_firing = g_firing
+        self.firing = False
+        self.burn_fast = 0.0
+        self.burn_slow = 0.0
+
+
+class SLOMonitor:
+    """Evaluates a set of :class:`SLObjective` against a registry.
+
+    Registers, per objective, ``slo_<name>_alerts_total`` plus
+    ``slo_<name>_burn_fast`` / ``_burn_slow`` / ``_firing`` gauges into
+    the same registry it reads, so one ``snapshot()`` carries both the
+    raw metrics and the verdicts. ``min_interval_s`` rate-limits
+    evaluation for callers that tick every step.
+    """
+
+    def __init__(
+        self,
+        registry,
+        objectives: Sequence[SLObjective],
+        *,
+        tracer=NULL_TRACER,
+        flight=NULL_FLIGHT_RECORDER,
+        clock: Callable[[], float] = time.perf_counter,
+        min_interval_s: float = 0.0,
+    ):
+        self.registry = registry
+        self.tracer = tracer
+        self.flight = flight
+        self._clock = clock
+        self.min_interval_s = float(min_interval_s)
+        self._last_tick: Optional[float] = None
+        self.ticks = 0
+        self._states: Dict[str, _ObjectiveState] = {}
+        for obj in objectives:
+            if obj.name in self._states:
+                raise ValueError(f"duplicate objective {obj.name!r}")
+            self._states[obj.name] = _ObjectiveState(
+                obj,
+                registry.counter(
+                    f"slo_{obj.name}_alerts_total",
+                    help=f"Burn-rate alerts fired for SLO {obj.name}",
+                ),
+                registry.gauge(
+                    f"slo_{obj.name}_burn_fast",
+                    help=f"Fast-window burn rate for SLO {obj.name}",
+                ),
+                registry.gauge(
+                    f"slo_{obj.name}_burn_slow",
+                    help=f"Slow-window burn rate for SLO {obj.name}",
+                ),
+                registry.gauge(
+                    f"slo_{obj.name}_firing",
+                    help=f"1 while SLO {obj.name} alert condition holds",
+                ),
+            )
+
+    @property
+    def objectives(self) -> List[SLObjective]:
+        return [s.obj for s in self._states.values()]
+
+    # ----------------------------------------------------------- evaluation
+
+    def tick(self, now: Optional[float] = None) -> List[str]:
+        """Sample every objective and evaluate the burn-rate condition.
+        Returns the names of objectives that FIRED this tick (rising
+        edges only)."""
+        if now is None:
+            now = self._clock()
+        if (
+            self._last_tick is not None
+            and self.min_interval_s > 0.0
+            and now - self._last_tick < self.min_interval_s
+        ):
+            return []
+        self._last_tick = now
+        self.ticks += 1
+        fired: List[str] = []
+        for state in self._states.values():
+            obj = state.obj
+            if obj.kind == "latency":
+                value = self.registry.read_quantile(
+                    obj.metric, obj.quantile, label_value=obj.label
+                )
+                bad = (
+                    1.0
+                    if (value == value and value > obj.threshold_s)
+                    else 0.0
+                )
+                frac_fast = state.fast.push_latency(now, bad)
+                frac_slow = state.slow.push_latency(now, bad)
+            else:
+                bad_cum = float(self.registry.read_counter(obj.bad_counter))
+                total_cum = float(
+                    self.registry.read_counter(obj.total_counter)
+                )
+                frac_fast = state.fast.push_rate(now, bad_cum, total_cum)
+                frac_slow = state.slow.push_rate(now, bad_cum, total_cum)
+            state.burn_fast = frac_fast / obj.budget
+            state.burn_slow = frac_slow / obj.budget
+            state.g_fast.set(state.burn_fast)
+            state.g_slow.set(state.burn_slow)
+            hot = (
+                state.burn_fast >= obj.fast_burn
+                and state.burn_slow >= obj.slow_burn
+            )
+            state.g_firing.set(1.0 if hot else 0.0)
+            if hot and not state.firing:
+                state.alerts.inc()
+                fired.append(obj.name)
+                # "objective_kind", not "kind": the flight recorder's
+                # event-kind slot is taken by "slo_alert" itself.
+                detail = {
+                    "objective": obj.name,
+                    "objective_kind": obj.kind,
+                    "burn_fast": round(state.burn_fast, 4),
+                    "burn_slow": round(state.burn_slow, 4),
+                }
+                self.tracer.instant("slo_alert", **detail)
+                self.flight.record("slo_alert", **detail)
+            state.firing = hot
+        return fired
+
+    def state(self) -> Dict[str, dict]:
+        """Current verdict per objective, for bench rows and stats()."""
+        out: Dict[str, dict] = {}
+        for name, st in self._states.items():
+            out[name] = {
+                "kind": st.obj.kind,
+                "burn_fast": st.burn_fast,
+                "burn_slow": st.burn_slow,
+                "firing": st.firing,
+                "alerts": st.alerts.value,
+            }
+        return out
+
+
+def default_serving_objectives(
+    *,
+    ttft_p95_s: float = 0.5,
+    tpot_p50_s: float = 0.05,
+    expired_budget: float = 0.05,
+    fast_window_s: float = 5.0,
+    slow_window_s: float = 60.0,
+) -> List[SLObjective]:
+    """A reasonable starter set wired to the serving engine's registry
+    names: TTFT p95, TPOT p50, and the expired-request rate."""
+    return [
+        SLObjective(
+            name="ttft_p95",
+            metric="ttft_seconds",
+            quantile=0.95,
+            threshold_s=ttft_p95_s,
+            budget=0.1,
+            fast_window_s=fast_window_s,
+            slow_window_s=slow_window_s,
+        ),
+        SLObjective(
+            name="tpot_p50",
+            metric="tpot_seconds",
+            quantile=0.5,
+            threshold_s=tpot_p50_s,
+            budget=0.1,
+            fast_window_s=fast_window_s,
+            slow_window_s=slow_window_s,
+        ),
+        SLObjective(
+            name="expired_rate",
+            bad_counter="requests_expired_total",
+            total_counter="admission_accepted_total",
+            budget=expired_budget,
+            fast_window_s=fast_window_s,
+            slow_window_s=slow_window_s,
+        ),
+    ]
+
+
+__all__ = [
+    "SLObjective",
+    "SLOMonitor",
+    "default_serving_objectives",
+]

@@ -86,6 +86,23 @@ class StepProfiler:
         if self._tracing:
             self._stop_trace()
 
+    def rewind(self, step: int) -> None:
+        """Reset the schedule to ``step`` — the elastic-restore path, where
+        a restart resumes from a snapshot taken BEFORE the current step
+        counter. A live trace whose window no longer covers ``step`` stops
+        cleanly (annotation closed first); a rewind back INTO the window
+        re-arms ``_maybe_transition`` so the trace starts again, writing a
+        second capture to the same logdir. Idempotent under
+        ``rewind(self._step)``."""
+        self._close_annotation()
+        self._step = int(step)
+        begin = self.trace_started_at
+        end = begin + self.active
+        if self._tracing and not (begin <= self._step < end):
+            self._stop_trace()
+        self._maybe_transition()
+        self._open_annotation()
+
     def _open_annotation(self) -> None:
         """Bracket the upcoming step's work in a StepTraceAnnotation named
         by the global step — only while the trace is live (annotations

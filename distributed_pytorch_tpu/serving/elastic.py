@@ -240,6 +240,15 @@ def drain_engine(engine, reason: str = "drain") -> EngineSnapshot:
         engine.tracer.instant(
             "drain", reason=reason, requests=len(snap.requests)
         )
+    if engine.flight.enabled:
+        engine.flight.record(
+            "drain", reason=reason, requests=len(snap.requests)
+        )
+        engine._dump_postmortem(f"drain:{reason}")
+    if engine.goodput is not None:
+        # In-process downtime clock: closed again by restore_engine when
+        # the same tracker survives (an in-process drain/restore cycle).
+        engine.goodput.note_drain()
     return snap
 
 
@@ -309,6 +318,11 @@ def restore_engine(engine, snapshot: EngineSnapshot) -> List[int]:
             )
             if rec.ttft_s is not None:
                 req.first_token_time = req.submit_time + rec.ttft_s
+            # Goodput: positions the dead engine had K/V for must be
+            # re-prefilled here — charge them to restore_reprefill (a
+            # prefix-cache re-match on re-admission shrinks the charge).
+            req.rework_until = rec.kv_committed
+            req.rework_kind = "restore_reprefill"
             engine.requests[rec.req_id] = req
             engine._keys[rec.req_id] = jax.random.PRNGKey(params.seed)
             engine.scheduler.add(req)
@@ -326,6 +340,10 @@ def restore_engine(engine, snapshot: EngineSnapshot) -> List[int]:
     engine.requests_recovered += len(restored)
     if tr.enabled:
         tr.instant("restore", requests=len(restored))
+    if engine.flight.enabled:
+        engine.flight.record("restore", requests=len(restored))
+    if engine.goodput is not None:
+        engine.goodput.note_restore()
     return restored
 
 
@@ -402,18 +420,26 @@ class DrainController:
         eng = self.engine
         finished: List[int] = []
         steps = 0
-        while eng.scheduler.has_work or eng._inflight is not None:
-            if self.drain_requested:
-                self.drain_now()
-                return finished
-            if steps >= max_steps:
-                raise RuntimeError(
-                    f"engine did not drain within {max_steps} steps"
-                )
-            finished.extend(eng.step())
-            steps += 1
-            if snapshot_every and steps % snapshot_every == 0:
-                self._write(snapshot_engine(eng))
+        try:
+            while eng.scheduler.has_work or eng._inflight is not None:
+                if self.drain_requested:
+                    self.drain_now()
+                    return finished
+                if steps >= max_steps:
+                    raise RuntimeError(
+                        f"engine did not drain within {max_steps} steps"
+                    )
+                finished.extend(eng.step())
+                steps += 1
+                if snapshot_every and steps % snapshot_every == 0:
+                    self._write(snapshot_engine(eng))
+        except BaseException as exc:
+            # Same last-gasp postmortem as InferenceEngine.run(): crashes
+            # escaping the drive loop leave a dump + trace behind.
+            flush = getattr(eng, "_flush_on_crash", None)
+            if flush is not None:
+                flush("exception", exc)
+            raise
         if self.drain_requested and not self.drained:
             # Notice arrived as the queue emptied: drain the (now idle)
             # engine so the caller still gets its snapshot + closed door.

@@ -86,6 +86,17 @@ from distributed_pytorch_tpu.generation import (
     truncate_logits,
 )
 from distributed_pytorch_tpu.obs import MetricsRegistry, Tracer
+from distributed_pytorch_tpu.obs.flight import (
+    NULL_FLIGHT_RECORDER,
+    FlightRecorder,
+)
+from distributed_pytorch_tpu.obs.goodput import (
+    GoodputTracker,
+    count_params,
+    peak_flops_per_chip,
+    transformer_decode_flops_per_token,
+)
+from distributed_pytorch_tpu.obs.slo import SLOMonitor, SLObjective
 from distributed_pytorch_tpu.obs.tracer import NULL_TRACER
 from distributed_pytorch_tpu.serving.admission import (
     AdmissionController,
@@ -190,6 +201,9 @@ class InferenceEngine:
         debug: bool = False,
         tracer: Optional[Tracer] = None,
         trace_path: Optional[str] = None,
+        flight: Optional[FlightRecorder] = None,
+        slo: Optional[Sequence[SLObjective]] = None,
+        goodput=None,
     ):
         if max_seq_len % page_size:
             raise ValueError(
@@ -298,8 +312,10 @@ class InferenceEngine:
             # Unsharded traces stay byte-identical: the label is only set
             # (and only serialized) for meshed engines.
             self.tracer.set_engine_label(f"mesh {self.mesh_fingerprint}")
+        self.flight = flight if flight is not None else NULL_FLIGHT_RECORDER
         self.allocator = PagedBlockAllocator(num_pages)
         self.allocator.tracer = self.tracer
+        self.allocator.flight = self.flight
         self.allocator.pool_names = self.pools.names
         self.prefix_cache = (
             PrefixCache(self.allocator, page_size) if prefix_cache else None
@@ -315,6 +331,7 @@ class InferenceEngine:
             gamma=self.gamma,
             debug=debug,
             tracer=self.tracer,
+            flight=self.flight,
         )
         self.admission = AdmissionController(
             max_queue=max_queue,
@@ -330,7 +347,31 @@ class InferenceEngine:
         self.requests_recovered = 0
         self.trace_path = trace_path
         self._closed = False
+        # Goodput accounting: ``goodput=True`` builds a tracker configured
+        # from the model's own dims (decode FLOPs-per-token at half the max
+        # context, peak FLOPs from the local device kind); pass a
+        # pre-configured GoodputTracker for full control. ``_acct`` is the
+        # per-step scratch dict the accounting wrapper threads through
+        # ``_step_impl`` — None whenever no step is being accounted.
+        if goodput is True:
+            self.goodput = self._default_goodput(model)
+        else:
+            self.goodput = goodput if goodput else None
+        self._acct: Optional[dict] = None
         self.registry = self._build_registry()
+        # SLO burn-rate monitoring reads the registry it writes its
+        # verdicts into, so one snapshot carries metrics AND alerts.
+        self.slo = (
+            SLOMonitor(
+                self.registry, slo, tracer=self.tracer, flight=self.flight
+            )
+            if slo
+            else None
+        )
+        # Flight-recorder postmortems must be written BEFORE an injected
+        # fault SIGKILLs the process: chaos notifies observers first.
+        if self.flight.enabled:
+            chaos.add_fault_observer(self._on_chaos_fault)
         self.requests: Dict[int, Request] = {}
         self._next_id = 0
         self._keys: Dict[int, jax.Array] = {}
@@ -355,6 +396,32 @@ class InferenceEngine:
         self._inflight: Optional[
             Tuple[jax.Array, List[int], List[Request]]
         ] = None
+
+    def _default_goodput(self, model) -> GoodputTracker:
+        """A :class:`GoodputTracker` configured from the engine's own
+        geometry: decode FLOPs-per-token from the analytic transformer
+        model at half the max context (the mean context of a sequence
+        decoded to the limit), peak FLOPs from the local device kind, and
+        the mesh's device count."""
+        n_params = count_params(self.params)
+        embed = getattr(model, "vocab_size", 0) * getattr(
+            model, "d_model", 0
+        )
+        n_heads = max(1, getattr(model, "n_heads", 1))
+        head_dim = getattr(model, "d_model", 0) // n_heads
+        fpt = transformer_decode_flops_per_token(
+            n_params=n_params,
+            embed_params=min(embed, n_params),
+            n_layers=getattr(model, "n_layers", 0),
+            n_heads=n_heads,
+            head_dim=head_dim,
+            context_len=self.max_seq_len // 2,
+        )
+        return GoodputTracker(
+            flops_per_token=fpt,
+            peak_flops_per_device=peak_flops_per_chip(jax.devices()[0]),
+            n_devices=max(1, self._data_size * self._model_size),
+        )
 
     def _build_registry(self) -> MetricsRegistry:
         """Every serving metric registered into one ``serving_``-namespaced
@@ -417,6 +484,25 @@ class InferenceEngine:
             "sharded_program_count", lambda: self._sharded_programs
         )
         reg.gauge_fn(f"mesh_{self.mesh_fingerprint}_info", lambda: 1.0)
+        if self.goodput is not None:
+            self.goodput.register_into(reg)
+        if self.flight.enabled:
+            fl = self.flight
+            reg.counter_fn(
+                "flight_events_recorded_total",
+                lambda: fl.recorded,
+                help="Events appended to the flight-recorder ring",
+            )
+            reg.counter_fn(
+                "flight_events_dropped_total",
+                lambda: fl.dropped,
+                help="Events that fell off the back of the ring",
+            )
+            reg.counter_fn(
+                "flight_dumps_total",
+                lambda: fl.dumps,
+                help="Postmortem dumps written",
+            )
         return reg
 
     # Pool accessors: the target pool keeps its historical ``self.cache``
@@ -806,6 +892,12 @@ class InferenceEngine:
             len(plan.decode_slots) * cost
         )
         pages = self.allocator.counters()
+        extra = {}
+        if self.goodput is not None:
+            # One counter track per trace: the goodput fraction as of the
+            # PREVIOUS step's accounting (this step's feed lands after the
+            # slice closes).
+            extra["goodput_fraction"] = self.goodput.fraction()
         self.tracer.end_step(
             decode_rows=len(plan.decode_slots),
             prefill_chunks=len(plan.prefill),
@@ -816,13 +908,91 @@ class InferenceEngine:
             pages_free=pages["pages_free"],
             pages_referenced=pages["pages_referenced"],
             pages_cached_idle=pages["pages_cached_idle"],
+            **extra,
         )
 
     def step(self) -> List[int]:
         """Run one engine iteration; returns ids of requests that FINISHED
         during it (under overlap, a finish surfaces on the step after its
         token was dispatched). A no-op (empty list) when nothing is queued,
-        running, or in flight."""
+        running, or in flight.
+
+        With goodput accounting, an SLO monitor, or a flight recorder
+        attached, the step is wrapped in wall-clock attribution (see
+        :meth:`_account_step`); none of it touches device work or
+        scheduling decisions, so outputs stay bitwise-identical (pinned
+        by the obs-parity bench gate)."""
+        if (
+            self.goodput is None
+            and self.slo is None
+            and not self.flight.enabled
+        ):
+            return self._step_impl()
+        t0 = time.perf_counter()
+        self._acct = {
+            "plan": None, "rework": None, "emitted": 0, "proposed": 0,
+        }
+        try:
+            finished = self._step_impl()
+        finally:
+            acct, self._acct = self._acct, None
+        self._account_step(acct, time.perf_counter() - t0, finished)
+        return finished
+
+    def _account_step(self, acct, dt_s: float, finished: List[int]) -> None:
+        """Post-step bookkeeping: feed the goodput tracker, append the
+        flight-recorder step record, tick the SLO monitor."""
+        plan = acct["plan"]
+        prefill_tokens = decode_rows = 0
+        if plan is not None:
+            prefill_tokens = sum(chunk for _s, chunk in plan.prefill)
+            decode_rows = len(plan.decode_slots)
+        if self.speculative:
+            decode_positions = acct["proposed"]
+            emitted = acct["emitted"]
+        else:
+            decode_positions = emitted = decode_rows
+        queue_depth = self.scheduler.num_waiting
+        if self.goodput is not None:
+            self.goodput.note_step(
+                dt_s,
+                prefill_tokens=prefill_tokens,
+                decode_positions=decode_positions,
+                emitted_tokens=emitted,
+                spec_proposed=acct["proposed"],
+                rework=acct["rework"],
+                budget_used=prefill_tokens + decode_positions,
+                token_budget=self.scheduler.token_budget,
+                queue_depth=queue_depth,
+            )
+        if self.flight.enabled:
+            self.flight.record(
+                "step",
+                step=self.metrics.engine_steps,
+                dur_s=dt_s,
+                prefill_tokens=prefill_tokens,
+                decode_rows=decode_rows,
+                emitted_tokens=emitted,
+                queue_depth=queue_depth,
+                running=len(self.scheduler.running),
+                finished=len(finished),
+            )
+        if self.slo is not None:
+            self.slo.tick()
+
+    def _note_rework(self, req, start: int, chunk: int) -> None:
+        """Charge the prefill positions below ``req.rework_until`` — K/V
+        the engine had already computed before a preemption or restore —
+        to the request's waste bucket. Called only while accounting."""
+        rw = min(start + chunk, req.rework_until) - start
+        if rw <= 0:
+            return
+        rework = self._acct["rework"]
+        if rework is None:
+            rework = self._acct["rework"] = {}
+        rework[req.rework_kind] = rework.get(req.rework_kind, 0) + rw
+
+    def _step_impl(self) -> List[int]:
         chaos.on_serving_phase(
             "step", queue_depth=self.scheduler.num_waiting
         )
@@ -830,6 +1000,8 @@ class InferenceEngine:
         tr.begin_step()
         with tr.phase("schedule"):
             plan = self.scheduler.schedule()
+        if self._acct is not None:
+            self._acct["plan"] = plan
 
         if plan.copies:
             with tr.phase("cow"):
@@ -864,6 +1036,8 @@ class InferenceEngine:
                 for slot, chunk in plan.prefill:
                     req = self.scheduler.slots[slot]
                     start = req.len_cached
+                    if self._acct is not None and req.rework_until > start:
+                        self._note_rework(req, start, chunk)
                     tok = np.asarray(
                         [req.tokens[start : start + chunk]], np.int32
                     )
@@ -1004,6 +1178,8 @@ class InferenceEngine:
                 for slot, chunk in plan.prefill:
                     req = self.scheduler.slots[slot]
                     start = req.len_cached
+                    if self._acct is not None and req.rework_until > start:
+                        self._note_rework(req, start, chunk)
                     tok = np.asarray(
                         [req.tokens[start : start + chunk]], np.int32
                     )
@@ -1031,6 +1207,9 @@ class InferenceEngine:
                 for slot, req in slot_reqs:
                     accepted = int(n_acc_host[slot])
                     n_emit = min(accepted + 1, self.gamma)
+                    if self._acct is not None:
+                        self._acct["emitted"] += n_emit
+                        self._acct["proposed"] += self.gamma
                     toks = [int(t) for t in emitted_host[slot, :n_emit]]
                     before = req.n_generated
                     done = self.scheduler.resolve_spec(req, toks, now=now)
@@ -1103,13 +1282,55 @@ class InferenceEngine:
 
         return drain_engine(self)
 
+    # --------------------------------------------------------- postmortems
+
+    def _dump_postmortem(self, reason: str):
+        """Write the flight-recorder ring (plus a goodput report and a
+        registry snapshot) as a postmortem document. No-op without a
+        recorder; never raises — a failed postmortem must not mask the
+        failure being documented."""
+        if not self.flight.enabled:
+            return None
+        try:
+            extra = {}
+            if self.goodput is not None:
+                extra["goodput"] = self.goodput.report()
+            extra["registry"] = self.registry.snapshot()
+            return self.flight.dump(reason, extra=extra)
+        except Exception:
+            return None
+
+    def _on_chaos_fault(self, kind: str, step: int, mode: str) -> None:
+        """Chaos fault observer — runs BEFORE the fault signal/raise, so
+        the dump survives even a SIGKILL drill."""
+        self.flight.record(
+            "chaos_fault", fault_kind=kind, step=step, mode=mode
+        )
+        self._dump_postmortem(f"chaos:{kind}")
+
+    def _flush_on_crash(self, reason: str, exc: BaseException) -> None:
+        """Last-gasp flush for unhandled exceptions escaping the engine
+        loop: record the exception, dump the postmortem, save the trace.
+        Every step is best-effort — the original exception re-raises."""
+        if self.flight.enabled:
+            self.flight.record(
+                "exception", reason=reason, error=repr(exc)
+            )
+        self._dump_postmortem(reason)
+        if self.tracer.enabled and self.trace_path:
+            try:
+                self.tracer.save(self.trace_path)
+            except Exception:
+                pass
+
     def close(self) -> None:
         """Deterministic teardown: resolve the in-flight overlapped
         dispatch (no dangling device readback), stop admission, cancel
         every non-terminal request (pages back to the allocator), assert
-        via the allocator gauges that zero pages leaked, and flush the
-        tracer to ``trace_path`` when one was configured. Idempotent; runs
-        automatically on ``with InferenceEngine(...) as eng:`` exit."""
+        via the allocator gauges that zero pages leaked, dump the flight
+        recorder, and flush the tracer to ``trace_path`` when one was
+        configured. Idempotent; runs automatically on
+        ``with InferenceEngine(...) as eng:`` exit."""
         if self._closed:
             return
         self.finish_inflight()
@@ -1118,6 +1339,9 @@ class InferenceEngine:
             self.scheduler.cancel(req)
         self._closed = True
         self.allocator.assert_quiescent()
+        if self.flight.enabled:
+            chaos.remove_fault_observer(self._on_chaos_fault)
+            self._dump_postmortem("close")
         if self.tracer.enabled and self.trace_path:
             self.tracer.save(self.trace_path)
 
@@ -1131,18 +1355,24 @@ class InferenceEngine:
     def run(self, max_steps: int = 10_000) -> List[int]:
         """Drive :meth:`step` until the engine drains; returns every
         request id finished along the way. ``max_steps`` bounds a scheduling
-        bug to a loud failure instead of a hang."""
+        bug to a loud failure instead of a hang. An exception escaping the
+        loop flushes the tracer and dumps the flight recorder before
+        re-raising — crashes leave a postmortem, not just a traceback."""
         finished: List[int] = []
         steps = 0
-        while self.scheduler.has_work or self._inflight is not None:
-            if steps >= max_steps:
-                raise RuntimeError(
-                    f"engine did not drain within {max_steps} steps "
-                    f"({self.scheduler.num_waiting} waiting, "
-                    f"{len(self.scheduler.running)} running)"
-                )
-            finished.extend(self.step())
-            steps += 1
+        try:
+            while self.scheduler.has_work or self._inflight is not None:
+                if steps >= max_steps:
+                    raise RuntimeError(
+                        f"engine did not drain within {max_steps} steps "
+                        f"({self.scheduler.num_waiting} waiting, "
+                        f"{len(self.scheduler.running)} running)"
+                    )
+                finished.extend(self.step())
+                steps += 1
+        except BaseException as exc:
+            self._flush_on_crash("exception", exc)
+            raise
         return finished
 
     def stats(self) -> Dict[str, float]:
@@ -1163,6 +1393,15 @@ class InferenceEngine:
         out["page_evictions"] = self.allocator.evictions
         if self.prefix_cache is not None:
             out.update(self.prefix_cache.stats())
+        if self.goodput is not None:
+            gp = self.goodput.report()
+            out["goodput_fraction"] = gp["goodput_fraction"]
+            out["goodput_productive_s"] = gp["productive_s"]
+            out["goodput_wasted_s"] = gp["wasted_total_s"]
+            out["goodput_mfu"] = gp["mfu"]
+            out["goodput_tokens_per_sec_per_device"] = gp[
+                "tokens_per_sec_per_device"
+            ]
         return out
 
     def save_trace(self, path: str) -> str:

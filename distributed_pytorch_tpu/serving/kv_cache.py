@@ -105,11 +105,14 @@ class PagedBlockAllocator:
         self._n_free = num_pages - 1
         self._n_referenced = 0
         self._n_idle = 0
-        # Optional tracer (duck-typed; NULL by default) so page evictions
-        # surface as instant events on the engine timeline.
+        # Optional tracer / flight recorder (duck-typed; NULL by default)
+        # so page evictions surface on the engine timeline and in
+        # postmortem dumps.
+        from distributed_pytorch_tpu.obs.flight import NULL_FLIGHT_RECORDER
         from distributed_pytorch_tpu.obs.tracer import NULL_TRACER
 
         self.tracer = NULL_TRACER
+        self.flight = NULL_FLIGHT_RECORDER
 
     @property
     def num_free(self) -> int:
@@ -154,6 +157,7 @@ class PagedBlockAllocator:
         if self.evict_hook is not None:
             self.evict_hook(page)
         self.tracer.instant("page_evict", page=page)
+        self.flight.record("page_evict", page=page)
         self._free.append(page)
         self._n_free += 1
 

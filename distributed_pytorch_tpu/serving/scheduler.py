@@ -68,6 +68,7 @@ import enum
 import time
 from typing import List, Optional, Tuple
 
+from distributed_pytorch_tpu.obs.flight import NULL_FLIGHT_RECORDER
 from distributed_pytorch_tpu.obs.tracer import NULL_TRACER
 from distributed_pytorch_tpu.serving.kv_cache import (
     BlockTable,
@@ -157,6 +158,11 @@ class Request:
     # through the elastic snapshot/restore codec, so routing/billing context
     # survives an engine migration. Must be JSON-serializable to snapshot.
     metadata: Optional[dict] = None
+    # Goodput accounting: prefill positions below this mark re-compute K/V
+    # the engine already had (lost to preemption or a snapshot/restore);
+    # ``rework_kind`` names the waste bucket they charge to.
+    rework_until: int = 0
+    rework_kind: str = "preempt_rework"
 
     def __post_init__(self):
         if not self.tokens:
@@ -226,6 +232,7 @@ class Scheduler:
         gamma: int = 0,
         debug: bool = False,
         tracer=NULL_TRACER,
+        flight=NULL_FLIGHT_RECORDER,
     ):
         if token_budget < 1:
             raise ValueError(f"token_budget must be >= 1, got {token_budget}")
@@ -246,6 +253,7 @@ class Scheduler:
         self.gamma = gamma
         self.debug = debug
         self.tracer = tracer
+        self.flight = flight
         self.waiting: List[Request] = []  # kept sorted by req_id
         self.slots: List[Optional[Request]] = [None] * max_slots
         self.preemptions = 0
@@ -311,6 +319,14 @@ class Scheduler:
                 hit=req.len_cached > 0,
                 readmission=req.preempt_count > 0,
             )
+        if self.flight.enabled:
+            self.flight.record(
+                "admit",
+                req_id=req.req_id,
+                slot=slot,
+                cached_tokens=req.len_cached,
+                readmission=req.preempt_count > 0,
+            )
 
     def _preempt(self, req: Request) -> None:
         """Evict ``req`` back to the waiting queue: page refs dropped
@@ -318,9 +334,19 @@ class Scheduler:
         usually re-matches them), generated tokens KEPT."""
         self.preemptions += 1
         req.preempt_count += 1
+        # Positions up to len_cached must be re-prefilled on re-admission;
+        # a later prefix-cache re-match shrinks the actual rework charged.
+        req.rework_until = max(req.rework_until, req.len_cached)
         if self.tracer.enabled:
             self.tracer.request_event(
                 req.req_id, "preempt",
+                n_generated=req.n_generated,
+                pages_released=len(req.table.pages),
+            )
+        if self.flight.enabled:
+            self.flight.record(
+                "preempt",
+                req_id=req.req_id,
                 n_generated=req.n_generated,
                 pages_released=len(req.table.pages),
             )
@@ -364,6 +390,13 @@ class Scheduler:
                 n_generated=req.n_generated,
                 preempt_count=req.preempt_count,
             )
+        if self.flight.enabled:
+            self.flight.record(
+                "retire",
+                req_id=req.req_id,
+                n_generated=req.n_generated,
+                preempt_count=req.preempt_count,
+            )
 
     def cancel(
         self,
@@ -400,6 +433,13 @@ class Scheduler:
         if self.tracer.enabled:
             self.tracer.request_end(
                 req.req_id,
+                terminal=state.value,
+                n_generated=req.n_generated,
+            )
+        if self.flight.enabled:
+            self.flight.record(
+                "cancel",
+                req_id=req.req_id,
                 terminal=state.value,
                 n_generated=req.n_generated,
             )

@@ -105,6 +105,82 @@ class TestStepProfiler:
         assert tracing_at == [False] * 2 + [True] * 2 + [False] * 2
         assert profiler._annotation is None
 
+    def test_rewind_mid_window_keeps_trace_alive(self, tmp_path):
+        """An elastic restore that rewinds INSIDE the live window must not
+        stop the trace; the schedule closes it at the original end step of
+        the replayed timeline."""
+        profiler = StepProfiler(
+            str(tmp_path / "log"), wait=1, warmup=1, active=4
+        )  # window is steps [2, 6)
+        step = jax.jit(lambda x: (x * 2.0).sum())
+        profiler.start()
+        for i in range(4):  # lands at step 4, mid-window
+            jax.block_until_ready(step(jnp.arange(8.0) + i))
+            profiler.step()
+        assert profiler._tracing
+        profiler.rewind(3)  # restore inside the window: keep tracing
+        assert profiler._tracing
+        assert profiler._annotation is not None
+        profiler.rewind(profiler._step)  # idempotent under a no-op rewind
+        assert profiler._tracing
+        tracing_at = []
+        for i in range(4):
+            jax.block_until_ready(step(jnp.arange(8.0) + i))
+            tracing_at.append(profiler._tracing)
+            profiler.step()
+        profiler.stop()
+        # replayed steps 3..5 traced, 6 past the window end
+        assert tracing_at == [True, True, True, False]
+        assert profiler._annotation is None
+
+    def test_rewind_after_window_rearms_trace(self, tmp_path):
+        """A restore that rewinds back INTO an already-closed window
+        re-arms the schedule: the trace starts again and closes at the
+        window end a second time."""
+        logdir = str(tmp_path / "log")
+        profiler = StepProfiler(logdir, wait=0, warmup=1, active=2)
+        step = jax.jit(lambda x: (x * 2.0).sum())
+        profiler.start()
+        for i in range(5):  # window [1, 3) opens and closes
+            jax.block_until_ready(step(jnp.arange(8.0) + i))
+            profiler.step()
+        assert not profiler._tracing
+        assert any(p.endswith(".xplane.pb") for p in trace_files(logdir))
+        profiler.rewind(1)  # snapshot resume from inside the window
+        assert profiler._tracing, "rewind into the window must re-arm"
+        tracing_at = []
+        for i in range(4):
+            jax.block_until_ready(step(jnp.arange(8.0) + i))
+            tracing_at.append(profiler._tracing)
+            profiler.step()
+        profiler.stop()
+        assert tracing_at == [True, True, False, False]
+        assert not profiler._tracing
+
+    def test_rewind_before_window_stops_trace_cleanly(self, tmp_path):
+        """A restore to a step BEFORE the window stops a live trace (and
+        its annotation) immediately; the replayed timeline re-enters the
+        window at the original begin step."""
+        profiler = StepProfiler(
+            str(tmp_path / "log"), wait=2, warmup=1, active=2
+        )  # window [3, 5)
+        step = jax.jit(lambda x: (x * 2.0).sum())
+        profiler.start()
+        for i in range(4):  # step 4: tracing
+            jax.block_until_ready(step(jnp.arange(8.0) + i))
+            profiler.step()
+        assert profiler._tracing
+        profiler.rewind(0)  # snapshot predates the window
+        assert not profiler._tracing
+        assert profiler._annotation is None, "annotation leaked past rewind"
+        tracing_at = []
+        for i in range(6):
+            jax.block_until_ready(step(jnp.arange(8.0) + i))
+            tracing_at.append(profiler._tracing)
+            profiler.step()
+        profiler.stop()
+        assert tracing_at == [False] * 3 + [True, True, False]
+
     def test_trace_contains_step_ops(self, tmp_path):
         """The captured trace is parseable and non-trivial: it contains
         XLA execution events from the profiled steps."""

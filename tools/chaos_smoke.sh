@@ -9,7 +9,12 @@
 # The serving scenario SIGKILLs an inference engine mid-verify under queue
 # pressure (6 requests, 2 slots), restores the last rolling snapshot into a
 # fresh process, and asserts every admitted request completes with greedy
-# output token-identical to an uninterrupted reference run.
+# output token-identical to an uninterrupted reference run. The engine runs
+# with the flight recorder + goodput accounting on: the chaos fault dumps a
+# postmortem BEFORE the SIGKILL lands (validated here by replaying it into
+# a Chrome trace-event document, then preserved as
+# traces/chaos_postmortem.json for the CI artifact upload), and the
+# restored engine must attribute nonzero wasted time to restore re-prefill.
 #
 # What it proves (the full failure-model matrix of docs/ARCHITECTURE.md in
 # one pass):
@@ -55,6 +60,7 @@ import jax
 import jax.numpy as jnp
 
 from distributed_pytorch_tpu.models.transformer import TransformerLM
+from distributed_pytorch_tpu.obs import FlightRecorder
 from distributed_pytorch_tpu.serving import (
     EngineSnapshot,
     InferenceEngine,
@@ -74,10 +80,17 @@ def build():
     params = model.init(
         jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
     )["params"]
-    # 2 slots under 6 requests: real queue pressure at the fault.
+    # 2 slots under 6 requests: real queue pressure at the fault. The
+    # flight recorder dumps its ring to postmortem.json the instant the
+    # chaos fault fires — BEFORE the SIGKILL lands — and goodput
+    # accounting attributes the restore-side re-prefill as rework.
     return InferenceEngine(model, params, max_slots=2, max_seq_len=32,
                            page_size=4, token_budget=16,
-                           max_prefill_chunk=8, debug=True)
+                           max_prefill_chunk=8, debug=True,
+                           flight=FlightRecorder(
+                               capacity=2048, path="postmortem.json"
+                           ),
+                           goodput=True)
 
 
 eng = build()
@@ -112,6 +125,8 @@ else:
     print(json.dumps(
         {"restored": {str(i): eng.poll(i).generated for i in restored}}
     ))
+    # Goodput must charge the snapshot-replay prefill as restore rework.
+    print(json.dumps({"goodput": eng.goodput.report()}))
 EOF
 
   SERVE_ENV=("PYTHONPATH=$REPO" "JAX_PLATFORMS=cpu")
@@ -128,6 +143,39 @@ EOF
   grep -q "SIGKILL self mid-verify" run.log || fail "kill_mid_verify never fired"
   grep -q "RUN-COMPLETED" run.log && fail "engine outlived its kill"
   [ -e snap.json ] || fail "no rolling snapshot left behind"
+  [ -e postmortem.json ] || fail "no flight-recorder postmortem dump (the fault observer must dump BEFORE the SIGKILL)"
+
+  # The postmortem must replay into a valid Chrome trace-event document.
+  env "${SERVE_ENV[@]}" python - <<'EOF'
+import json
+
+from distributed_pytorch_tpu.obs import replay_to_tracer
+
+dump = json.load(open("postmortem.json"))
+assert dump["reason"] == "chaos:kill_mid_verify", dump["reason"]
+assert dump["events"], "postmortem ring buffer is empty"
+kinds = {e["kind"] for e in dump["events"]}
+assert "chaos_fault" in kinds, f"no chaos_fault event in dump: {kinds}"
+assert "step" in kinds, f"no engine step records in dump: {kinds}"
+assert "admit" in kinds, f"no scheduler admit records in dump: {kinds}"
+assert "registry" in dump.get("extra", {}), "postmortem lost the registry snapshot"
+
+doc = json.loads(json.dumps(replay_to_tracer(dump).to_perfetto()))
+events = doc["traceEvents"]
+assert isinstance(events, list) and events, "replay produced no trace events"
+assert any(
+    ev.get("ph") == "X" and ev.get("name") == "step" for ev in events
+), "replayed trace has no step slices"
+assert any(
+    ev.get("ph") == "i" and ev.get("name") == "chaos_fault" for ev in events
+), "replayed trace has no chaos_fault instant"
+print(f"[chaos_smoke] postmortem: {len(dump['events'])} events "
+      f"(reason={dump['reason']}) -> {len(events)} trace events, replay OK")
+EOF
+
+  # Preserve the postmortem for the CI artifact upload (WORK is wiped).
+  mkdir -p "$REPO/traces"
+  cp postmortem.json "$REPO/traces/chaos_postmortem.json"
 
   env "${SERVE_ENV[@]}" python drill.py restore snap.json > restore.log
   echo "--- run.log";     cat run.log
@@ -140,16 +188,18 @@ ref = {}
 for line in open("ref.log"):
     if line.startswith("{"):
         ref = {int(k): v for k, v in json.loads(line)["ref"].items()}
-pre_fault, restored = {}, {}
+pre_fault, restored, goodput = {}, {}, {}
 for line in open("run.log"):
     if line.startswith("{"):
         rec = json.loads(line)
         pre_fault[rec["finished"]] = rec["generated"]
 for line in open("restore.log"):
     if line.startswith("{"):
-        restored = {
-            int(k): v for k, v in json.loads(line)["restored"].items()
-        }
+        rec = json.loads(line)
+        if "restored" in rec:
+            restored = {int(k): v for k, v in rec["restored"].items()}
+        if "goodput" in rec:
+            goodput = rec["goodput"]
 if set(pre_fault) | set(restored) != set(ref):
     sys.exit(f"lost requests: ref={sorted(ref)} pre-fault="
              f"{sorted(pre_fault)} restored={sorted(restored)}")
@@ -157,9 +207,15 @@ for i, want in ref.items():
     got = restored.get(i, pre_fault.get(i))
     if got != want:
         sys.exit(f"request {i} diverged: {got} != {want}")
+# The restored engine re-prefills every snapshotted request's committed
+# tokens — goodput must attribute that wall-clock as restore rework.
+reprefill = goodput.get("wasted_s", {}).get("restore_reprefill", 0.0)
+if not reprefill > 0.0:
+    sys.exit(f"goodput charged no restore_reprefill rework: {goodput}")
 print(f"[chaos_smoke] serving: {len(restored)} restored + "
       f"{len(set(pre_fault) - set(restored))} pre-fault finishes, "
-      "all token-identical to the uninterrupted run")
+      "all token-identical to the uninterrupted run; "
+      f"restore_reprefill waste {reprefill * 1e3:.2f} ms")
 EOF
 
   echo "[chaos_smoke] PASS (serving)"

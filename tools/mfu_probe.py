@@ -165,8 +165,12 @@ def probe_resnet(args):
     import numpy as np
     import optax
 
-    from bench import compile_with_flops, peak_flops_per_chip
+    from bench import compile_with_flops
     from distributed_pytorch_tpu.models import ResNet50
+    from distributed_pytorch_tpu.obs.goodput import (
+        peak_flops_per_chip,
+        resnet50_train_flops,
+    )
     from distributed_pytorch_tpu.training.losses import softmax_cross_entropy_loss
     from distributed_pytorch_tpu.training.train_step import (
         create_train_state,
@@ -183,7 +187,7 @@ def probe_resnet(args):
     step_fn = make_train_step(model.apply, optimizer, softmax_cross_entropy_loss)
     device_batch = jax.device_put((x, y))
     compiled, flops = compile_with_flops(step_fn, state, device_batch)
-    flops = flops or 3 * 4.09e9 * batch
+    flops = flops or resnet50_train_flops(batch)
     _, nbytes = cost_summary(compiled, f"resnet50_b{batch}")
 
     logdir = args.logdir or f"traces/resnet50_b{batch}"
@@ -198,8 +202,13 @@ def probe_lm(args):
     import numpy as np
     import optax
 
-    from bench import compile_with_flops, peak_flops_per_chip
+    from bench import compile_with_flops
     from distributed_pytorch_tpu.models.transformer import TransformerLM
+    from distributed_pytorch_tpu.obs.goodput import (
+        count_params,
+        peak_flops_per_chip,
+        transformer_train_flops,
+    )
     from distributed_pytorch_tpu.training.losses import softmax_cross_entropy_loss
     from distributed_pytorch_tpu.training.train_step import (
         create_train_state,
@@ -227,6 +236,18 @@ def probe_lm(args):
         step_fn = make_train_step(model.apply, optimizer, softmax_cross_entropy_loss)
     device_batch = jax.device_put((x, y))
     compiled, flops = compile_with_flops(step_fn, state, device_batch)
+    # When XLA won't report a cost analysis, fall back to the same analytic
+    # PaLM-style formula bench.py and the serving engine use (obs.goodput is
+    # the single source of truth for the FLOPs model).
+    flops = flops or transformer_train_flops(
+        n_params=count_params(state.params),
+        embed_params=vocab * d_model,
+        n_layers=n_layers,
+        n_heads=n_heads,
+        head_dim=d_model // n_heads,
+        seq_len=seq,
+        batch=batch,
+    )
     _, nbytes = cost_summary(compiled, f"lm_t{seq}")
 
     logdir = args.logdir or f"traces/lm_t{seq}"

@@ -323,4 +323,48 @@ print(
     f"{len(begins)} request spans == {n_done} completed, "
     f"{len(events)} trace events -> {trace_path}"
 )
+
+# ---- scenario 5: a deliberately-tight SLO must fire its alert counter ----
+# Two objectives on the same engine: tpot_tight (threshold 1ns — every
+# sample breaches, burn = 1/budget >> both burn thresholds) MUST fire;
+# tpot_loose (threshold 1s — a CPU microbench never breaches) MUST stay
+# quiet. The alert lands in the registry, so it survives into scrapes.
+from distributed_pytorch_tpu.obs import SLObjective
+
+eng5 = InferenceEngine(
+    model, params, max_slots=4, max_seq_len=32, page_size=4,
+    token_budget=16, max_prefill_chunk=8,
+    slo=[
+        SLObjective(
+            name="tpot_tight", metric="tpot_seconds", quantile=0.5,
+            threshold_s=1e-9, fast_window_s=0.5, slow_window_s=2.0,
+        ),
+        SLObjective(
+            name="tpot_loose", metric="tpot_seconds", quantile=0.5,
+            threshold_s=1.0, fast_window_s=0.5, slow_window_s=2.0,
+        ),
+    ],
+)
+ids5 = [eng5.submit(p, SamplingParams(max_new_tokens=6)) for p in prompts4]
+eng5.run()
+assert all(eng5.poll(r).finished for r in ids5)
+
+snap5 = eng5.registry.snapshot()["counters"]
+assert snap5["serving_slo_tpot_tight_alerts_total"] >= 1, (
+    f"tight SLO never fired: {eng5.slo.state()}"
+)
+assert snap5["serving_slo_tpot_loose_alerts_total"] == 0, (
+    f"loose SLO fired spuriously: {eng5.slo.state()}"
+)
+state5 = eng5.slo.state()
+assert state5["tpot_tight"]["firing"], state5
+prom5 = eng5.registry.prometheus_text()
+assert "# TYPE serving_slo_tpot_tight_alerts_total counter" in prom5
+
+print(
+    "[serving_smoke] PASS: SLO scenario, tight objective fired "
+    f"{int(snap5['serving_slo_tpot_tight_alerts_total'])} alert(s) "
+    f"(burn_fast={state5['tpot_tight']['burn_fast']:.1f}), "
+    "loose objective quiet"
+)
 EOF

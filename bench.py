@@ -30,6 +30,7 @@ import argparse
 import itertools
 import json
 import os
+import threading
 import time
 
 # The analytic FLOPs model (peak table, transformer/ResNet formulas) lives
@@ -535,12 +536,18 @@ def bench_serving(
     warm_rng = np.random.default_rng(seed + 1)
 
     def run_pass(prefix_caching: bool, spec: bool = False,
-                 trace: bool = False, obs_full: bool = False, mesh=None):
+                 trace: bool = False, obs_full: bool = False,
+                 serve: bool = False, mesh=None):
         kw = {}
         if spec:
             kw.update(
                 draft_model=model, draft_params=params, gamma=gamma
             )
+        if serve:
+            # The observability WIRE on top of the stack: XLA program
+            # ledger + recompile sentinel in-engine, the introspection
+            # server scraped from another thread mid-run.
+            kw.update(xla_ledger=True)
         tracer = Tracer() if (trace or obs_full) else None
         if obs_full:
             # The full production-observability stack: flight recorder,
@@ -590,6 +597,22 @@ def bench_serving(
             assert eng.poll(warm).finished
             n_warm += 1
             chunk *= 2
+        if serve and prefix_caching:
+            # copy_page compiles lazily on the first CoW; warm it too so
+            # the armed sentinel sees a fully-compiled steady state. Two
+            # continuations of one retired history share its partial page
+            # — extending both forces the copy.
+            base = warm_rng.integers(0, 256, 5).tolist()
+            first = eng.submit(base, SamplingParams(max_new_tokens=2))
+            eng.run()
+            hist = base + [eng.poll(first).generated[0]]
+            cont = [
+                eng.submit(hist + [t], SamplingParams(max_new_tokens=4))
+                for t in (3, 17)
+            ]
+            eng.run()
+            assert all(eng.poll(r).finished for r in cont)
+            n_warm += 3
         eng.metrics = ServingMetrics(speculative=eng.speculative)
         eng.admission.accepted = 0
         eng.admission.cached_tokens_admitted = 0
@@ -601,6 +624,41 @@ def bench_serving(
             # the row reports the measured workload only.
             eng.prefix_cache.lookups = eng.prefix_cache.hits = 0
             eng.prefix_cache.tokens_hit = eng.prefix_cache.tokens_missed = 0
+        server = None
+        scraper = None
+        scrape_stop = None
+        scrapes = {"n": 0, "valid": 0}
+        if serve:
+            from distributed_pytorch_tpu.obs import validate_exposition
+            from distributed_pytorch_tpu.obs.server import scrape as _scrape
+
+            # Every program the workload needs is compiled; from here any
+            # new XLA compilation is a bug the sentinel must catch.
+            eng.arm_recompile_sentinel()
+            server = eng.serve()
+            scrape_stop = threading.Event()
+
+            def _scrape_loop():
+                while not scrape_stop.is_set():
+                    try:
+                        body = _scrape(server.url, "/metrics")
+                        validate_exposition(body)
+                        statusz = _scrape(server.url, "/statusz")
+                        health = _scrape(server.url, "/healthz")
+                        scrapes["n"] += 1
+                        if (
+                            statusz.get("health") == "live"
+                            and health.get("status") == "live"
+                        ):
+                            scrapes["valid"] += 1
+                    except Exception:
+                        pass
+                    scrape_stop.wait(0.05)
+
+            scraper = threading.Thread(
+                target=_scrape_loop, name="bench-scraper", daemon=True
+            )
+            scraper.start()
 
         start = time.perf_counter()
         submitted = 0
@@ -619,6 +677,9 @@ def bench_serving(
             elif submitted < n_requests:
                 time.sleep(min(arrivals[submitted] - now, 0.01))
         assert all(eng.poll(r).finished for r in ids)
+        if serve:
+            scrape_stop.set()
+            scraper.join(timeout=10)
         stats = eng.stats()
         row = {
             "prefix_caching": prefix_caching,
@@ -658,6 +719,15 @@ def bench_serving(
             row["flight_events_dropped"] = eng.flight.dropped
         if eng.slo is not None:
             row["slo"] = eng.slo.state()
+        if serve:
+            row["scrapes_mid_run"] = scrapes["n"]
+            row["scrapes_valid"] = scrapes["valid"]
+            row["recompiles_at_steady_state"] = eng.sentinel.count
+            row["recompile_trips"] = list(eng.sentinel.trips)
+            row["xla_programs"] = len(eng.xla.programs)
+            eng.sentinel.disarm()
+            server.stop()
+            eng._server = None
         tokens = [eng.poll(r).generated for r in ids]
         return row, tokens
 
@@ -692,7 +762,9 @@ def bench_serving(
     # to the all-off pass, the per-request span count must equal completed
     # requests, and the all-on TPOT p50 sits next to the all-off one so the
     # overhead is measured, not asserted (<2% regression is the gate).
-    row_traced, tokens_traced = run_pass(True, trace=True, obs_full=True)
+    row_traced, tokens_traced = run_pass(
+        True, trace=True, obs_full=True, serve=True
+    )
     # A single paired pass cannot resolve a 2% TPOT delta here: p50 over
     # n_requests samples on a shared CPU swings tens of percent run to
     # run (and sometimes lands NEGATIVE). Measure the overhead as the
@@ -711,6 +783,19 @@ def bench_serving(
     tpot_on = tpots_on[len(tpots_on) // 2] if tpots_on else None
     out["obs"] = {
         "greedy_tokens_identical_with_tracing": tokens_traced == tokens_on,
+        # The traced pass now ALSO runs the introspection server (scraped
+        # from another thread every 50ms), the XLA program ledger, and the
+        # armed recompile sentinel — so the same token comparison pins the
+        # whole wire: scraping mid-run must not perturb generation, and a
+        # fully-warmed engine must never recompile at steady state.
+        "greedy_tokens_identical_with_server": tokens_traced == tokens_on,
+        "scrapes_mid_run": row_traced.get("scrapes_mid_run"),
+        "scrapes_valid": row_traced.get("scrapes_valid"),
+        "recompiles_at_steady_state": row_traced.get(
+            "recompiles_at_steady_state"
+        ),
+        "recompile_trips": row_traced.get("recompile_trips"),
+        "xla_programs_ledgered": row_traced.get("xla_programs"),
         "trace_request_spans": row_traced["trace_request_spans"],
         "trace_spans_expected": row_traced["trace_spans_expected"],
         "trace_spans_match": (

@@ -16,7 +16,17 @@ disabled:
 * :class:`~.slo.SLOMonitor` — declarative latency/rate objectives with
   multi-window burn-rate alerting over the registry's own metrics;
 * :class:`~.goodput.GoodputTracker` + the analytic FLOPs model — wall-clock
-  decomposed into productive vs wasted time, tokens/sec/device, and MFU.
+  decomposed into productive vs wasted time, tokens/sec/device, and MFU;
+* :class:`~.server.IntrospectionServer` / :func:`~.server.scrape` — the
+  observability WIRE: a stdlib HTTP server per engine (``/metrics``,
+  ``/healthz``, ``/statusz``, ``/snapshot``, ``/trace``, ``/postmortem``)
+  plus :meth:`MetricsRegistry.merge_remote` fleet aggregation, with
+  :func:`~.promtext.validate_exposition` holding the Prometheus text
+  grammar honest;
+* :class:`~.xla.ProgramLedger` / :class:`~.xla.RecompileSentinel` —
+  device-truth accounting (compile time, HBM breakdown, FLOPs,
+  host<->device transfer bytes, live-buffer watermark) and post-warmup
+  recompile detection.
 """
 
 from distributed_pytorch_tpu.obs.flight import (
@@ -33,28 +43,38 @@ from distributed_pytorch_tpu.obs.goodput import (
     transformer_decode_flops_per_token,
     transformer_train_flops,
 )
+from distributed_pytorch_tpu.obs.promtext import (
+    ExpositionError,
+    validate_exposition,
+)
 from distributed_pytorch_tpu.obs.registry import (
     Counter,
     Gauge,
     MetricsRegistry,
 )
+from distributed_pytorch_tpu.obs.server import IntrospectionServer, scrape
 from distributed_pytorch_tpu.obs.slo import (
     SLObjective,
     SLOMonitor,
     default_serving_objectives,
 )
 from distributed_pytorch_tpu.obs.tracer import NULL_TRACER, NullTracer, Tracer
+from distributed_pytorch_tpu.obs.xla import ProgramLedger, RecompileSentinel
 
 __all__ = [
     "Counter",
+    "ExpositionError",
     "FlightRecorder",
     "Gauge",
     "GoodputTracker",
+    "IntrospectionServer",
     "MetricsRegistry",
     "NULL_FLIGHT_RECORDER",
     "NULL_TRACER",
     "NullFlightRecorder",
     "NullTracer",
+    "ProgramLedger",
+    "RecompileSentinel",
     "SLObjective",
     "SLOMonitor",
     "Tracer",
@@ -63,6 +83,8 @@ __all__ = [
     "peak_flops_per_chip",
     "replay_to_tracer",
     "resnet50_train_flops",
+    "scrape",
     "transformer_decode_flops_per_token",
     "transformer_train_flops",
+    "validate_exposition",
 ]

@@ -1,0 +1,241 @@
+"""Strict Prometheus text-exposition grammar checker.
+
+``MetricsRegistry.prometheus_text`` claims "real scrapers accept the body
+as-is" — this module makes that claim testable instead of aspirational. It
+parses a scrape body under the text-format rules a conformant Prometheus
+server enforces (plus the stricter conventions this repo commits to) and
+raises :class:`ExpositionError` naming the offending line:
+
+* metric names match ``[a-zA-Z_:][a-zA-Z0-9_:]*``, label names match
+  ``[a-zA-Z_][a-zA-Z0-9_]*`` and never start ``__``;
+* label values escape exactly ``\\``, ``\"`` and ``\\n`` — a raw newline or
+  an unknown escape inside a quoted value is a corruption, not a sample;
+* ``# HELP`` / ``# TYPE`` come in pairs, HELP first, at most once per
+  family, BEFORE any of the family's samples (a TYPE after its samples is
+  legal-but-meaningless and rejected here);
+* every sample belongs to the most recent TYPE'd family: bare name for
+  counters/gauges, ``name`` + ``name_sum`` + ``name_count`` (quantile
+  label on the bare name) for summaries — and families are contiguous;
+* sample values parse as Go floats (``+Inf``/``-Inf``/``NaN`` included),
+  optional timestamps as integers;
+* the body ends with a newline (the format requires the final line feed).
+
+:func:`validate_exposition` returns the parsed families, so tests assert
+content ("the merged body still carries every engine's counter") with the
+same call that proves the grammar.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_VALUE_RE = re.compile(
+    r"^[+-]?(?:Inf|inf|NaN|nan|\d+\.?\d*(?:[eE][+-]?\d+)?"
+    r"|\.\d+(?:[eE][+-]?\d+)?)$"
+)
+_TYPES = ("counter", "gauge", "summary", "histogram", "untyped")
+
+
+class ExpositionError(ValueError):
+    """The body violates the text exposition format; the message carries
+    the 1-based line number and the offending content."""
+
+
+class Family:
+    """One metric family: its TYPE, HELP, and samples in body order."""
+
+    __slots__ = ("name", "type", "help", "samples")
+
+    def __init__(self, name: str, type_: str, help_: Optional[str]):
+        self.name = name
+        self.type = type_
+        self.help = help_
+        # (sample_name, labels dict, value text)
+        self.samples: List[Tuple[str, Dict[str, str], str]] = []
+
+
+def _fail(lineno: int, line: str, why: str) -> None:
+    raise ExpositionError(f"line {lineno}: {why}: {line!r}")
+
+
+def _parse_labels(body: str, lineno: int, line: str) -> Dict[str, str]:
+    """Parse the ``a="b",c="d"`` interior of a label set, enforcing the
+    three-escape rule inside quoted values."""
+    labels: Dict[str, str] = {}
+    i = 0
+    n = len(body)
+    while i < n:
+        eq = body.find("=", i)
+        if eq < 0:
+            _fail(lineno, line, "label without '='")
+        name = body[i:eq]
+        if not _LABEL_NAME_RE.match(name):
+            _fail(lineno, line, f"bad label name {name!r}")
+        if name.startswith("__"):
+            _fail(lineno, line, f"reserved label name {name!r}")
+        if name in labels:
+            _fail(lineno, line, f"duplicate label {name!r}")
+        if eq + 1 >= n or body[eq + 1] != '"':
+            _fail(lineno, line, f"label {name!r} value not quoted")
+        i = eq + 2
+        value_chars: List[str] = []
+        while True:
+            if i >= n:
+                _fail(lineno, line, f"unterminated value for label {name!r}")
+            ch = body[i]
+            if ch == "\\":
+                if i + 1 >= n or body[i + 1] not in ('\\', '"', "n"):
+                    _fail(
+                        lineno, line,
+                        f"bad escape in label {name!r} (only \\\\ \\\" \\n)",
+                    )
+                value_chars.append(
+                    "\n" if body[i + 1] == "n" else body[i + 1]
+                )
+                i += 2
+            elif ch == '"':
+                i += 1
+                break
+            else:
+                value_chars.append(ch)
+                i += 1
+        labels[name] = "".join(value_chars)
+        if i < n:
+            if body[i] != ",":
+                _fail(lineno, line, "expected ',' between labels")
+            i += 1
+    return labels
+
+
+def _family_of(sample_name: str, families: Dict[str, Family]) -> Optional[str]:
+    """Map a sample name to its family: exact match, or the ``_sum`` /
+    ``_count`` / ``_bucket`` suffix of a summary/histogram family."""
+    if sample_name in families:
+        return sample_name
+    for suffix in ("_sum", "_count", "_bucket"):
+        if sample_name.endswith(suffix):
+            base = sample_name[: -len(suffix)]
+            if base in families:
+                return base
+    return None
+
+
+def validate_exposition(text: str) -> Dict[str, Family]:
+    """Validate one scrape body; returns ``{family_name: Family}`` or
+    raises :class:`ExpositionError` (see module doc for the rules)."""
+    if not isinstance(text, str) or not text:
+        raise ExpositionError("empty exposition body")
+    if not text.endswith("\n"):
+        raise ExpositionError("body must end with a newline")
+    families: Dict[str, Family] = {}
+    pending_help: Dict[str, str] = {}
+    closed: set = set()  # families that may not receive more samples
+    current: Optional[str] = None
+    for lineno, line in enumerate(text.split("\n")[:-1], start=1):
+        if line == "":
+            continue
+        if line.startswith("#"):
+            parts = line.split(" ", 3)
+            if len(parts) < 3 or parts[0] != "#" or parts[1] not in (
+                "HELP", "TYPE"
+            ):
+                _fail(lineno, line, "comment is neither # HELP nor # TYPE")
+            kind, name = parts[1], parts[2]
+            rest = parts[3] if len(parts) > 3 else ""
+            if not _METRIC_NAME_RE.match(name):
+                _fail(lineno, line, f"bad metric name {name!r}")
+            if kind == "HELP":
+                if name in pending_help or name in families:
+                    _fail(lineno, line, f"second HELP for {name!r}")
+                bad = re.search(r"\\(?![\\n])", rest)
+                if bad is not None:
+                    _fail(lineno, line, "bad escape in HELP text")
+                pending_help[name] = rest
+            else:
+                if rest not in _TYPES:
+                    _fail(lineno, line, f"unknown TYPE {rest!r}")
+                if name in families:
+                    _fail(lineno, line, f"second TYPE for {name!r}")
+                if name not in pending_help:
+                    _fail(lineno, line, f"TYPE for {name!r} without HELP")
+                if current is not None:
+                    closed.add(current)
+                families[name] = Family(name, rest, pending_help.pop(name))
+                current = name
+            continue
+        # ---- sample line ------------------------------------------------
+        rest = line
+        labels: Dict[str, str] = {}
+        brace = rest.find("{")
+        if brace >= 0:
+            close_idx = rest.rfind("}")
+            if close_idx < brace:
+                _fail(lineno, line, "unbalanced '{'")
+            sample_name = rest[:brace]
+            labels = _parse_labels(
+                rest[brace + 1 : close_idx], lineno, line
+            )
+            tail = rest[close_idx + 1 :]
+        else:
+            fields = rest.split(" ", 1)
+            if len(fields) != 2:
+                _fail(lineno, line, "sample without value")
+            sample_name, tail = fields[0], " " + fields[1]
+        if not _METRIC_NAME_RE.match(sample_name):
+            _fail(lineno, line, f"bad metric name {sample_name!r}")
+        tail = tail.strip()
+        if not tail:
+            _fail(lineno, line, "sample without value")
+        value_fields = tail.split(" ")
+        if len(value_fields) > 2:
+            _fail(lineno, line, "too many fields after label set")
+        if not _VALUE_RE.match(value_fields[0]):
+            _fail(lineno, line, f"bad sample value {value_fields[0]!r}")
+        if len(value_fields) == 2 and not re.match(
+            r"^-?\d+$", value_fields[1]
+        ):
+            _fail(lineno, line, f"bad timestamp {value_fields[1]!r}")
+        fam_name = _family_of(sample_name, families)
+        if fam_name is None:
+            _fail(
+                lineno, line,
+                f"sample {sample_name!r} has no preceding # TYPE",
+            )
+        if fam_name in closed:
+            _fail(
+                lineno, line,
+                f"family {fam_name!r} is not contiguous (samples after "
+                "another family started)",
+            )
+        fam = families[fam_name]
+        if fam.type in ("counter", "gauge") and sample_name != fam.name:
+            _fail(
+                lineno, line,
+                f"{fam.type} family {fam.name!r} with suffixed sample",
+            )
+        if fam.type == "summary":
+            if sample_name == fam.name:
+                if "quantile" not in labels:
+                    _fail(
+                        lineno, line,
+                        f"summary {fam.name!r} sample missing quantile label",
+                    )
+            elif sample_name not in (
+                f"{fam.name}_sum", f"{fam.name}_count"
+            ):
+                _fail(
+                    lineno, line,
+                    f"summary {fam.name!r} only allows _sum/_count suffixes",
+                )
+        fam.samples.append((sample_name, labels, value_fields[0]))
+    if pending_help:
+        raise ExpositionError(
+            f"HELP without TYPE for: {sorted(pending_help)}"
+        )
+    return families
+
+
+__all__ = ["ExpositionError", "Family", "validate_exposition"]

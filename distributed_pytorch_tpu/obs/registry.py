@@ -35,7 +35,8 @@ suffixes.
 from __future__ import annotations
 
 import re
-from typing import Callable, Dict, List, Optional, Union
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Union
 
 from distributed_pytorch_tpu.metrics import ReservoirGroup, ReservoirHistogram
 
@@ -83,6 +84,14 @@ class MetricsRegistry:
 
     def __init__(self, namespace: str = ""):
         self.namespace = _sanitize(namespace) if namespace else ""
+        # THE observability lock. Scrapes arrive on the introspection
+        # server's thread while the engine steps on its own; every render
+        # path below takes this lock, and the engine takes it around
+        # step()/submit() whenever a server is attached — reservoir reads
+        # lazily re-sort their sample buffer, so an unlocked scrape would
+        # race the step loop's record() calls. Reentrant: the SLO monitor
+        # ticks (reads quantiles) from inside a locked step.
+        self.lock = threading.RLock()
         # name -> zero-arg callable returning the current value.
         self._counters: Dict[str, Callable[[], float]] = {}
         self._gauges: Dict[str, Callable[[], float]] = {}
@@ -179,11 +188,13 @@ class MetricsRegistry:
 
     def read_counter(self, name: str) -> float:
         """Current value of a registered counter (by either name form)."""
-        return self._counters[self._resolve(name)]()
+        with self.lock:
+            return self._counters[self._resolve(name)]()
 
     def read_gauge(self, name: str) -> float:
         """Current value of a registered gauge (by either name form)."""
-        return self._gauges[self._resolve(name)]()
+        with self.lock:
+            return self._gauges[self._resolve(name)]()
 
     def read_quantile(
         self, name: str, q: float, label_value: Optional[str] = None
@@ -191,18 +202,19 @@ class MetricsRegistry:
         """Current quantile of a registered reservoir; ``label_value``
         selects the series of a labeled group. NaN on empty reservoirs,
         consistent with :meth:`ReservoirHistogram.quantile`."""
-        resolver, label = self._reservoirs[self._resolve(name)]
-        obj = resolver()
-        if label is not None:
-            if label_value is None:
-                raise ValueError(
-                    f"reservoir {name!r} is labeled by {label!r}; "
-                    "pass label_value"
-                )
-            if label_value not in obj.labels:
-                return float("nan")
-            obj = obj[label_value]
-        return obj.quantile(q)
+        with self.lock:
+            resolver, label = self._reservoirs[self._resolve(name)]
+            obj = resolver()
+            if label is not None:
+                if label_value is None:
+                    raise ValueError(
+                        f"reservoir {name!r} is labeled by {label!r}; "
+                        "pass label_value"
+                    )
+                if label_value not in obj.labels:
+                    return float("nan")
+                obj = obj[label_value]
+            return obj.quantile(q)
 
     @staticmethod
     def _summary(hist: ReservoirHistogram) -> Dict[str, float]:
@@ -212,6 +224,10 @@ class MetricsRegistry:
         """Structured JSON view. ``include_state=True`` additionally embeds
         each reservoir's sample state so :meth:`merge` can aggregate
         percentiles sample-exactly across hosts."""
+        with self.lock:
+            return self._snapshot_locked(include_state)
+
+    def _snapshot_locked(self, include_state: bool) -> Dict[str, dict]:
         counters = {
             self._qualified(n): fn() for n, fn in self._counters.items()
         }
@@ -303,6 +319,102 @@ class MetricsRegistry:
             "reservoir_states": states,
         }
 
+    @classmethod
+    def merge_remote(
+        cls, urls: Sequence[str], timeout: float = 5.0
+    ) -> dict:
+        """Scrape each engine's ``/snapshot`` endpoint (see
+        ``obs.server.IntrospectionServer``) and :meth:`merge` the payloads
+        — N engines' metrics aggregated over HTTP, the routed-fleet signal.
+        ``urls`` are server base URLs (``http://host:port``). A dead peer
+        raises; fleet callers that want partial aggregation catch per-URL
+        and merge what answered."""
+        from distributed_pytorch_tpu.obs.server import scrape
+
+        return cls.merge(
+            [scrape(url, "/snapshot", timeout=timeout) for url in urls]
+        )
+
+    @classmethod
+    def render_snapshot(cls, snapshot: dict) -> str:
+        """Render a snapshot dict — typically :meth:`merge` /
+        :meth:`merge_remote` output — as a Prometheus text body, same
+        grammar as :meth:`prometheus_text`. Reservoirs re-render from
+        their sample states when present (exact merged quantiles), else
+        from the precomputed summaries."""
+        lines: List[str] = []
+
+        def head(qname, mtype):
+            lines.append(f"# HELP {qname} {cls._escape_help(qname)}")
+            lines.append(f"# TYPE {qname} {mtype}")
+
+        def emit_hist(qname, hist, extra=""):
+            for q in (0.5, 0.95, 0.99):
+                value = hist.quantile(q)
+                if value == value:
+                    lines.append(f'{qname}{{{extra}quantile="{q}"}} {value}')
+            suffix = "{" + extra.rstrip(",") + "}" if extra else ""
+            lines.append(f"{qname}_sum{suffix} {hist.sum}")
+            lines.append(f"{qname}_count{suffix} {hist.count}")
+
+        def rebuild(state):
+            hist = ReservoirHistogram(int(state["capacity"]))
+            hist.merge_state(state)
+            return hist
+
+        for name, value in snapshot.get("counters", {}).items():
+            head(name, "counter")
+            lines.append(f"{name} {value}")
+        for name, value in snapshot.get("gauges", {}).items():
+            head(name, "gauge")
+            lines.append(f"{name} {value}")
+        states = snapshot.get("reservoir_states")
+        if states is not None:
+            for name, state in states.items():
+                head(name, "summary")
+                if isinstance(state, dict) and "series" in state:
+                    label = _sanitize(str(state["label"]))
+                    for lab, sub in state["series"].items():
+                        emit_hist(
+                            name,
+                            rebuild(sub),
+                            extra=f'{label}="{cls._escape_label(lab)}",',
+                        )
+                else:
+                    emit_hist(name, rebuild(state))
+        else:
+            for name, summ in snapshot.get("reservoirs", {}).items():
+                head(name, "summary")
+                series = (
+                    summ["series"].items()
+                    if isinstance(summ, dict) and "series" in summ
+                    else [(None, summ)]
+                )
+                label = (
+                    _sanitize(str(summ["label"]))
+                    if isinstance(summ, dict) and "series" in summ
+                    else None
+                )
+                for lab, sub in series:
+                    extra = (
+                        f'{label}="{cls._escape_label(lab)}",'
+                        if lab is not None
+                        else ""
+                    )
+                    count = sub.get("count", 0)
+                    for q_key, q in (("p50", 0.5), ("p95", 0.95),
+                                     ("p99", 0.99)):
+                        if q_key in sub:
+                            lines.append(
+                                f'{name}{{{extra}quantile="{q}"}} '
+                                f"{sub[q_key]}"
+                            )
+                    suffix = "{" + extra.rstrip(",") + "}" if extra else ""
+                    total = sub.get("mean", 0.0) * count
+                    lines.append(f"{name}_sum{suffix} {total}")
+                    lines.append(f"{name}_count{suffix} {count}")
+        return "\n".join(lines) + "\n"
+
     @staticmethod
     def _escape_label(value: object) -> str:
         """Escape a label VALUE per the exposition format: backslash,
@@ -324,7 +436,12 @@ class MetricsRegistry:
         as ``summary`` metrics: quantile-labeled samples plus ``_sum`` and
         ``_count``; group labels become ordinary Prometheus labels. Every
         metric gets ``# HELP`` / ``# TYPE`` headers and label values are
-        escaped, so real scrapers accept the body as-is."""
+        escaped, so real scrapers accept the body as-is (and
+        ``obs.promtext.validate_exposition`` enforces it in tests)."""
+        with self.lock:
+            return self._prometheus_text_locked()
+
+    def _prometheus_text_locked(self) -> str:
         lines: List[str] = []
 
         def emit_head(name, qname, mtype):

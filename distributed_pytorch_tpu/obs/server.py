@@ -1,0 +1,206 @@
+"""Per-engine HTTP introspection server — the observability wire.
+
+Everything the obs stack knows stays trapped in-process until something
+exports it; this module is that something, built on stdlib only
+(``http.server`` + ``urllib``) so it runs anywhere the engine does. One
+:class:`IntrospectionServer` rides one engine on a daemon thread:
+
+==============  ============================================================
+endpoint        body
+==============  ============================================================
+``/metrics``    Prometheus text exposition from the engine's registry
+``/healthz``    ``{"status": "live"|"draining"|"closed"}`` — 200 only when
+                live, 503 while draining or closed (load-balancer semantics:
+                a draining replica must fall out of rotation)
+``/statusz``    JSON live-state: queue depth, per-request phase/age/tokens,
+                page-state counts, SLO firing set, goodput split, XLA
+                program ledger, recompile-sentinel state
+``/snapshot``   ``registry.snapshot(include_state=True)`` as JSON — the
+                exact-merge payload :meth:`MetricsRegistry.merge_remote`
+                aggregates across a fleet
+``/trace``      the Perfetto trace dump, rendered on demand (404 untraced)
+``/postmortem`` a fresh flight-recorder dump (404 without a recorder)
+==============  ============================================================
+
+Thread safety: every handler goes through the engine's registry lock —
+either implicitly (``prometheus_text``/``snapshot`` lock internally) or
+via :meth:`InferenceEngine.status`, which snapshots scheduler state under
+the same lock the engine holds across each ``step()`` while a server is
+attached. The server thread therefore always observes step boundaries,
+never a half-updated slot table. Scrapes never touch device state, so
+serving traffic stays bitwise-identical with the server on (pinned by
+tests and the bench obs-parity gate).
+
+:func:`scrape` is the matching client: one GET, JSON-decoded when the
+endpoint serves JSON, raw text for ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Union
+
+_JSON = "application/json"
+_PROM = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class IntrospectionServer:
+    """HTTP introspection for one engine. ``port=0`` (default) binds an
+    ephemeral port — read it back from :attr:`port` / :attr:`url`.
+    Constructed-and-started by :meth:`InferenceEngine.serve`; usable
+    standalone around anything exposing the same surface (``registry``,
+    ``status()``, ``tracer``, ``flight``, ``admission``, ``_closed``)."""
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0):
+        self.engine = engine
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # One quiet access log line per scrape would swamp test output.
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                try:
+                    server._route(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as exc:  # surface handler bugs as 500s
+                    try:
+                        self.send_error(500, repr(exc))
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------- lifecycle
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self._httpd.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    def start(self) -> "IntrospectionServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name=f"obs-server-{self.port}",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._httpd.server_close()
+
+    # ------------------------------------------------------------ handlers
+
+    def _route(self, handler: BaseHTTPRequestHandler) -> None:
+        path = handler.path.split("?", 1)[0].rstrip("/") or "/"
+        eng = self.engine
+        if path == "/metrics":
+            self._send(handler, 200, eng.registry.prometheus_text(), _PROM)
+        elif path == "/healthz":
+            status = self._health()
+            code = 200 if status == "live" else 503
+            self._send_json(handler, code, {"status": status})
+        elif path == "/statusz":
+            self._send_json(handler, 200, eng.status())
+        elif path == "/snapshot":
+            self._send_json(
+                handler, 200, eng.registry.snapshot(include_state=True)
+            )
+        elif path == "/trace":
+            tracer = getattr(eng, "tracer", None)
+            if tracer is None or not getattr(tracer, "enabled", False):
+                self._send_json(
+                    handler, 404, {"error": "engine has no tracer"}
+                )
+            else:
+                with eng.registry.lock:
+                    doc = tracer.to_perfetto()
+                self._send_json(handler, 200, doc)
+        elif path == "/postmortem":
+            flight = getattr(eng, "flight", None)
+            if flight is None or not getattr(flight, "enabled", False):
+                self._send_json(
+                    handler, 404, {"error": "engine has no flight recorder"}
+                )
+            else:
+                doc = eng._dump_postmortem("postmortem_endpoint")
+                self._send_json(handler, 200, doc)
+        elif path == "/":
+            self._send_json(
+                handler,
+                200,
+                {
+                    "endpoints": [
+                        "/metrics", "/healthz", "/statusz", "/snapshot",
+                        "/trace", "/postmortem",
+                    ]
+                },
+            )
+        else:
+            self._send_json(handler, 404, {"error": f"unknown path {path}"})
+
+    def _health(self) -> str:
+        eng = self.engine
+        health = getattr(eng, "health", None)
+        if callable(health):
+            return health()
+        if getattr(eng, "_closed", False):
+            return "closed"
+        if getattr(getattr(eng, "admission", None), "draining", False):
+            return "draining"
+        return "live"
+
+    @staticmethod
+    def _send(handler, code: int, body: str, content_type: str) -> None:
+        payload = body.encode("utf-8")
+        handler.send_response(code)
+        handler.send_header("Content-Type", content_type)
+        handler.send_header("Content-Length", str(len(payload)))
+        handler.end_headers()
+        handler.wfile.write(payload)
+
+    @classmethod
+    def _send_json(cls, handler, code: int, doc) -> None:
+        cls._send(handler, code, json.dumps(doc, default=str), _JSON)
+
+
+def scrape(
+    base_url: str, endpoint: str = "/snapshot", timeout: float = 5.0
+) -> Union[dict, list, str]:
+    """GET one introspection endpoint. Returns the decoded JSON document,
+    or the raw text body for ``/metrics``. ``/healthz`` answers through
+    its status code too — a 503 here still returns the JSON body rather
+    than raising, because "draining" is an answer, not an error."""
+    url = base_url.rstrip("/") + endpoint
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            body = resp.read().decode("utf-8")
+            ctype = resp.headers.get("Content-Type", "")
+    except urllib.error.HTTPError as err:
+        if endpoint.rstrip("/") == "/healthz":
+            return json.loads(err.read().decode("utf-8"))
+        raise
+    if _JSON in ctype:
+        return json.loads(body)
+    return body
+
+
+__all__ = ["IntrospectionServer", "scrape"]

@@ -132,6 +132,14 @@ class AdmissionController:
         self.accepted += 1
         self.cached_tokens_admitted += cached_tokens
 
+    def status(self) -> Dict[str, object]:
+        """The ``/statusz`` admission block: every rejection counter plus
+        the live draining flag (``/healthz`` derives its verdict from the
+        same flag)."""
+        out: Dict[str, object] = dict(self.counters())
+        out["draining"] = self.draining
+        return out
+
     def counters(self) -> Dict[str, int]:
         return {
             "accepted": self.accepted,

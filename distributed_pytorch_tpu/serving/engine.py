@@ -98,6 +98,7 @@ from distributed_pytorch_tpu.obs.goodput import (
 )
 from distributed_pytorch_tpu.obs.slo import SLOMonitor, SLObjective
 from distributed_pytorch_tpu.obs.tracer import NULL_TRACER
+from distributed_pytorch_tpu.obs.xla import ProgramLedger, RecompileSentinel
 from distributed_pytorch_tpu.serving.admission import (
     AdmissionController,
     ServingMetrics,
@@ -204,6 +205,7 @@ class InferenceEngine:
         flight: Optional[FlightRecorder] = None,
         slo: Optional[Sequence[SLObjective]] = None,
         goodput=None,
+        xla_ledger=None,
     ):
         if max_seq_len % page_size:
             raise ValueError(
@@ -358,6 +360,32 @@ class InferenceEngine:
         else:
             self.goodput = goodput if goodput else None
         self._acct: Optional[dict] = None
+        # Device-truth accounting (obs/xla.py). ``xla_ledger=True`` (or a
+        # pre-built ProgramLedger) wraps every compiled program: first call
+        # per signature runs an analysis-only AOT compile recording wall
+        # time / memory_analysis HBM / cost-analysis FLOPs, and the engine
+        # counts host<->device staging/readback bytes per step. Execution
+        # always goes through the original jit callable, so tokens are
+        # bitwise-identical ledger-on vs -off. The paired RecompileSentinel
+        # (``arm_recompile_sentinel()`` after warmup) turns any later
+        # compilation into a counted, flight-recorded alert. Must be chosen
+        # at construction — programs are wrapped as they are built.
+        if xla_ledger:
+            self.xla = (
+                xla_ledger
+                if isinstance(xla_ledger, ProgramLedger)
+                else ProgramLedger()
+            )
+            self.sentinel = RecompileSentinel(
+                self.xla, tracer=self.tracer, flight=self.flight
+            )
+        else:
+            self.xla = None
+            self.sentinel = None
+        # Introspection server handle (serve()/close()); while attached,
+        # step()/submit() run under the registry lock so scrapes observe
+        # step boundaries only.
+        self._server = None
         self.registry = self._build_registry()
         # SLO burn-rate monitoring reads the registry it writes its
         # verdicts into, so one snapshot carries metrics AND alerts.
@@ -486,6 +514,10 @@ class InferenceEngine:
         reg.gauge_fn(f"mesh_{self.mesh_fingerprint}_info", lambda: 1.0)
         if self.goodput is not None:
             self.goodput.register_into(reg)
+        if self.xla is not None:
+            self.xla.register_into(reg)
+        if self.sentinel is not None:
+            self.sentinel.register_into(reg)
         if self.flight.enabled:
             fl = self.flight
             reg.counter_fn(
@@ -538,6 +570,14 @@ class InferenceEngine:
     # registry gauge): lazily-built programs surface in obs exactly when
     # they start existing.
 
+    def _ledgered(self, name, fn):
+        """Route one compiled program through the XLA ledger when device
+        accounting is on; the identity otherwise (the bitwise/fast-path
+        guarantee is the absence of any wrapper, not a cheap wrapper)."""
+        if self.xla is None:
+            return fn
+        return self.xla.wrap(name, fn)
+
     def _sharded_jit(self, run, *, donate, in_shardings, out_shardings):
         self._sharded_programs += 1
         return jax.jit(
@@ -573,20 +613,25 @@ class InferenceEngine:
             return nxt, cache
 
         if self.mesh is None:
-            return jax.jit(run, donate_argnums=(1,))
+            return self._ledgered(
+                "decode_step", jax.jit(run, donate_argnums=(1,))
+            )
         rep = self._replicated
         pool = self._pool_shardings["target"]
         # prev is device-resident feedback: it comes back replicated (out
         # sharding below) and is consumed replicated, so the overlapped
         # splice never adds a collective.
-        return self._sharded_jit(
-            run,
-            donate=(1,),
-            in_shardings=(
-                self._param_shardings, pool, rep, rep, rep, rep, rep, rep,
-                rep,
+        return self._ledgered(
+            "decode_step",
+            self._sharded_jit(
+                run,
+                donate=(1,),
+                in_shardings=(
+                    self._param_shardings, pool, rep, rep, rep, rep, rep,
+                    rep, rep,
+                ),
+                out_shardings=(rep, pool),
             ),
-            out_shardings=(rep, pool),
         )
 
     @functools.lru_cache(maxsize=16)
@@ -601,15 +646,19 @@ class InferenceEngine:
             )
             return cache
 
+        name = f"prefill_step_c{chunk}"
         if self.mesh is None:
-            return jax.jit(run, donate_argnums=(1,))
+            return self._ledgered(name, jax.jit(run, donate_argnums=(1,)))
         rep = self._replicated
         pool = self._pool_shardings["target"]
-        return self._sharded_jit(
-            run,
-            donate=(1,),
-            in_shardings=(self._param_shardings, pool, rep, rep, rep),
-            out_shardings=pool,
+        return self._ledgered(
+            name,
+            self._sharded_jit(
+                run,
+                donate=(1,),
+                in_shardings=(self._param_shardings, pool, rep, rep, rep),
+                out_shardings=pool,
+            ),
         )
 
     @functools.cached_property
@@ -626,14 +675,19 @@ class InferenceEngine:
             )
 
         if self.mesh is None:
-            return jax.jit(run, donate_argnums=(0,))
+            return self._ledgered(
+                "copy_page", jax.jit(run, donate_argnums=(0,))
+            )
         rep = self._replicated
         return {
-            name: self._sharded_jit(
-                run,
-                donate=(0,),
-                in_shardings=(self._pool_shardings[name], rep, rep),
-                out_shardings=self._pool_shardings[name],
+            name: self._ledgered(
+                f"copy_page_{name}",
+                self._sharded_jit(
+                    run,
+                    donate=(0,),
+                    in_shardings=(self._pool_shardings[name], rep, rep),
+                    out_shardings=self._pool_shardings[name],
+                ),
             )
             for name in self.pools.names
         }
@@ -653,17 +707,21 @@ class InferenceEngine:
             )
             return draft_cache
 
+        name = f"draft_prefill_step_c{chunk}"
         if self.mesh is None:
-            return jax.jit(run, donate_argnums=(1,))
+            return self._ledgered(name, jax.jit(run, donate_argnums=(1,)))
         rep = self._replicated
         pool = self._pool_shardings["draft"]
-        return self._sharded_jit(
-            run,
-            donate=(1,),
-            in_shardings=(
-                self._draft_param_shardings, pool, rep, rep, rep
+        return self._ledgered(
+            name,
+            self._sharded_jit(
+                run,
+                donate=(1,),
+                in_shardings=(
+                    self._draft_param_shardings, pool, rep, rep, rep
+                ),
+                out_shardings=pool,
             ),
-            out_shardings=pool,
         )
 
     @functools.cached_property
@@ -794,18 +852,23 @@ class InferenceEngine:
             return emitted, n_acc, cache, draft_cache
 
         if self.mesh is None:
-            return jax.jit(run, donate_argnums=(2, 3))
+            return self._ledgered(
+                "spec_step", jax.jit(run, donate_argnums=(2, 3))
+            )
         rep = self._replicated
         pool = self._pool_shardings["target"]
         draft_pool = self._pool_shardings["draft"]
-        return self._sharded_jit(
-            run,
-            donate=(2, 3),
-            in_shardings=(
-                self._param_shardings, self._draft_param_shardings,
-                pool, draft_pool, rep, rep, rep, rep, rep,
+        return self._ledgered(
+            "spec_step",
+            self._sharded_jit(
+                run,
+                donate=(2, 3),
+                in_shardings=(
+                    self._param_shardings, self._draft_param_shardings,
+                    pool, draft_pool, rep, rep, rep, rep, rep,
+                ),
+                out_shardings=(rep, rep, pool, draft_pool),
             ),
-            out_shardings=(rep, rep, pool, draft_pool),
         )
 
     # ----------------------------------------------------------------- API
@@ -825,6 +888,17 @@ class InferenceEngine:
         uncached tail of prefill work against the queue-token budget.
         ``metadata`` is a tenant-opaque JSON-serializable dict carried
         through scheduling (and the elastic snapshot) untouched."""
+        if self._server is None:
+            return self._submit_impl(prompt, params, metadata)
+        with self.registry.lock:
+            return self._submit_impl(prompt, params, metadata)
+
+    def _submit_impl(
+        self,
+        prompt: Sequence[int],
+        params: Optional[SamplingParams],
+        metadata: Optional[dict],
+    ) -> int:
         params = params or SamplingParams()
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         cached = 0
@@ -865,6 +939,8 @@ class InferenceEngine:
         nxt, slots, reqs = self._inflight
         self._inflight = None
         nxt_host = np.asarray(nxt)
+        if self.xla is not None:
+            self.xla.count_d2h(nxt_host.nbytes)
         now = time.perf_counter()
         finished: List[int] = []
         for slot, req in zip(slots, reqs):
@@ -898,6 +974,13 @@ class InferenceEngine:
             # PREVIOUS step's accounting (this step's feed lands after the
             # slice closes).
             extra["goodput_fraction"] = self.goodput.fraction()
+        if self.xla is not None:
+            # Host<->device transfer ledger as counter tracks: bytes staged
+            # up / read back since the previous step's slice closed.
+            dh2d, dd2h = self.xla.step_transfer_deltas()
+            extra["bytes_h2d"] = dh2d
+            extra["bytes_d2h"] = dd2h
+            extra["live_buffer_bytes"] = self.xla.live_bytes
         self.tracer.end_step(
             decode_rows=len(plan.decode_slots),
             prefill_chunks=len(plan.prefill),
@@ -917,27 +1000,34 @@ class InferenceEngine:
         token was dispatched). A no-op (empty list) when nothing is queued,
         running, or in flight.
 
-        With goodput accounting, an SLO monitor, or a flight recorder
-        attached, the step is wrapped in wall-clock attribution (see
-        :meth:`_account_step`); none of it touches device work or
-        scheduling decisions, so outputs stay bitwise-identical (pinned
-        by the obs-parity bench gate)."""
+        With goodput accounting, an SLO monitor, a flight recorder, an XLA
+        ledger, or an introspection server attached, the step is wrapped
+        in wall-clock attribution (see :meth:`_account_step`) and — when a
+        server is live — the registry lock, so scrapes only ever observe
+        step boundaries; none of it touches device work or scheduling
+        decisions, so outputs stay bitwise-identical (pinned by the
+        obs-parity bench gate and the server parity test)."""
         if (
             self.goodput is None
             and self.slo is None
             and not self.flight.enabled
+            and self.xla is None
+            and self._server is None
         ):
             return self._step_impl()
-        t0 = time.perf_counter()
-        self._acct = {
-            "plan": None, "rework": None, "emitted": 0, "proposed": 0,
-        }
-        try:
-            finished = self._step_impl()
-        finally:
-            acct, self._acct = self._acct, None
-        self._account_step(acct, time.perf_counter() - t0, finished)
-        return finished
+        with self.registry.lock:
+            t0 = time.perf_counter()
+            self._acct = {
+                "plan": None, "rework": None, "emitted": 0, "proposed": 0,
+            }
+            try:
+                finished = self._step_impl()
+            finally:
+                acct, self._acct = self._acct, None
+            self._account_step(acct, time.perf_counter() - t0, finished)
+            if self.xla is not None:
+                self.xla.update_live_bytes()
+            return finished
 
     def _account_step(self, acct, dt_s: float, finished: List[int]) -> None:
         """Post-step bookkeeping: feed the goodput tracker, append the
@@ -1004,6 +1094,9 @@ class InferenceEngine:
             self._acct["plan"] = plan
 
         if plan.copies:
+            if self.xla is not None:
+                # Two staged int32 page-id scalars per CoW copy.
+                self.xla.count_h2d(8 * len(plan.copies))
             with tr.phase("cow"):
                 for _slot, src, dst in plan.copies:
                     # Copy-on-write fans out to every pool: the draft pool
@@ -1042,6 +1135,8 @@ class InferenceEngine:
                         [req.tokens[start : start + chunk]], np.int32
                     )
                     table = req.table.as_row(self.pages_per_seq)[None]
+                    if self.xla is not None:
+                        self.xla.count_h2d(tok.nbytes + table.nbytes + 4)
                     self.cache = self._prefill_step(chunk)(
                         self.params, self.cache, jnp.asarray(tok),
                         jnp.asarray(table),
@@ -1082,6 +1177,15 @@ class InferenceEngine:
                     self._inflight[0] if self._inflight is not None
                     else self._zero_prev
                 )
+                if self.xla is not None:
+                    self.xla.count_h2d(
+                        self._stage_tokens.nbytes
+                        + self._stage_use_prev.nbytes
+                        + self._stage_tables.nbytes
+                        + self._stage_lens.nbytes
+                        + self._stage_temps.nbytes
+                        + self._stage_keys.nbytes
+                    )
                 nxt, self.cache = self._decode_step(
                     self.params, self.cache,
                     jnp.asarray(self._stage_tokens), prev,
@@ -1148,6 +1252,14 @@ class InferenceEngine:
                         ),
                         np.uint32,
                     )
+                if self.xla is not None:
+                    self.xla.count_h2d(
+                        self._stage_tokens.nbytes
+                        + self._stage_tables.nbytes
+                        + self._stage_lens.nbytes
+                        + self._stage_temps.nbytes
+                        + self._stage_keys.nbytes
+                    )
                 emitted, n_acc, self.cache, self.draft_cache = (
                     self._spec_step(
                         self.params, self.draft_params,
@@ -1184,6 +1296,11 @@ class InferenceEngine:
                         [req.tokens[start : start + chunk]], np.int32
                     )
                     table = req.table.as_row(self.pages_per_seq)[None]
+                    if self.xla is not None:
+                        # Chunk + table + start staged into BOTH pools.
+                        self.xla.count_h2d(
+                            2 * (tok.nbytes + table.nbytes + 4)
+                        )
                     self.cache = self._prefill_step(chunk)(
                         self.params, self.cache, jnp.asarray(tok),
                         jnp.asarray(table),
@@ -1203,6 +1320,10 @@ class InferenceEngine:
                 emitted, n_acc, slot_reqs = dispatched
                 emitted_host = np.asarray(emitted)  # the ONE blocking sync
                 n_acc_host = np.asarray(n_acc)
+                if self.xla is not None:
+                    self.xla.count_d2h(
+                        emitted_host.nbytes + n_acc_host.nbytes
+                    )
                 now = time.perf_counter()
                 for slot, req in slot_reqs:
                     accepted = int(n_acc_host[slot])
@@ -1253,6 +1374,114 @@ class InferenceEngine:
         if req is None:
             return False
         return self.scheduler.cancel(req)
+
+    # -------------------------------------------------- observability wire
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0):
+        """Start the HTTP introspection server for this engine (see
+        ``obs/server.py``): ``/metrics``, ``/healthz``, ``/statusz``,
+        ``/snapshot``, ``/trace``, ``/postmortem``. ``port=0`` binds an
+        ephemeral port; read it from the returned server's ``.url``.
+        Idempotent; stopped automatically by :meth:`close`. While a server
+        is attached, :meth:`step` and :meth:`submit` run under the
+        registry lock so scrapes observe step boundaries only — device
+        work and tokens are untouched."""
+        if self._server is None:
+            from distributed_pytorch_tpu.obs.server import (
+                IntrospectionServer,
+            )
+
+            self._server = IntrospectionServer(
+                self, host=host, port=port
+            ).start()
+        return self._server
+
+    def health(self) -> str:
+        """``"live"`` / ``"draining"`` / ``"closed"`` — the ``/healthz``
+        verdict (only ``"live"`` answers 200)."""
+        if self._closed:
+            return "closed"
+        if self.admission.draining:
+            return "draining"
+        return "live"
+
+    def status(self) -> dict:
+        """The ``/statusz`` document: one JSON-serializable dict of engine
+        live-state — queue/slot occupancy with per-request phase, age and
+        token counts, page-state counts, admission verdicts, SLO firing
+        set, goodput split, the XLA program ledger, and recompile-sentinel
+        state. Taken under the registry lock, so a server-thread caller
+        sees a step-boundary-consistent view."""
+        with self.registry.lock:
+            now = time.perf_counter()
+            out = {
+                "health": self.health(),
+                "engine": {
+                    "speculative": self.speculative,
+                    "mesh": self.mesh_fingerprint,
+                    "max_slots": self.max_slots,
+                    "overlap": self.overlap,
+                    "steps": self.metrics.engine_steps,
+                    "closed": self._closed,
+                },
+                "queue_depth": self.scheduler.num_waiting,
+                "running_requests": len(self.scheduler.running),
+                "inflight_dispatch": self._inflight is not None,
+                "requests": self.scheduler.describe_requests(now=now),
+                "pages": self.allocator.counters(),
+                "admission": self.admission.status(),
+                "latency": {
+                    "ttft_p50_s": self.registry.read_quantile(
+                        "ttft_seconds", 0.5
+                    ),
+                    "ttft_p95_s": self.registry.read_quantile(
+                        "ttft_seconds", 0.95
+                    ),
+                    "tpot_p50_s": self.registry.read_quantile(
+                        "tpot_seconds", 0.5
+                    ),
+                    "tpot_p95_s": self.registry.read_quantile(
+                        "tpot_seconds", 0.95
+                    ),
+                    "tokens_per_sec": self.metrics.snapshot()[
+                        "tokens_per_sec"
+                    ],
+                },
+            }
+            if self.prefix_cache is not None:
+                out["prefix_cache"] = self.prefix_cache.stats()
+            if self.slo is not None:
+                slo_state = self.slo.state()
+                out["slo"] = {
+                    "firing": sorted(
+                        name
+                        for name, st in slo_state.items()
+                        if st["firing"]
+                    ),
+                    "objectives": slo_state,
+                }
+            if self.goodput is not None:
+                out["goodput"] = self.goodput.report()
+            if self.xla is not None:
+                out["xla"] = self.xla.metadata()
+            if self.sentinel is not None:
+                out["recompile_sentinel"] = self.sentinel.status()
+            return out
+
+    def arm_recompile_sentinel(self) -> RecompileSentinel:
+        """Declare warmup over: from here on, every new XLA compilation —
+        a ledger signature miss or an unattributed backend-compile event —
+        bumps ``serving_engine_recompiles_total``, records a ``recompile``
+        flight event with the program name + shapes, and latches the
+        firing gauge. Requires ``xla_ledger`` (programs must have been
+        wrapped at construction)."""
+        if self.sentinel is None:
+            raise RuntimeError(
+                "recompile sentinel requires the XLA ledger; construct "
+                "with InferenceEngine(..., xla_ledger=True)"
+            )
+        self.sentinel.arm()
+        return self.sentinel
 
     # ------------------------------------------------------- elastic hooks
 
@@ -1333,17 +1562,25 @@ class InferenceEngine:
         ``with InferenceEngine(...) as eng:`` exit."""
         if self._closed:
             return
-        self.finish_inflight()
-        self.stop_admission()
-        for req in list(self.scheduler.waiting) + self.scheduler.running:
-            self.scheduler.cancel(req)
-        self._closed = True
-        self.allocator.assert_quiescent()
-        if self.flight.enabled:
-            chaos.remove_fault_observer(self._on_chaos_fault)
-            self._dump_postmortem("close")
-        if self.tracer.enabled and self.trace_path:
-            self.tracer.save(self.trace_path)
+        with self.registry.lock:
+            self.finish_inflight()
+            self.stop_admission()
+            for req in (
+                list(self.scheduler.waiting) + self.scheduler.running
+            ):
+                self.scheduler.cancel(req)
+            self._closed = True
+            self.allocator.assert_quiescent()
+            if self.flight.enabled:
+                chaos.remove_fault_observer(self._on_chaos_fault)
+                self._dump_postmortem("close")
+            if self.tracer.enabled and self.trace_path:
+                self.tracer.save(self.trace_path)
+        if self.sentinel is not None:
+            self.sentinel.disarm()
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
 
     def __enter__(self) -> "InferenceEngine":
         return self

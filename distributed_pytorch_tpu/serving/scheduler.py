@@ -283,6 +283,35 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.waiting) or any(r is not None for r in self.slots)
 
+    def describe_requests(
+        self, now: Optional[float] = None
+    ) -> List[dict]:
+        """Per-request live-state for ``/statusz``: every waiting and
+        slotted request as one JSON-serializable dict — phase (the request
+        state), slot, age since submit, prompt/cached/generated token
+        counts, preemptions. Read-only; the engine calls it under the
+        registry lock so a server-thread reader never sees a slot table
+        mid-update."""
+        if now is None:
+            now = time.perf_counter()
+
+        def describe(req: Request) -> dict:
+            return {
+                "req_id": req.req_id,
+                "phase": req.state.value,
+                "slot": req.slot,
+                "age_s": max(0.0, now - req.submit_time),
+                "prompt_len": len(req.prompt),
+                "len_cached": req.len_cached,
+                "generated": req.n_generated,
+                "max_new_tokens": req.params.max_new_tokens,
+                "preempt_count": req.preempt_count,
+            }
+
+        out = [describe(r) for r in self.waiting]
+        out.extend(describe(r) for r in self.slots if r is not None)
+        return out
+
     # ------------------------------------------------------------ mutation
 
     def add(self, req: Request) -> None:

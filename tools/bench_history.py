@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Perf-trajectory record for ``bench.py --serving``.
+
+Every serving bench run collapses to one JSONL row — headline tokens/sec
+and TPOT p50 per engine config, plus the obs-parity numbers — appended to
+``BENCH_HISTORY.jsonl``. CI replays the gate on every PR: extract a fresh
+candidate row from the just-produced ``BENCH_SERVING.json`` and diff it
+against the LAST COMMITTED history row with a +/-10 percent tolerance —
+tokens/sec may not drop, TPOT p50 may not rise, beyond the gate. The
+history file is the repo's perf memory; the gate is what turns "the bench
+exists" into "regressions fail CI".
+
+Usage:
+    python tools/bench_history.py append [--bench BENCH_SERVING.json]
+                                         [--history BENCH_HISTORY.jsonl]
+    python tools/bench_history.py check  [--bench BENCH_SERVING.json]
+                                         [--history BENCH_HISTORY.jsonl]
+                                         [--tolerance 0.10]
+
+``check`` exits 1 on any gated regression, 0 otherwise (including when
+the history is empty — the first row has nothing to regress against).
+Stdlib only; importable (``extract_row`` / ``compare_rows``) so the gate
+logic is unit-tested without running the bench.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+GATED_HIGHER_IS_BETTER = ("tokens_per_sec",)
+GATED_LOWER_IS_BETTER = ("tpot_s_p50",)
+
+
+def _config_key(row: dict) -> str:
+    """Stable label for one bench row's engine config, e.g.
+    ``prefix=on,spec=off`` (plus ``mesh=2x4`` when the row is meshed)."""
+    parts = [
+        f"prefix={'on' if row.get('prefix_caching') else 'off'}",
+        f"spec={'on' if row.get('speculative') else 'off'}",
+    ]
+    if row.get("mesh"):
+        parts.append(f"mesh={row['mesh']}")
+    return ",".join(parts)
+
+
+def _git_rev() -> Optional[str]:
+    try:
+        return (
+            subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True,
+                text=True,
+                timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip()
+            or None
+        )
+    except Exception:
+        return None
+
+
+def extract_row(bench: dict) -> dict:
+    """Collapse one BENCH_SERVING.json document into one history row."""
+    configs: Dict[str, dict] = {}
+    for row in bench.get("rows", []):
+        stats = row.get("stats", {})
+        configs[_config_key(row)] = {
+            "tokens_per_sec": stats.get("tokens_per_sec"),
+            "tpot_s_p50": stats.get("tpot_s_p50"),
+            "ttft_s_p50": stats.get("ttft_s_p50"),
+            "requests_completed": stats.get("requests_completed"),
+        }
+    out = {
+        "recorded_at": datetime.datetime.now(
+            datetime.timezone.utc
+        ).isoformat(timespec="seconds"),
+        "git_rev": _git_rev(),
+        "platform": bench.get("platform"),
+        "device_kind": bench.get("device_kind"),
+        "configs": configs,
+    }
+    obs = bench.get("obs")
+    if obs:
+        out["obs"] = {
+            key: obs.get(key)
+            for key in (
+                "tokens_per_sec_obs_on",
+                "tokens_per_sec_obs_off",
+                "tpot_p50_obs_overhead",
+                "greedy_tokens_identical_with_tracing",
+                "greedy_tokens_identical_with_server",
+                "recompiles_at_steady_state",
+                "scrapes_mid_run",
+            )
+            if key in obs
+        }
+    return out
+
+
+def compare_rows(
+    prev: dict, cur: dict, tolerance: float = 0.10
+) -> List[str]:
+    """Diff two history rows under the gate; returns regression messages
+    (empty = pass). Only configs present in BOTH rows are gated — a brand
+    new config has no baseline, a retired one no candidate. Comparable
+    platforms only: a device_kind change voids the gate (CPU numbers say
+    nothing about TPU numbers)."""
+    if prev.get("device_kind") != cur.get("device_kind"):
+        return []
+    failures: List[str] = []
+    prev_configs = prev.get("configs", {})
+    for key, cur_stats in cur.get("configs", {}).items():
+        prev_stats = prev_configs.get(key)
+        if prev_stats is None:
+            continue
+        for metric in GATED_HIGHER_IS_BETTER:
+            old, new = prev_stats.get(metric), cur_stats.get(metric)
+            if old and new is not None and new < old * (1 - tolerance):
+                failures.append(
+                    f"[{key}] {metric} fell {old:.4g} -> {new:.4g} "
+                    f"(> {tolerance:.0%} drop)"
+                )
+        for metric in GATED_LOWER_IS_BETTER:
+            old, new = prev_stats.get(metric), cur_stats.get(metric)
+            if old and new is not None and new > old * (1 + tolerance):
+                failures.append(
+                    f"[{key}] {metric} rose {old:.4g} -> {new:.4g} "
+                    f"(> {tolerance:.0%} rise)"
+                )
+    return failures
+
+
+def load_history(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("command", choices=("append", "check"))
+    parser.add_argument("--bench", default="BENCH_SERVING.json")
+    parser.add_argument("--history", default="BENCH_HISTORY.jsonl")
+    parser.add_argument("--tolerance", type=float, default=0.10)
+    args = parser.parse_args(argv)
+
+    with open(args.bench) as f:
+        bench = json.load(f)
+    row = extract_row(bench)
+
+    if args.command == "append":
+        with open(args.history, "a") as f:
+            f.write(json.dumps(row, sort_keys=True) + "\n")
+        print(f"[bench_history] appended to {args.history}:")
+        print(json.dumps(row, indent=2, sort_keys=True))
+        return 0
+
+    history = load_history(args.history)
+    if not history:
+        print(
+            f"[bench_history] {args.history} is empty — nothing to gate "
+            "against (first row passes by definition)"
+        )
+        return 0
+    prev = history[-1]
+    failures = compare_rows(prev, row, tolerance=args.tolerance)
+    if failures:
+        print(
+            f"[bench_history] FAIL: perf regressed beyond "
+            f"+/-{args.tolerance:.0%} vs last committed row "
+            f"({prev.get('recorded_at')}, rev {prev.get('git_rev')}):"
+        )
+        for failure in failures:
+            print(f"  {failure}")
+        return 1
+    print(
+        f"[bench_history] PASS: within +/-{args.tolerance:.0%} of last "
+        f"committed row ({prev.get('recorded_at')}, "
+        f"rev {prev.get('git_rev')})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""``top`` for serving engines — the human end of the observability wire.
+
+Polls one or more ``/statusz`` endpoints (see ``obs/server.py``) and
+redraws an ANSI dashboard: per-engine health, queue depth, running
+requests, page states, TTFT/TPOT percentiles, tokens/sec, SLO firing set,
+and the recompile-sentinel counter — plus the busiest in-flight requests
+of the first engine. Stdlib only, one process, no curses dependency (ANSI
+home+clear is enough and survives dumb terminals via ``--once``).
+
+Usage:
+    python tools/obs_top.py http://127.0.0.1:8321 [more urls...]
+    python tools/obs_top.py --once URL        # one frame, no screen clear
+    python tools/obs_top.py --interval 0.5 URL
+
+``render_frame`` is a pure function of the polled documents, so tests
+drive it with canned statusz payloads and never open a socket.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import List, Optional, Tuple
+
+CLEAR = "\x1b[H\x1b[2J"
+BOLD = "\x1b[1m"
+RED = "\x1b[31m"
+YELLOW = "\x1b[33m"
+GREEN = "\x1b[32m"
+RESET = "\x1b[0m"
+
+
+def poll(url: str, timeout: float = 2.0) -> Optional[dict]:
+    """One ``/statusz`` GET; None when the engine is unreachable (a dead
+    replica is a row in the dashboard, not a crash of the dashboard)."""
+    try:
+        with urllib.request.urlopen(
+            url.rstrip("/") + "/statusz", timeout=timeout
+        ) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except Exception:
+        return None
+
+
+def _ms(value) -> str:
+    """Seconds -> fixed-width milliseconds, '-' for missing/NaN."""
+    if not isinstance(value, (int, float)) or value != value:
+        return "     -"
+    return f"{value * 1e3:6.2f}"
+
+
+def _health_cell(health: str, color: bool) -> str:
+    text = f"{health:<8}"
+    if not color:
+        return text
+    tint = {"live": GREEN, "draining": YELLOW}.get(health, RED)
+    return f"{tint}{text}{RESET}"
+
+
+def render_frame(
+    polled: List[Tuple[str, Optional[dict]]], color: bool = True
+) -> str:
+    """One dashboard frame from ``[(url, statusz-or-None), ...]``."""
+    bold = BOLD if color else ""
+    reset = RESET if color else ""
+    lines = [
+        f"{bold}{'ENGINE':<28} {'HEALTH':<8} {'Q':>4} {'RUN':>4} "
+        f"{'PAGES f/r/i':>14} {'TTFT p50':>9} {'TPOT p50':>9} "
+        f"{'TPOT p95':>9} {'TOK/S':>8} {'RECOMP':>7}  SLO{reset}"
+    ]
+    for url, doc in polled:
+        name = url.replace("http://", "")[:28]
+        if doc is None:
+            down = f"{RED}down{RESET}    " if color else "down    "
+            lines.append(f"{name:<28} {down}")
+            continue
+        pages = doc.get("pages", {})
+        page_cell = (
+            f"{pages.get('pages_free', 0)}/"
+            f"{pages.get('pages_referenced', 0)}/"
+            f"{pages.get('pages_cached_idle', 0)}"
+        )
+        latency = doc.get("latency", {})
+        sentinel = doc.get("recompile_sentinel") or {}
+        recomp = sentinel.get("count", 0)
+        recomp_cell = f"{recomp:>7}"
+        if color and recomp:
+            recomp_cell = f"{RED}{recomp_cell}{RESET}"
+        slo = doc.get("slo") or {}
+        firing = slo.get("firing", [])
+        slo_cell = ",".join(firing) if firing else "ok"
+        if color and firing:
+            slo_cell = f"{RED}{slo_cell}{RESET}"
+        lines.append(
+            f"{name:<28} {_health_cell(doc.get('health', '?'), color)} "
+            f"{doc.get('queue_depth', 0):>4} "
+            f"{doc.get('running_requests', 0):>4} "
+            f"{page_cell:>14} "
+            f"{_ms(latency.get('ttft_p50_s')):>9} "
+            f"{_ms(latency.get('tpot_p50_s')):>9} "
+            f"{_ms(latency.get('tpot_p95_s')):>9} "
+            f"{latency.get('tokens_per_sec', 0) or 0:>8.1f} "
+            f"{recomp_cell}  {slo_cell}"
+        )
+    first = next((doc for _u, doc in polled if doc), None)
+    if first and first.get("requests"):
+        lines.append("")
+        lines.append(
+            f"{bold}{'REQ':>6} {'PHASE':<9} {'SLOT':>4} {'AGE s':>7} "
+            f"{'PROMPT':>7} {'CACHED':>7} {'GEN':>6} {'PREEMPT':>7}"
+            f"{reset}"
+        )
+        requests = sorted(
+            first["requests"], key=lambda r: -r.get("age_s", 0)
+        )[:12]
+        for req in requests:
+            lines.append(
+                f"{req.get('req_id', '?'):>6} "
+                f"{req.get('phase', '?'):<9} "
+                f"{str(req.get('slot', '-')):>4} "
+                f"{req.get('age_s', 0):>7.2f} "
+                f"{req.get('prompt_len', 0):>7} "
+                f"{req.get('len_cached', 0):>7} "
+                f"{req.get('generated', 0):>6} "
+                f"{req.get('preempt_count', 0):>7}"
+            )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("urls", nargs="+", help="engine base URLs")
+    parser.add_argument("--interval", type=float, default=1.0)
+    parser.add_argument(
+        "--once",
+        action="store_true",
+        help="render one frame and exit (no screen clearing)",
+    )
+    parser.add_argument(
+        "--no-color", action="store_true", help="plain-text output"
+    )
+    args = parser.parse_args(argv)
+    color = not args.no_color and sys.stdout.isatty()
+    try:
+        while True:
+            frame = render_frame(
+                [(url, poll(url)) for url in args.urls], color=color
+            )
+            if args.once:
+                sys.stdout.write(frame)
+                return 0
+            sys.stdout.write(CLEAR + frame)
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

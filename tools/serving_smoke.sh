@@ -14,9 +14,15 @@
 # trace (well-formed JSON, per-request span count == completed requests,
 # step slices present), and cross-checks the unified metrics registry
 # against engine ground truth; CI uploads traces/serving_trace.json as a
-# build artifact.
+# build artifact. A fifth scenario pins the SLO burn-rate monitor (tight
+# objective fires, loose stays quiet). A sixth scenario drives the
+# observability WIRE: introspection server scraped (/metrics under the
+# strict Prometheus grammar, /healthz, /statusz) from another thread
+# mid-run with token parity, the XLA program ledger populated, and the
+# armed recompile sentinel reading zero at steady state;
+# traces/statusz_snapshot.json is uploaded as a CI artifact.
 #
-#   bash tools/serving_smoke.sh          # the four default scenarios
+#   bash tools/serving_smoke.sh          # the six default scenarios
 #   bash tools/serving_smoke.sh mesh     # mesh-sharded scenario only
 #
 # The ``mesh`` scenario boots the engine on a (2,4) ("data","model") mesh
@@ -366,5 +372,93 @@ print(
     f"{int(snap5['serving_slo_tpot_tight_alerts_total'])} alert(s) "
     f"(burn_fast={state5['tpot_tight']['burn_fast']:.1f}), "
     "loose objective quiet"
+)
+
+# ---- scenario 6: the observability wire, scraped over HTTP mid-run ----
+# Engine + XLA program ledger + armed recompile sentinel + introspection
+# server; a scraper thread GETs /metrics + /healthz + /statusz WHILE the
+# engine steps. Asserts: tokens bitwise-identical to the unserved engine
+# of scenario 4, /metrics parses under the strict Prometheus grammar,
+# /healthz is live during the run and draining after stop_admission(),
+# and the sentinel reads ZERO across the fully-warmed steady-state run.
+# The final /statusz snapshot lands in traces/ as a CI artifact.
+import os
+import threading
+
+from distributed_pytorch_tpu.obs import validate_exposition
+from distributed_pytorch_tpu.obs.server import scrape
+
+eng6 = InferenceEngine(
+    model, params, max_slots=4, max_seq_len=32, page_size=4,
+    token_budget=16, max_prefill_chunk=8, xla_ledger=True,
+)
+# Warm every program the workload needs — one request per power-of-two
+# prefill bucket (a prompt of length c+1 prefills exactly one c-chunk)
+# plus the shared decode step — then arm: from here a recompile is a
+# failure. Warm prompts must share NO prefix: a prefix-cache hit would
+# shave pages off the prefill and leave the big chunk uncompiled.
+chunk = 1
+while chunk <= 8:
+    warm = eng6.submit(
+        [(37 * chunk + i) % 128 for i in range(chunk + 1)],
+        SamplingParams(max_new_tokens=2),
+    )
+    eng6.run()
+    assert eng6.poll(warm).finished
+    chunk *= 2
+sentinel = eng6.arm_recompile_sentinel()
+server = eng6.serve()
+
+stop = threading.Event()
+scrapes = {"n": 0, "errors": 0, "live": 0}
+
+def scraper():
+    while not stop.is_set():
+        try:
+            validate_exposition(scrape(server.url, "/metrics", timeout=30.0))
+            health = scrape(server.url, "/healthz", timeout=30.0)
+            statusz = scrape(server.url, "/statusz", timeout=30.0)
+            scrapes["n"] += 1
+            if health["status"] == "live" and statusz["health"] == "live":
+                scrapes["live"] += 1
+        except Exception:
+            scrapes["errors"] += 1
+        stop.wait(0.02)
+
+thread = threading.Thread(target=scraper, daemon=True)
+thread.start()
+ids6 = [eng6.submit(p, SamplingParams(max_new_tokens=6)) for p in prompts4]
+eng6.run()
+stop.set()
+thread.join(timeout=30)
+
+served_tokens = [eng6.poll(r).generated for r in ids6]
+assert served_tokens == untraced_tokens, (
+    "the introspection server changed the generated tokens"
+)
+assert scrapes["n"] > 0 and scrapes["errors"] == 0, scrapes
+assert scrapes["live"] == scrapes["n"], scrapes
+assert sentinel.count == 0, (
+    f"recompile sentinel tripped at steady state: {sentinel.trips}"
+)
+
+statusz = scrape(server.url, "/statusz")
+assert statusz["engine"]["steps"] == eng6.metrics.engine_steps
+assert statusz["recompile_sentinel"]["armed"]
+assert {p["name"] for p in statusz["xla"]["programs"]} >= {"decode_step"}
+os.makedirs("traces", exist_ok=True)
+with open("traces/statusz_snapshot.json", "w") as f:
+    json.dump(statusz, f, indent=1, default=str)
+
+eng6.stop_admission()
+assert scrape(server.url, "/healthz")["status"] == "draining"
+eng6.close()
+
+print(
+    "[serving_smoke] PASS: observability wire, tokens identical with "
+    f"server scraped mid-run ({scrapes['n']} scrapes, all valid), "
+    f"recompiles_at_steady_state={sentinel.count}, "
+    f"{len(statusz['xla']['programs'])} programs ledgered "
+    "-> traces/statusz_snapshot.json"
 )
 EOF

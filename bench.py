@@ -29,6 +29,7 @@ every measurement below synchronizes by fetching the loss VALUE.
 import argparse
 import itertools
 import json
+import math
 import os
 import threading
 import time
@@ -897,6 +898,242 @@ def bench_serving(
     return out
 
 
+def bench_fleet(
+    n_replicas: int = 3,
+    n_requests: int = 24,
+    arrival_rate_hz: float = 20.0,
+    seed: int = 0,
+    shared_prefix_len: int = 24,
+    kill_round: int = 12,
+):
+    """Routed-fleet benchmark with a mid-run replica kill: the SAME Poisson
+    workload as ``bench_serving``, routed across ``n_replicas`` in-process
+    engines by the ``FleetRouter``, with a seeded ``kill_replica`` chaos
+    fault SIGKILLing (in-process: abandoning) the replica that affinity
+    routing loaded — chosen as the rendezvous target of the shared prefix,
+    so the kill provably lands on a replica holding decodes.
+
+    Reported into the ``fleet`` section of ``BENCH_SERVING.json``:
+    aggregate tokens/sec across the fleet, the router's dead-replica
+    detection latency, and the failover TTFT spike — time from failover
+    re-admission to the next committed token on the survivor, against the
+    single-engine baseline TTFT p50. The acceptance row is
+    ``greedy_tokens_match_single_engine``: every request (including the
+    failed-over ones) must emit byte-identical greedy tokens to one
+    uninterrupted engine."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_pytorch_tpu import chaos
+    from distributed_pytorch_tpu.models.transformer import TransformerLM
+    from distributed_pytorch_tpu.serving import (
+        FleetRouter,
+        InferenceEngine,
+        SamplingParams,
+        prefix_affinity_key,
+    )
+    from distributed_pytorch_tpu.serving.admission import ServingMetrics
+    from distributed_pytorch_tpu.serving.fleet import _rendezvous
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    model = TransformerLM(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=8, d_ff=256,
+        dtype=jnp.float32 if on_cpu else jnp.bfloat16,
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    rng = np.random.default_rng(seed)
+    shared = (
+        rng.integers(0, 256, shared_prefix_len).tolist()
+        if shared_prefix_len else []
+    )
+    arrivals = np.cumsum(rng.exponential(1.0 / arrival_rate_hz, n_requests))
+    prompts = [
+        shared + rng.integers(0, 256, int(rng.integers(4, 17))).tolist()
+        for _ in range(n_requests)
+    ]
+    warm_rng = np.random.default_rng(seed + 1)
+    page_size = 8
+
+    def mk_engine():
+        eng = InferenceEngine(
+            model, params, max_slots=4, max_seq_len=64, page_size=page_size,
+            token_budget=64, max_prefill_chunk=32, max_queue=n_requests,
+            prefix_cache=True,
+        )
+        # Same off-the-clock compile warm-up as bench_serving: one request
+        # per prefill bucket, then reset accounting so TTFT measures
+        # scheduling (and failover), not XLA compilation.
+        chunk = 1
+        while chunk <= 32:
+            warm = eng.submit(
+                warm_rng.integers(0, 256, chunk + 1).tolist(),
+                SamplingParams(max_new_tokens=2),
+            )
+            eng.run()
+            assert eng.poll(warm).finished
+            chunk *= 2
+        eng.metrics = ServingMetrics(speculative=False)
+        eng.admission.accepted = 0
+        eng.admission.cached_tokens_admitted = 0
+        eng.prefix_cache.lookups = eng.prefix_cache.hits = 0
+        eng.prefix_cache.tokens_hit = eng.prefix_cache.tokens_missed = 0
+        return eng
+
+    def drive(submit, step, has_work, poll):
+        start = time.perf_counter()
+        submitted = 0
+        handles = []
+        while submitted < n_requests or has_work():
+            now = time.perf_counter() - start
+            while submitted < n_requests and arrivals[submitted] <= now:
+                handles.append(
+                    submit(
+                        prompts[submitted],
+                        SamplingParams(max_new_tokens=16),
+                    )
+                )
+                submitted += 1
+            if has_work():
+                step()
+            elif submitted < n_requests:
+                time.sleep(min(arrivals[submitted] - now, 0.01))
+        elapsed = time.perf_counter() - start
+        tokens = [poll(h).generated for h in handles]
+        return tokens, elapsed
+
+    # Single-engine reference: uninterrupted run of the identical workload
+    # — the token-parity oracle and the baseline TTFT for the spike ratio.
+    ref = mk_engine()
+    ref_tokens, _ = drive(
+        ref.submit,
+        ref.step,
+        lambda: ref.scheduler.has_work or ref._inflight is not None,
+        ref.poll,
+    )
+    baseline_ttft_p50 = ref.stats().get("ttft_s_p50")
+    ref.close()
+
+    # The kill lands on the replica the shared prefix routes to, so it is
+    # holding decodes when it dies (all affinity traffic is there).
+    names = [f"r{i}" for i in range(n_replicas)]
+    key = prefix_affinity_key(prompts[0], page_size)
+    victim = _rendezvous(key, names) if key is not None else names[0]
+    victim_idx = int(victim[1:])
+
+    prev_plan = os.environ.get(chaos.ENV_VAR)
+    os.environ[chaos.ENV_VAR] = json.dumps({
+        "seed": seed,
+        "faults": [
+            {"kind": "kill_replica", "replica": victim_idx,
+             "at_step": kill_round}
+        ],
+    })
+    chaos._reset()
+    router = FleetRouter(
+        [mk_engine() for _ in range(n_replicas)], probe_every=4
+    )
+    try:
+        fleet_tokens, elapsed = drive(
+            router.submit,
+            router.step,
+            lambda: any(
+                not s.finished for s in router._shadows.values()
+            ),
+            router.poll,
+        )
+        total_tokens = sum(len(t) for t in fleet_tokens)
+        detection_s = router.registry.read_gauge(
+            "dead_replica_detection_seconds"
+        )
+        failover_p50 = router.registry.read_quantile(
+            "failover_ttft_seconds", 0.5
+        )
+        failover_max = router._failover_ttft.max
+        failed_over = router.registry.read_counter(
+            "requests_failed_over_total"
+        )
+        leaked = sum(
+            int(rep.engine.registry.read_gauge("pages_referenced"))
+            for rep in router.replicas()
+            if rep.state != "dead"
+        )
+        fleet_doc = {
+            "n_replicas": n_replicas,
+            "workload": (
+                f"fleet{n_replicas}_poisson{arrival_rate_hz:g}hz"
+                f"_n{n_requests}_prefix{shared_prefix_len}"
+            ),
+            "kill_round": kill_round,
+            "victim": victim,
+            "victim_dead": any(
+                r.name == victim and r.state == "dead"
+                for r in router.replicas()
+            ),
+            "aggregate_tokens_per_sec": round(total_tokens / elapsed, 2),
+            "requests_completed": len(fleet_tokens),
+            "requests_failed_over": int(failed_over),
+            "detection_latency_s": round(detection_s, 6),
+            "failover_ttft_s_p50": (
+                round(failover_p50, 6)
+                if failover_p50 == failover_p50 else None  # NaN guard
+            ),
+            "failover_ttft_s_max": (
+                round(failover_max, 6)
+                if failover_max > -math.inf else None
+            ),
+            "baseline_ttft_s_p50": baseline_ttft_p50,
+            # The spike: failover-TTFT p50 over baseline TTFT p50 — how
+            # much worse a failed-over request's next token is than a
+            # fresh request's first.
+            "failover_ttft_spike_x": (
+                round(failover_p50 / baseline_ttft_p50, 4)
+                if failover_p50 == failover_p50 and baseline_ttft_p50
+                else None
+            ),
+            "greedy_tokens_match_single_engine": (
+                fleet_tokens == ref_tokens
+            ),
+            "pages_leaked_on_survivors": leaked,
+            "routed_affinity": int(
+                router.registry.read_counter("routed_affinity_total")
+            ),
+            "routed_least_loaded": int(
+                router.registry.read_counter("routed_least_loaded_total")
+            ),
+        }
+    finally:
+        router.close()
+        if prev_plan is None:
+            os.environ.pop(chaos.ENV_VAR, None)
+        else:
+            os.environ[chaos.ENV_VAR] = prev_plan
+        chaos._reset()
+
+    # Merge into BENCH_SERVING.json: the fleet section rides next to the
+    # single-engine rows (bench_history records it un-gated).
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_SERVING.json"
+    )
+    if os.path.exists(path):
+        with open(path) as f:
+            doc = json.load(f)
+    else:
+        doc = {
+            "mode": "serving_fleet_only",
+            "platform": jax.devices()[0].platform,
+            "device_kind": jax.devices()[0].device_kind,
+            "rows": [],
+        }
+    doc["fleet"] = fleet_doc
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    return fleet_doc
+
+
 def attach_mfu(result: dict, peak: float) -> dict:
     per_chip = result["flops_per_step"] * result["steps_per_sec"] / result["n_chips"]
     result["model_tflops_per_sec_per_chip"] = round(per_chip / 1e12, 2)
@@ -1033,6 +1270,14 @@ def main():
         "prefix-caching off-vs-on rows) and write BENCH_SERVING.json",
     )
     parser.add_argument(
+        "--fleet", type=int, default=0, metavar="N",
+        help="benchmark the N-replica routed fleet under the --serving "
+        "Poisson workload with a seeded mid-run replica kill (aggregate "
+        "tok/s, dead-replica detection latency, failover TTFT spike, "
+        "greedy-parity vs one uninterrupted engine); merges a 'fleet' "
+        "section into BENCH_SERVING.json",
+    )
+    parser.add_argument(
         "--shared-prefix-len", type=int, default=24, metavar="L",
         help="length of the system-prompt prefix every --serving request "
         "shares (0 = fully distinct prompts)",
@@ -1074,12 +1319,14 @@ def main():
         # import is authoritative.
         jax.config.update("jax_platforms", "cpu")
 
-    if sum((args.scaling, args.window_sweep, args.serving)) > 1:
+    if sum(
+        (args.scaling, args.window_sweep, args.serving, bool(args.fleet))
+    ) > 1:
         # All are exclusive whole-run modes; silently preferring one would
         # burn a chip window on the wrong measurement (the queue scripts
         # run these as separate precious steps).
-        parser.error("--scaling, --window_sweep and --serving are exclusive "
-                     "modes; run them as separate invocations")
+        parser.error("--scaling, --window_sweep, --serving and --fleet are "
+                     "exclusive modes; run them as separate invocations")
     scaling_metric = "dp_weak_scaling_efficiency"
     if args.scaling:
         metric, unit = scaling_metric, "ratio_vs_1dev"
@@ -1087,6 +1334,8 @@ def main():
         metric, unit = "window1024_speedup_vs_full_t8192", "ratio"
     elif args.serving:
         metric, unit = "serving_throughput_tok_per_sec", "tok/s"
+    elif args.fleet:
+        metric, unit = "fleet_aggregate_tok_per_sec", "tok/s"
     else:
         metric, unit = "resnet50_bf16_train_steps_per_sec", "steps/s"
 
@@ -1182,6 +1431,40 @@ def run_benches(args, dev, peak):
             ]
             line["mesh_greedy_parity"] = result["mesh_greedy_parity"]
         print(json.dumps(line))
+        return
+
+    if args.fleet:
+        # Exclusive mode: the routed replica fleet under the same Poisson
+        # workload, with a seeded kill_replica fault landing on the
+        # affinity-loaded replica mid-decode. The headline is aggregate
+        # fleet tok/s; the acceptance row is greedy token parity with one
+        # uninterrupted engine despite the kill.
+        fleet = bench_fleet(
+            n_replicas=args.fleet,
+            shared_prefix_len=args.shared_prefix_len,
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "fleet_aggregate_tok_per_sec",
+                    "value": fleet["aggregate_tokens_per_sec"],
+                    "unit": "tok/s",
+                    "vs_baseline": 1.0,
+                    "n_replicas": fleet["n_replicas"],
+                    "victim": fleet["victim"],
+                    "requests_failed_over": fleet["requests_failed_over"],
+                    "detection_latency_s": fleet["detection_latency_s"],
+                    "failover_ttft_s_p50": fleet["failover_ttft_s_p50"],
+                    "failover_ttft_spike_x": fleet["failover_ttft_spike_x"],
+                    "greedy_tokens_match_single_engine": fleet[
+                        "greedy_tokens_match_single_engine"
+                    ],
+                    "pages_leaked_on_survivors": fleet[
+                        "pages_leaked_on_survivors"
+                    ],
+                }
+            )
+        )
         return
 
     if args.window_sweep:

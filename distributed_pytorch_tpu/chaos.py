@@ -64,6 +64,19 @@ _SERVING_PHASE = {
     "reclaim_under_queue_pressure": "step",
 }
 
+# Fleet-level fault kinds, matched against the FleetRouter's per-pump hook
+# (see on_fleet_step). Unlike the serving kinds these never signal or raise
+# here: chaos only DECLARES which replica suffers what and when; the router
+# is the blast radius and applies the semantics itself (abandon the engine
+# object for kill, stop reaching it for partition, delay its steps for
+# slow). That keeps this module free of any engine knowledge while the
+# drill stays seeded and declarative.
+_FLEET_KINDS = (
+    "kill_replica",
+    "partition_replica",
+    "slow_replica",
+)
+
 _KINDS = (
     "kill",
     "hang",
@@ -72,7 +85,7 @@ _KINDS = (
     "drain",
     "corrupt_snapshot",
     "store_partition",
-) + _SERVING_KINDS
+) + _SERVING_KINDS + _FLEET_KINDS
 
 
 class InjectedFault(RuntimeError):
@@ -131,6 +144,19 @@ class Fault:
     installed handler turns that into a drain), while ``"raise"`` raises
     :class:`InjectedFault` so in-process pytest drills can model death by
     abandoning the engine mid-step.
+
+    Fleet kinds, fired from the FleetRouter's :func:`on_fleet_step` hook
+    (``at_step`` counts router pump rounds and is again a LOWER bound;
+    unset means "due immediately"). ``replica`` (required) is the index of
+    the target in the router's attach order. ``kill_replica`` abandons the
+    replica's engine object between steps with an unresolved overlapped
+    dispatch in flight — the in-process SIGKILL twin; ``partition_replica``
+    makes the replica unreachable (probes fail, steps stop) for
+    ``duration`` seconds (0 = until the run ends); ``slow_replica`` delays
+    every step of the replica by ``duration`` seconds — the tail-latency
+    straggler that hedging exists for. These faults fire as *declarations*
+    (mode ``"router"``): the hook returns them to the router, which applies
+    the damage itself.
     """
 
     kind: str
@@ -143,13 +169,27 @@ class Fault:
     mode: str = "flip"  # corrupt_snapshot: "flip"|"truncate"; serving: "hard"|"raise"
     exit_code: int = 13
     min_queue: Optional[int] = None  # reclaim_under_queue_pressure threshold
+    replica: Optional[int] = None  # fleet kinds: router attach-order index
 
     def __post_init__(self):
         if self.kind == "drain_at_step":
             self.kind = "drain"
         if self.kind not in _KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}; one of {_KINDS}")
-        if self.kind in _SERVING_KINDS:
+        if self.kind in _FLEET_KINDS:
+            if self.replica is None:
+                raise ValueError(
+                    f"{self.kind} requires 'replica' (the router "
+                    "attach-order index of the target)"
+                )
+            # Router-applied; signal/raise modes are meaningless here.
+            self.mode = "router"
+        elif self.replica is not None:
+            raise ValueError(
+                f"'replica' only applies to fleet kinds {_FLEET_KINDS}, "
+                f"not {self.kind!r}"
+            )
+        elif self.kind in _SERVING_KINDS:
             if self.mode == "flip":  # the dataclass default; serving = hard
                 self.mode = "hard"
             if self.mode not in ("hard", "raise"):
@@ -208,6 +248,7 @@ class FaultPlan:
         self._steps = 0
         self._saves = 0
         self._serving_steps = 0
+        self._fleet_steps = 0
         self._fired: set = set()
         self._lock = threading.Lock()
 
@@ -357,6 +398,35 @@ class FaultPlan:
             self._fired.add(i)
             self._fire_serving(fault)
 
+    def on_fleet_step(self) -> List[Fault]:
+        """Fleet chaos hook: the FleetRouter calls this once per pump
+        round. Advances the fleet-round counter and returns the due fleet
+        faults (``at_step`` is a lower bound; unset = due now) for the
+        ROUTER to apply — chaos declares, the router executes, so killing
+        "replica 2" needs no knowledge of engine objects here. Each fault
+        fires once; observers are notified exactly as for signal-delivered
+        kinds (the flight recorder's pre-SIGKILL dump hook)."""
+        with self._lock:
+            self._fleet_steps += 1
+            step = self._fleet_steps
+        due: List[Fault] = []
+        for i, fault in enumerate(self.faults):
+            if fault.kind not in _FLEET_KINDS or i in self._fired:
+                continue
+            if fault.at_step is not None and step < fault.at_step:
+                continue
+            if not self._identity_matches(fault):
+                continue
+            self._fired.add(i)
+            print(
+                f"[chaos] fleet fault {fault.kind} on replica "
+                f"{fault.replica} at router round {step}",
+                flush=True,
+            )
+            _notify_observers(fault.kind, step, fault.mode)
+            due.append(fault)
+        return due
+
     def _fire_serving(self, fault: Fault) -> None:
         step = self._serving_steps
         _notify_observers(fault.kind, step, fault.mode)
@@ -500,6 +570,13 @@ def on_serving_phase(phase: str, queue_depth: int = 0) -> None:
     plan = get_plan()
     if plan is not None:
         plan.on_serving_phase(phase, queue_depth=queue_depth)
+
+
+def on_fleet_step() -> List[Fault]:
+    plan = get_plan()
+    if plan is None:
+        return []
+    return plan.on_fleet_step()
 
 
 # ------------------------------------------------------------- FaultProxy

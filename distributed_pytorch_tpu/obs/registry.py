@@ -321,18 +321,36 @@ class MetricsRegistry:
 
     @classmethod
     def merge_remote(
-        cls, urls: Sequence[str], timeout: float = 5.0
+        cls,
+        urls: Sequence[str],
+        timeout: float = 5.0,
+        *,
+        retries: int = 1,
+        backoff_s: float = 0.1,
     ) -> dict:
         """Scrape each engine's ``/snapshot`` endpoint (see
         ``obs.server.IntrospectionServer``) and :meth:`merge` the payloads
         — N engines' metrics aggregated over HTTP, the routed-fleet signal.
-        ``urls`` are server base URLs (``http://host:port``). A dead peer
-        raises; fleet callers that want partial aggregation catch per-URL
-        and merge what answered."""
+        ``urls`` are server base URLs (``http://host:port``). ``timeout``
+        bounds connect + every read per attempt and ``retries`` transport
+        retries ride over blips (both forwarded to ``scrape``), so one
+        dead or partitioned replica delays a fleet-wide merge by a bounded
+        ``(retries+1) * timeout`` instead of hanging it. A peer still dead
+        after the retries raises; fleet callers that want partial
+        aggregation catch per-URL and merge what answered."""
         from distributed_pytorch_tpu.obs.server import scrape
 
         return cls.merge(
-            [scrape(url, "/snapshot", timeout=timeout) for url in urls]
+            [
+                scrape(
+                    url,
+                    "/snapshot",
+                    timeout=timeout,
+                    retries=retries,
+                    backoff_s=backoff_s,
+                )
+                for url in urls
+            ]
         )
 
     @classmethod

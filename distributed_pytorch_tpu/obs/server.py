@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -183,24 +184,52 @@ class IntrospectionServer:
 
 
 def scrape(
-    base_url: str, endpoint: str = "/snapshot", timeout: float = 5.0
+    base_url: str,
+    endpoint: str = "/snapshot",
+    timeout: float = 5.0,
+    *,
+    retries: int = 1,
+    backoff_s: float = 0.1,
 ) -> Union[dict, list, str]:
     """GET one introspection endpoint. Returns the decoded JSON document,
     or the raw text body for ``/metrics``. ``/healthz`` answers through
     its status code too — a 503 here still returns the JSON body rather
-    than raising, because "draining" is an answer, not an error."""
+    than raising, because "draining" is an answer, not an error.
+
+    ``timeout`` bounds BOTH the connect and every socket read (a peer that
+    accepts the connection and then never answers raises within
+    ``timeout``, it cannot wedge the caller), and transport failures —
+    refused connect, reset, timeout — are retried ``retries`` times with
+    exponential backoff before the last error propagates. The bound
+    matters more than the retry: a fleet-wide scrape or a router health
+    loop polls every replica in sequence, so one dead or partitioned
+    replica must cost at most ``(retries+1) * timeout + backoff``, never a
+    hang. An HTTP *error response* is an answer from a live server, not a
+    transport blip, and is never retried."""
     url = base_url.rstrip("/") + endpoint
-    try:
-        with urllib.request.urlopen(url, timeout=timeout) as resp:
-            body = resp.read().decode("utf-8")
-            ctype = resp.headers.get("Content-Type", "")
-    except urllib.error.HTTPError as err:
-        if endpoint.rstrip("/") == "/healthz":
-            return json.loads(err.read().decode("utf-8"))
-        raise
-    if _JSON in ctype:
-        return json.loads(body)
-    return body
+    attempts = max(1, int(retries) + 1)
+    for attempt in range(attempts):
+        try:
+            try:
+                with urllib.request.urlopen(url, timeout=timeout) as resp:
+                    body = resp.read().decode("utf-8")
+                    ctype = resp.headers.get("Content-Type", "")
+            except urllib.error.HTTPError as err:
+                if endpoint.rstrip("/") == "/healthz":
+                    return json.loads(err.read().decode("utf-8"))
+                raise
+        except urllib.error.HTTPError:
+            raise  # served error page: the server is alive and answered
+        except OSError:
+            # URLError (refused/reset, DNS) and the bare socket timeout a
+            # mid-response stall raises are both OSError subclasses.
+            if attempt + 1 >= attempts:
+                raise
+            time.sleep(backoff_s * (2 ** attempt))
+            continue
+        if _JSON in ctype:
+            return json.loads(body)
+        return body
 
 
 __all__ = ["IntrospectionServer", "scrape"]

@@ -37,6 +37,12 @@ from distributed_pytorch_tpu.serving.elastic import (
     snapshot_engine,
 )
 from distributed_pytorch_tpu.serving.engine import InferenceEngine
+from distributed_pytorch_tpu.serving.fleet import (
+    AutoscalePolicy,
+    FleetRouter,
+    NoLiveReplica,
+    prefix_affinity_key,
+)
 from distributed_pytorch_tpu.serving.kv_cache import (
     BlockTable,
     OutOfPages,
@@ -60,11 +66,14 @@ from distributed_pytorch_tpu.serving.scheduler import (
 __all__ = [
     "AdmissionController",
     "AdmissionError",
+    "AutoscalePolicy",
     "BlockTable",
     "DrainController",
     "EngineDraining",
     "EngineSnapshot",
+    "FleetRouter",
     "InferenceEngine",
+    "NoLiveReplica",
     "OutOfPages",
     "PENDING_TOKEN",
     "PagePoolGroup",
@@ -83,6 +92,7 @@ __all__ = [
     "drain_engine",
     "make_serving_mesh",
     "mesh_fingerprint",
+    "prefix_affinity_key",
     "publish_snapshot",
     "restore_engine",
     "snapshot_engine",

@@ -255,14 +255,28 @@ def drain_engine(engine, reason: str = "drain") -> EngineSnapshot:
 # ------------------------------------------------------------------ restore
 
 
-def restore_engine(engine, snapshot: EngineSnapshot) -> List[int]:
+def restore_engine(
+    engine, snapshot: EngineSnapshot, *, rebase_ids: bool = False
+) -> List[int]:
     """Re-admit every snapshotted request into a fresh ``engine``,
     preserving ids (= priorities), sampling state, deadline clocks, and
     tenant metadata. Each request enters WAITING with
     ``tokens = prompt + generated``; the normal admission path then
     re-prefills through the prefix cache — exactly the preemption-resume
     machinery, so restored output is token-identical to an uninterrupted
-    run. Returns the restored ids, oldest first."""
+    run. Returns the restored ids, oldest first.
+
+    ``rebase_ids=True`` mints FRESH ids from the target's counter instead
+    of preserving snapshot ids — the failover path for adopting several
+    replicas' snapshots into one survivor, where two engines that counted
+    ids from the same base would otherwise collide (preserving mode
+    refuses such a duplicate with ``ValueError``). Snapshot order (oldest
+    first) maps positionally onto the returned ids, so a router tracking
+    shadow state can re-key its table; relative priority WITHIN the
+    snapshot is preserved, but adopted requests rank behind the
+    survivor's existing ones (fresh ids are higher = younger). Token
+    streams are unaffected: sampling is keyed by per-request ``seed`` and
+    fold index, never by req_id."""
     if snapshot.version != SNAPSHOT_VERSION:
         raise ValueError(
             f"snapshot version {snapshot.version} != {SNAPSHOT_VERSION}"
@@ -286,11 +300,17 @@ def restore_engine(engine, snapshot: EngineSnapshot) -> List[int]:
     tr = engine.tracer
     with tr.phase("restore"):
         for rec in snapshot.requests:
-            if rec.req_id in engine.requests:
-                raise ValueError(
-                    f"request id {rec.req_id} already exists in the "
-                    "restoring engine"
-                )
+            if rebase_ids:
+                req_id = engine._next_id
+                engine._next_id += 1
+            else:
+                req_id = rec.req_id
+                if req_id in engine.requests:
+                    raise ValueError(
+                        f"request id {req_id} already exists in the "
+                        "restoring engine (restore with rebase_ids=True "
+                        "to mint fresh ids on adoption)"
+                    )
             total = len(rec.prompt) + rec.max_new_tokens
             if total > engine.max_seq_len:
                 raise ValueError(
@@ -305,7 +325,7 @@ def restore_engine(engine, snapshot: EngineSnapshot) -> List[int]:
                 deadline_s=rec.deadline_s,
             )
             req = Request(
-                req_id=rec.req_id,
+                req_id=req_id,
                 prompt=list(rec.prompt),
                 params=params,
                 tokens=list(rec.prompt) + list(rec.generated),
@@ -323,19 +343,23 @@ def restore_engine(engine, snapshot: EngineSnapshot) -> List[int]:
             # prefix-cache re-match on re-admission shrinks the charge).
             req.rework_until = rec.kv_committed
             req.rework_kind = "restore_reprefill"
-            engine.requests[rec.req_id] = req
-            engine._keys[rec.req_id] = jax.random.PRNGKey(params.seed)
+            engine.requests[req_id] = req
+            engine._keys[req_id] = jax.random.PRNGKey(params.seed)
             engine.scheduler.add(req)
             if tr.enabled:
                 tr.request_begin(
-                    rec.req_id,
+                    req_id,
                     prompt_len=len(rec.prompt),
                     max_new_tokens=rec.max_new_tokens,
                     restored=True,
                     recovered_tokens=len(rec.generated),
                 )
-            restored.append(rec.req_id)
-    engine._next_id = max(engine._next_id, snapshot.next_id)
+            restored.append(req_id)
+    if not rebase_ids:
+        # Preserving mode keeps the id space: the target must not mint an
+        # id that outranks a recovered request. Rebasing already advanced
+        # the counter past every minted id.
+        engine._next_id = max(engine._next_id, snapshot.next_id)
     engine.restores += 1
     engine.requests_recovered += len(restored)
     if tr.enabled:
@@ -457,15 +481,21 @@ def publish_snapshot(store, key: str, snapshot: EngineSnapshot) -> None:
 
 
 def adopt_snapshot(
-    engine, store, key: str, *, delete: bool = True
+    engine, store, key: str, *, delete: bool = True,
+    rebase_ids: bool = False,
 ) -> List[int]:
     """Fetch a published snapshot and restore it into ``engine``; deletes
     the key afterwards by default (adopt-once). Returns the restored ids,
-    or ``[]`` when no snapshot is published under ``key``."""
+    or ``[]`` when no snapshot is published under ``key``.
+    ``rebase_ids=True`` mints fresh ids on adoption (see
+    :func:`restore_engine`) — required when one survivor adopts snapshots
+    from several peers whose id spaces overlap."""
     text = store.get(key)
     if text is None:
         return []
-    ids = restore_engine(engine, EngineSnapshot.from_json(text))
+    ids = restore_engine(
+        engine, EngineSnapshot.from_json(text), rebase_ids=rebase_ids
+    )
     if delete:
         store.delete(key)
     return ids

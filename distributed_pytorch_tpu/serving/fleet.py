@@ -1,0 +1,1132 @@
+"""Routed replica fleet: prefix-affinity routing, health-checked
+token-identical failover, hedging with request-id dedup, and SLO-driven
+scale-out/scale-in over in-process :class:`~.engine.InferenceEngine`
+replicas.
+
+One engine replica is production-shaped — elastic, chaos-drilled,
+observable — but a fleet needs three things no single replica provides:
+something that *routes* to N of them, something that *notices* when one
+dies, and something that *moves its work* without changing a single output
+token. This module is that layer, built deliberately on machinery that
+already exists rather than new device code:
+
+* **Routing** is content-addressed: the router hashes the page-aligned
+  prefix of each prompt with the exact ``PrefixCache.key_chain`` digest
+  (``sha256(prev + "|" + tokens)`` per page, chained from ``"root"``), so
+  the affinity key of a prompt IS the trie address of its first page(s) on
+  any engine. Rendezvous hashing over the live replicas then sends
+  shared-prefix traffic to the replica whose trie already holds those
+  pages, with minimal reshuffling when the replica set changes. Prompts
+  too short for a full page — and affinity targets over the spill
+  threshold — fall back to the least-loaded replica, read live from each
+  engine's registry gauges (``queue_depth + running_requests``).
+
+* **Failover is token-identical by construction.** The router keeps a
+  shadow :class:`~.elastic.RequestSnapshot` per in-flight request —
+  prompt, sampling params (seed!), and the committed generated tokens
+  observed via ``poll()`` after each step; the fold index is implied by
+  their count. Because token *i* of a request is drawn with
+  ``fold_in(PRNGKey(seed), i)`` independent of batch composition, slot,
+  or engine identity, re-admitting ``prompt + generated`` on ANY
+  same-fingerprint replica through :func:`~.elastic.restore_engine`
+  regenerates the identical tail. A dead replica's uncommitted in-flight
+  dispatch is simply re-issued elsewhere at the same fold index. Request
+  ids are namespaced per replica at attach (``index * id_stride``), so
+  two replicas' requests can land on one survivor without colliding.
+
+* **Death is detected, not assumed**: ``/healthz``-style probes (over
+  HTTP via :func:`~distributed_pytorch_tpu.obs.server.scrape` when a
+  replica serves, else in-process ``engine.health()``) with a consecutive
+  -failure threshold, plus a per-step liveness deadline for replicas that
+  stop making progress while holding work. A probe answering 503
+  *draining* is an answer, not a death: the replica leaves the admission
+  rotation but stays in the route table, stepped and polled, until its
+  in-flight requests stream to completion — and a SIGTERM-style
+  :meth:`FleetRouter.drain_replica` hands its queue to a survivor via
+  :func:`~.elastic.publish_snapshot` / :func:`~.elastic.adopt_snapshot`
+  (or a direct restore) with zero token divergence.
+
+* **Retries, hedging, dedup.** Admission failures are retried across
+  replicas with bounded exponential backoff (``EngineDraining`` means
+  "elsewhere, now" and costs no backoff; ``QueueFull`` means "later" and
+  does). Optionally, a request with no first token after ``hedge_after_s``
+  is duplicated on a second replica — determinism makes the copies
+  token-identical, so whichever finishes first wins. The dedup rule: a
+  fleet request emits exactly once, keyed by fleet id; the first copy to
+  finish is recorded, every other copy is cancelled, and a twin that
+  finishes anyway is counted ``duplicates_suppressed`` and never emitted.
+
+* **The SRE loop closes at the fleet.** With an :class:`AutoscalePolicy`,
+  a firing SLO burn-rate alert on any live replica (``obs/slo.py``) spins
+  up a new replica from ``engine_factory``, and fleet-wide ``budget_idle``
+  waste (``obs/goodput.py``) above the threshold drains the least-loaded
+  replica down — both as observable route-table transitions, not
+  orchestration outside the process.
+
+Chaos integration: the router calls :func:`chaos.on_fleet_step` once per
+pump round; the armed plan's fleet faults (``kill_replica``,
+``partition_replica``, ``slow_replica``) come back as declarations and the
+router applies the damage — abandoning the engine object mid-flight for a
+kill (the in-process SIGKILL twin), refusing contact for a partition,
+sleeping before each step for a straggler. ``tests/test_serving_fleet.py``
+drills a seeded SIGKILL of one of three replicas mid-decode under Poisson
+load and asserts union token parity against a single-engine reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from distributed_pytorch_tpu import chaos
+from distributed_pytorch_tpu.metrics import ReservoirHistogram
+from distributed_pytorch_tpu.obs.registry import MetricsRegistry
+from distributed_pytorch_tpu.serving.admission import (
+    AdmissionError,
+    EngineDraining,
+    QueueFull,
+)
+from distributed_pytorch_tpu.serving.elastic import (
+    SNAPSHOT_VERSION,
+    EngineSnapshot,
+    RequestSnapshot,
+    adopt_snapshot,
+    drain_engine,
+    publish_snapshot,
+    restore_engine,
+)
+from distributed_pytorch_tpu.serving.engine import RequestStatus
+from distributed_pytorch_tpu.serving.scheduler import SamplingParams
+
+# Per-replica request-id namespace width. Replica k mints ids from
+# k * ID_STRIDE, so any mix of replicas' requests can be adopted by one
+# survivor without a req_id collision (restore_engine refuses duplicates).
+ID_STRIDE = 1_000_000
+
+_HEALTH_VALUE = {"live": 1.0, "draining": 0.5, "dead": 0.0, "removed": -1.0}
+
+
+class NoLiveReplica(AdmissionError):
+    """Every replica is dead, draining, or unreachable — the fleet-level
+    twin of :class:`~.admission.EngineDraining`: there is no "elsewhere"
+    left to retry."""
+
+
+def prefix_affinity_key(
+    prompt: Sequence[int], page_size: int, pages: int = 1
+) -> Optional[str]:
+    """The routing key: the content-addressed chain digest of the first
+    ``pages`` full pages of ``prompt``, computed with the EXACT
+    ``PrefixCache.key_chain`` recurrence — so the key a router derives
+    from raw tokens equals the trie address any engine assigns those
+    pages. Requests sharing a system prompt share their leading page(s)
+    and therefore the key; ``None`` when the prompt has no full page
+    (nothing page-aligned to share)."""
+    if pages < 1:
+        raise ValueError(f"pages must be >= 1, got {pages}")
+    n = min(int(pages), len(prompt) // page_size)
+    if n == 0:
+        return None
+    prev = "root"
+    for i in range(n):
+        chunk = prompt[i * page_size : (i + 1) * page_size]
+        prev = hashlib.sha256(
+            (prev + "|" + ",".join(str(int(t)) for t in chunk)).encode()
+        ).hexdigest()[:16]
+    return prev
+
+
+def _rendezvous(key: str, names: Sequence[str]) -> str:
+    """Highest-random-weight hashing: stable key->replica assignment that
+    moves only the dead replica's keys when the live set changes."""
+    return max(
+        names,
+        key=lambda name: hashlib.sha256(f"{key}|{name}".encode()).digest(),
+    )
+
+
+@dataclasses.dataclass
+class Replica:
+    """Route-table entry for one engine. ``state`` transitions:
+    ``live -> draining`` (healthz 503 / drain notice; out of admission
+    rotation, still stepped), ``-> dead`` (kill / probe threshold /
+    liveness deadline; engine abandoned, work failed over), ``-> removed``
+    (clean drain handoff; engine closed and leak-checked)."""
+
+    name: str
+    engine: object
+    index: int
+    state: str = "live"
+    url: Optional[str] = None
+    last_ok_s: float = 0.0
+    probe_failures: int = 0
+    dead_reason: Optional[str] = None
+    # Chaos damage the router applies to itself:
+    killed_at: Optional[float] = None
+    partitioned_until: Optional[float] = None
+    slow_delay_s: float = 0.0
+
+
+@dataclasses.dataclass
+class ShadowRequest:
+    """The router's recovery record for one fleet request: everything
+    needed to rebuild a :class:`~.elastic.RequestSnapshot` without ever
+    touching a dead engine. ``generated`` holds only COMMITTED tokens
+    (observed through ``poll()`` after a completed step) — the fold index
+    for the next token is implied by ``len(prompt) + len(generated)``, so
+    re-admission regenerates the identical stream."""
+
+    fid: int
+    prompt: Tuple[int, ...]
+    params: SamplingParams
+    metadata: Optional[dict]
+    submit_s: float
+    replica: str
+    req_id: int
+    generated: List[int] = dataclasses.field(default_factory=list)
+    hedge_replica: Optional[str] = None
+    hedge_req_id: Optional[int] = None
+    finished: bool = False
+    tokens: Optional[List[int]] = None
+    failovers: int = 0
+    first_token_s: Optional[float] = None
+    failover_pending_since: Optional[float] = None
+    len_at_failover: int = 0
+
+
+@dataclasses.dataclass
+class AutoscalePolicy:
+    """When to grow and shrink the fleet. Scale-out fires on any live
+    replica's SLO burn-rate alert (the multi-window monitor from
+    ``obs/slo.py`` — page-worthy burn, not a point-in-time threshold);
+    scale-in fires when the live replicas' mean ``budget_idle`` waste
+    fraction (``obs/goodput.py``) says the fleet is paying for capacity
+    the load no longer needs. ``cooldown_rounds`` debounces flapping."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    scale_in_idle_fraction: float = 0.6
+    cooldown_rounds: int = 0
+
+
+class FleetRouter:
+    """Routes, probes, fails over, and autoscales N in-process replicas.
+
+    The public surface mirrors one engine — ``submit() -> fleet id``,
+    ``step() -> finished fleet ids``, ``poll(fid)``, ``run()``,
+    ``close()`` — so callers (and the bench) swap a fleet in where an
+    engine was. All replicas must share the snapshot fingerprint
+    (page_size, max_seq_len, top_k/top_p, speculative, mesh): failover
+    restores refuse mismatched targets, so the router refuses them at
+    attach instead of at the worst possible moment.
+    """
+
+    def __init__(
+        self,
+        engines: Sequence = (),
+        *,
+        engine_factory: Optional[Callable[[], object]] = None,
+        affinity_pages: int = 1,
+        spill_queue_depth: Optional[int] = None,
+        probe_every: int = 4,
+        probe_timeout_s: float = 1.0,
+        probe_fail_threshold: int = 2,
+        liveness_deadline_s: Optional[float] = None,
+        max_retries: int = 2,
+        retry_backoff_s: float = 0.02,
+        hedge_after_s: Optional[float] = None,
+        autoscale: Optional[AutoscalePolicy] = None,
+        autoscale_every: int = 8,
+        id_stride: int = ID_STRIDE,
+        clock: Callable[[], float] = time.perf_counter,
+    ):
+        self.engine_factory = engine_factory
+        self.affinity_pages = int(affinity_pages)
+        self.spill_queue_depth = spill_queue_depth
+        self.probe_every = max(1, int(probe_every))
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.probe_fail_threshold = max(1, int(probe_fail_threshold))
+        self.liveness_deadline_s = liveness_deadline_s
+        self.max_retries = max(0, int(max_retries))
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.hedge_after_s = hedge_after_s
+        self.autoscale = autoscale
+        self.autoscale_every = max(1, int(autoscale_every))
+        self.id_stride = int(id_stride)
+        self._clock = clock
+
+        self._replicas: List[Replica] = []
+        self._by_name: Dict[str, Replica] = {}
+        self._fingerprint: Optional[dict] = None
+        self._attached = 0
+
+        self._shadows: Dict[int, ShadowRequest] = {}
+        self._by_owner: Dict[Tuple[str, int], int] = {}
+        self._next_fid = 0
+        self._round = 0
+        self._last_scale_round = -(10**9)
+
+        self.registry = MetricsRegistry(namespace="fleet")
+        self._c = {
+            name: self.registry.counter(name)
+            for name in (
+                "submitted_total",
+                "routed_affinity_total",
+                "routed_spill_total",
+                "routed_least_loaded_total",
+                "submit_retries_total",
+                "submit_rejected_total",
+                "hedges_total",
+                "hedge_wins_total",
+                "duplicates_suppressed_total",
+                "replicas_dead_total",
+                "requests_failed_over_total",
+                "hedge_promotions_total",
+                "drain_handoffs_total",
+                "probe_failures_total",
+                "scale_outs_total",
+                "scale_ins_total",
+            )
+        }
+        self.registry.gauge_fn(
+            "replicas_live",
+            lambda: sum(1 for r in self._replicas if r.state == "live"),
+        )
+        self.registry.gauge_fn(
+            "replicas_draining",
+            lambda: sum(1 for r in self._replicas if r.state == "draining"),
+        )
+        self.registry.gauge_fn(
+            "replicas_dead",
+            lambda: sum(1 for r in self._replicas if r.state == "dead"),
+        )
+        self._detect_gauge = self.registry.gauge(
+            "dead_replica_detection_seconds"
+        )
+        self._detect_hist = ReservoirHistogram(256, seed=7)
+        self.registry.reservoir(
+            "detection_seconds", lambda: self._detect_hist
+        )
+        self._failover_ttft = ReservoirHistogram(256, seed=8)
+        self.registry.reservoir(
+            "failover_ttft_seconds", lambda: self._failover_ttft
+        )
+
+        for engine in engines:
+            self.add_replica(engine)
+
+    # ------------------------------------------------------------ replicas
+
+    def add_replica(
+        self, engine, *, name: Optional[str] = None, serve: bool = False
+    ) -> Replica:
+        """Attach one engine: fingerprint-check it against the fleet,
+        namespace its request ids (``index * id_stride`` — the collision
+        guard for multi-snapshot adoption), register its health gauge,
+        and put it in the admission rotation. ``serve=True`` starts its
+        introspection server and probes ``/healthz`` over HTTP instead of
+        in-process."""
+        fp = {
+            "page_size": engine.page_size,
+            "max_seq_len": engine.max_seq_len,
+            "top_k": engine._top_k,
+            "top_p": engine._top_p,
+            "speculative": engine.speculative,
+            "mesh": engine.mesh_fingerprint,
+        }
+        if self._fingerprint is None:
+            self._fingerprint = fp
+        elif fp != self._fingerprint:
+            raise ValueError(
+                f"replica fingerprint {fp} != fleet {self._fingerprint} — "
+                "token-identical failover requires identical geometry and "
+                "sampling truncation on every replica"
+            )
+        index = self._attached
+        self._attached += 1
+        if name is None:
+            name = f"r{index}"
+        if name in self._by_name:
+            raise ValueError(f"replica name {name!r} already attached")
+        engine._next_id = max(engine._next_id, index * self.id_stride)
+        replica = Replica(
+            name=name,
+            engine=engine,
+            index=index,
+            url=engine.serve().url if serve else None,
+            last_ok_s=self._clock(),
+        )
+        self._replicas.append(replica)
+        self._by_name[name] = replica
+        self.registry.gauge_fn(
+            f"replica_{name}_health",
+            lambda r=replica: _HEALTH_VALUE[r.state],
+            help=f"1 live, 0.5 draining, 0 dead, -1 removed ({name})",
+        )
+        return replica
+
+    def replicas(self) -> List[Replica]:
+        return list(self._replicas)
+
+    @property
+    def page_size(self) -> int:
+        if self._fingerprint is None:
+            raise RuntimeError("no replica attached yet")
+        return self._fingerprint["page_size"]
+
+    def _unreachable(self, replica: Replica) -> bool:
+        if replica.killed_at is not None:
+            return True
+        until = replica.partitioned_until
+        return until is not None and self._clock() < until
+
+    def _eligible(self) -> List[Replica]:
+        return [
+            r
+            for r in self._replicas
+            if r.state == "live" and not self._unreachable(r)
+        ]
+
+    def _load(self, replica: Replica) -> float:
+        """Least-loaded signal, read from the replica's own registry
+        gauges (the same numbers a remote router would scrape)."""
+        reg = replica.engine.registry
+        return reg.read_gauge("queue_depth") + reg.read_gauge(
+            "running_requests"
+        )
+
+    def _queue_depth(self, replica: Replica) -> float:
+        return replica.engine.registry.read_gauge("queue_depth")
+
+    # ------------------------------------------------------------- routing
+
+    def _route_order(
+        self, key: Optional[str]
+    ) -> Tuple[List[Replica], str]:
+        """Candidate replicas, best first, plus how the head was chosen
+        (``affinity`` / ``spill`` / ``least_loaded``)."""
+        eligible = self._eligible()
+        by_load = sorted(eligible, key=lambda r: (self._load(r), r.index))
+        if key is None or not eligible:
+            return by_load, "least_loaded"
+        target = self._by_name[
+            _rendezvous(key, [r.name for r in eligible])
+        ]
+        if (
+            self.spill_queue_depth is not None
+            and self._queue_depth(target) >= self.spill_queue_depth
+        ):
+            # The affinity replica is backed up past the point where a
+            # cached prefix is worth waiting for: spill to load order.
+            return by_load, "spill"
+        rest = [r for r in by_load if r is not target]
+        return [target] + rest, "affinity"
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        params: Optional[SamplingParams] = None,
+        metadata: Optional[dict] = None,
+    ) -> int:
+        """Route one request; returns its FLEET id (stable across
+        failover and hedging — engine-level ids are an implementation
+        detail the shadow table tracks). Raises
+        :class:`~.admission.RequestTooLong` unretried (deterministic),
+        retries :class:`~.admission.QueueFull` with backoff and
+        :class:`~.admission.EngineDraining` immediately-elsewhere, up to
+        ``max_retries`` extra attempts; then re-raises the last error
+        (or :class:`NoLiveReplica`)."""
+        params = params or SamplingParams()
+        prompt = [int(t) for t in prompt]
+        key = prefix_affinity_key(
+            prompt, self.page_size, self.affinity_pages
+        )
+        order, routed_by = self._route_order(key)
+        if not order:
+            self._c["submit_rejected_total"].inc()
+            raise NoLiveReplica("no live replica to admit to")
+        last_exc: Optional[Exception] = None
+        attempts = 0
+        for pos, replica in enumerate(order):
+            if attempts > self.max_retries:
+                break
+            try:
+                req_id = replica.engine.submit(prompt, params, metadata)
+            except EngineDraining as exc:
+                # "Retry ELSEWHERE, now": the draining flag beat our last
+                # probe; update the table and go straight to the next.
+                if replica.state == "live":
+                    replica.state = "draining"
+                last_exc = exc
+                continue
+            except QueueFull as exc:
+                # "Retry later": bounded backoff, then the next-best.
+                last_exc = exc
+                attempts += 1
+                self._c["submit_retries_total"].inc()
+                if attempts <= self.max_retries:
+                    time.sleep(
+                        self.retry_backoff_s * (2 ** (attempts - 1))
+                    )
+                continue
+            fid = self._next_fid
+            self._next_fid += 1
+            shadow = ShadowRequest(
+                fid=fid,
+                prompt=tuple(prompt),
+                params=params,
+                metadata=metadata,
+                submit_s=self._clock(),
+                replica=replica.name,
+                req_id=req_id,
+            )
+            self._shadows[fid] = shadow
+            self._by_owner[(replica.name, req_id)] = fid
+            self._c["submitted_total"].inc()
+            if pos == 0 and routed_by == "affinity":
+                self._c["routed_affinity_total"].inc()
+            elif routed_by == "spill":
+                self._c["routed_spill_total"].inc()
+            else:
+                self._c["routed_least_loaded_total"].inc()
+            return fid
+        self._c["submit_rejected_total"].inc()
+        raise last_exc if last_exc is not None else NoLiveReplica(
+            "no live replica accepted the request"
+        )
+
+    # ------------------------------------------------------------- serving
+
+    def step(self) -> List[int]:
+        """One fleet pump round: apply due chaos faults, step every
+        reachable replica once (failing over the ones that die), refresh
+        shadows from committed tokens, probe health on schedule, hedge
+        stragglers, and autoscale. Returns fleet ids finished this
+        round."""
+        self._round += 1
+        for fault in chaos.on_fleet_step():
+            self._apply_fault(fault)
+        finished: List[int] = []
+        for replica in list(self._replicas):
+            if replica.state in ("dead", "removed"):
+                continue
+            now = self._clock()
+            if replica.killed_at is not None:
+                # First contact with a SIGKILLed process: the step "call"
+                # fails instantly, which IS the detection event.
+                self._mark_dead(
+                    replica, "kill_replica", died_at=replica.killed_at
+                )
+                continue
+            if replica.partitioned_until is not None:
+                if now >= replica.partitioned_until:
+                    # Healed within the detection window: a blip. The
+                    # replica kept its state; nothing diverged.
+                    replica.partitioned_until = None
+                    replica.probe_failures = 0
+                    replica.last_ok_s = now
+                else:
+                    continue  # unreachable: no step lands
+            if replica.slow_delay_s > 0:
+                time.sleep(replica.slow_delay_s)
+            try:
+                step_finished = replica.engine.step()
+            except chaos.InjectedFault:
+                self._mark_dead(replica, "injected_fault", died_at=now)
+                continue
+            replica.last_ok_s = self._clock()
+            replica.probe_failures = 0
+            for req_id in step_finished:
+                fid = self._finalize(replica, req_id)
+                if fid is not None:
+                    finished.append(fid)
+            self._update_shadows(replica)
+        if self._round % self.probe_every == 0:
+            self.probe_health()
+        self._maybe_hedge()
+        if (
+            self.autoscale is not None
+            and self._round % self.autoscale_every == 0
+        ):
+            self.maybe_autoscale()
+        return finished
+
+    def run(self, max_steps: int = 10_000) -> List[int]:
+        """Pump until every submitted request has finished (surviving any
+        chaos the armed plan throws). Returns finished fleet ids in
+        completion order."""
+        finished: List[int] = []
+        steps = 0
+        while any(not s.finished for s in self._shadows.values()):
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"fleet did not finish within {max_steps} rounds"
+                )
+            finished.extend(self.step())
+            steps += 1
+        return finished
+
+    def poll(self, fid: int) -> RequestStatus:
+        """Fleet-level request status. ``generated`` reflects committed
+        tokens (the shadow view — what failover would preserve);
+        ``preempt_count`` reports the request's failover count."""
+        shadow = self._shadows[fid]
+        if shadow.finished:
+            return RequestStatus(
+                req_id=fid,
+                state="finished",
+                prompt_len=len(shadow.prompt),
+                generated=list(shadow.tokens[len(shadow.prompt):]),
+                finished=True,
+                preempt_count=shadow.failovers,
+            )
+        state = "running"
+        replica = self._by_name.get(shadow.replica)
+        if replica is not None and replica.state not in ("dead", "removed"):
+            try:
+                state = replica.engine.poll(shadow.req_id).state
+            except KeyError:
+                state = "recovering"
+        return RequestStatus(
+            req_id=fid,
+            state=state,
+            prompt_len=len(shadow.prompt),
+            generated=list(shadow.generated),
+            finished=False,
+            preempt_count=shadow.failovers,
+        )
+
+    def _finalize(self, replica: Replica, req_id: int) -> Optional[int]:
+        """One engine-level completion. The dedup rule lives here: the
+        FIRST copy to finish records the result under the fleet id and
+        cancels its twin; a twin finishing anyway is suppressed."""
+        fid = self._by_owner.get((replica.name, req_id))
+        if fid is None:
+            return None
+        status = replica.engine.poll(req_id)
+        if status.state == "cancelled":
+            return None  # a cancelled twin retires through finished ids too
+        shadow = self._shadows[fid]
+        if shadow.finished:
+            self._c["duplicates_suppressed_total"].inc()
+            return None
+        shadow.finished = True
+        shadow.generated = list(status.generated)
+        shadow.tokens = list(shadow.prompt) + list(status.generated)
+        if shadow.first_token_s is None and status.generated:
+            shadow.first_token_s = self._clock()
+        won_by_hedge = (replica.name, req_id) == (
+            shadow.hedge_replica,
+            shadow.hedge_req_id,
+        )
+        twin: Optional[Tuple[str, int]] = None
+        if won_by_hedge:
+            twin = (shadow.replica, shadow.req_id)
+            self._c["hedge_wins_total"].inc()
+        elif shadow.hedge_replica is not None:
+            twin = (shadow.hedge_replica, shadow.hedge_req_id)
+        if twin is not None:
+            other = self._by_name.get(twin[0])
+            if other is not None and other.state not in ("dead", "removed"):
+                other.engine.cancel(twin[1])
+        return fid
+
+    def _update_shadows(self, replica: Replica) -> None:
+        """Refresh committed-token shadows from ``replica`` after a step.
+        This is the failover state: tokens recorded here survive the
+        replica; anything newer is re-generated identically."""
+        now = self._clock()
+        for shadow in self._shadows.values():
+            if shadow.finished:
+                continue
+            if shadow.replica == replica.name:
+                req_id = shadow.req_id
+            elif shadow.hedge_replica == replica.name:
+                req_id = shadow.hedge_req_id
+            else:
+                continue
+            try:
+                status = replica.engine.poll(req_id)
+            except KeyError:
+                continue
+            if len(status.generated) > len(shadow.generated):
+                shadow.generated = list(status.generated)
+                if shadow.first_token_s is None:
+                    shadow.first_token_s = now
+                if (
+                    shadow.failover_pending_since is not None
+                    and len(shadow.generated) > shadow.len_at_failover
+                ):
+                    self._failover_ttft.record(
+                        now - shadow.failover_pending_since
+                    )
+                    shadow.failover_pending_since = None
+
+    # ------------------------------------------------------ health / death
+
+    def probe_health(self) -> None:
+        """One probe sweep. Consecutive failures past the threshold — or
+        a liveness deadline expiring on a replica that holds work but
+        stopped completing steps — declare death and trigger failover.
+        A 503 *draining* verdict keeps the replica in the table (no
+        premature eviction): it leaves the admission rotation but its
+        in-flight requests keep streaming."""
+        for replica in list(self._replicas):
+            if replica.state in ("dead", "removed"):
+                continue
+            now = self._clock()
+            if (
+                self.liveness_deadline_s is not None
+                and self._has_work(replica)
+                and now - replica.last_ok_s > self.liveness_deadline_s
+            ):
+                self._mark_dead(
+                    replica, "liveness_deadline", died_at=replica.last_ok_s
+                )
+                continue
+            verdict: Optional[str] = None
+            if self._unreachable(replica):
+                pass  # probe cannot land; counts as a failure below
+            else:
+                try:
+                    if replica.url is not None:
+                        from distributed_pytorch_tpu.obs.server import scrape
+
+                        doc = scrape(
+                            replica.url,
+                            "/healthz",
+                            timeout=self.probe_timeout_s,
+                            retries=0,
+                        )
+                        verdict = doc.get("status")
+                    else:
+                        verdict = replica.engine.health()
+                except Exception:
+                    verdict = None
+            if verdict is None:
+                replica.probe_failures += 1
+                self._c["probe_failures_total"].inc()
+                if replica.probe_failures >= self.probe_fail_threshold:
+                    self._mark_dead(
+                        replica, "probe_failures", died_at=replica.last_ok_s
+                    )
+                continue
+            replica.probe_failures = 0
+            replica.last_ok_s = now
+            if verdict == "draining" and replica.state == "live":
+                replica.state = "draining"
+            elif verdict == "live" and replica.state == "draining":
+                replica.state = "live"  # drain was cancelled / reopened
+            elif verdict == "closed":
+                # A closed engine finishes nothing: recover its work.
+                if self._has_work(replica):
+                    self._mark_dead(replica, "closed", died_at=now)
+                else:
+                    replica.state = "removed"
+
+    def _has_work(self, replica: Replica) -> bool:
+        return any(
+            not s.finished
+            and replica.name in (s.replica, s.hedge_replica)
+            for s in self._shadows.values()
+        )
+
+    def _mark_dead(
+        self, replica: Replica, reason: str, *, died_at: float
+    ) -> None:
+        """Declare ``replica`` dead, record detection latency (death to
+        declaration), and fail its work over. The engine object is
+        abandoned exactly as a SIGKILLed process abandons its memory —
+        nothing is read from it again."""
+        if replica.state in ("dead", "removed"):
+            return
+        now = self._clock()
+        replica.state = "dead"
+        replica.dead_reason = reason
+        detection = max(0.0, now - died_at)
+        self._detect_gauge.set(detection)
+        self._detect_hist.record(detection)
+        self._c["replicas_dead_total"].inc()
+        print(
+            f"[fleet] replica {replica.name} dead ({reason}); "
+            f"detection {detection * 1e3:.1f}ms",
+            flush=True,
+        )
+        self._failover_from(replica)
+
+    def _failover_from(self, dead: Replica) -> None:
+        """Token-identical failover: promote hedge twins where one exists
+        (an identical stream already running elsewhere), re-admit the
+        rest through ``restore_engine``'s re-prefill path from the shadow
+        snapshots — grouped by the same affinity routing as fresh
+        traffic, so shared prefixes regroup on the survivor that caches
+        them."""
+        moved: List[ShadowRequest] = []
+        for shadow in self._shadows.values():
+            if shadow.finished:
+                continue
+            if shadow.hedge_replica == dead.name:
+                self._by_owner.pop((dead.name, shadow.hedge_req_id), None)
+                shadow.hedge_replica = None
+                shadow.hedge_req_id = None
+                continue
+            if shadow.replica != dead.name:
+                continue
+            self._by_owner.pop((dead.name, shadow.req_id), None)
+            if shadow.hedge_replica is not None:
+                hedge = self._by_name.get(shadow.hedge_replica)
+                if hedge is not None and hedge.state in (
+                    "live",
+                    "draining",
+                ):
+                    shadow.replica = shadow.hedge_replica
+                    shadow.req_id = shadow.hedge_req_id
+                    shadow.hedge_replica = None
+                    shadow.hedge_req_id = None
+                    self._c["hedge_promotions_total"].inc()
+                    continue
+                shadow.hedge_replica = None
+                shadow.hedge_req_id = None
+            moved.append(shadow)
+        if not moved:
+            return
+        now = self._clock()
+        groups: Dict[str, List[ShadowRequest]] = {}
+        for shadow in moved:
+            key = prefix_affinity_key(
+                shadow.prompt, self.page_size, self.affinity_pages
+            )
+            order, _ = self._route_order(key)
+            if not order:
+                raise NoLiveReplica(
+                    f"replica {dead.name} died holding {len(moved)} "
+                    "requests and no live replica remains to adopt them"
+                )
+            groups.setdefault(order[0].name, []).append(shadow)
+        for name, shadows in groups.items():
+            target = self._by_name[name]
+            restore_engine(target.engine, self._snapshot_for(shadows, now))
+            for shadow in shadows:
+                shadow.replica = name
+                self._by_owner[(name, shadow.req_id)] = shadow.fid
+                shadow.failovers += 1
+                shadow.failover_pending_since = now
+                shadow.len_at_failover = len(shadow.generated)
+            self._c["requests_failed_over_total"].inc(len(shadows))
+
+    def _snapshot_for(
+        self, shadows: Sequence[ShadowRequest], now: float
+    ) -> EngineSnapshot:
+        """Build an :class:`~.elastic.EngineSnapshot` purely from router
+        shadows — the dead engine contributes nothing. ``next_id=0`` so
+        adoption never moves the survivor's id counter (per-replica
+        namespacing already guarantees uniqueness)."""
+        fp = self._fingerprint
+        recs = []
+        for shadow in sorted(shadows, key=lambda s: s.req_id):
+            p = shadow.params
+            recs.append(
+                RequestSnapshot(
+                    req_id=shadow.req_id,
+                    prompt=shadow.prompt,
+                    generated=tuple(shadow.generated),
+                    max_new_tokens=p.max_new_tokens,
+                    temperature=p.temperature,
+                    seed=p.seed,
+                    stop_token=p.stop_token,
+                    deadline_s=p.deadline_s,
+                    metadata=shadow.metadata,
+                    preempt_count=0,
+                    age_s=max(0.0, now - shadow.submit_s),
+                    ttft_s=(
+                        shadow.first_token_s - shadow.submit_s
+                        if shadow.first_token_s is not None
+                        else None
+                    ),
+                    # Upper bound on KV lost with the replica: everything
+                    # committed must re-prefill (goodput charges it to
+                    # restore_reprefill; a prefix-cache hit shrinks it).
+                    kv_committed=len(shadow.prompt) + len(shadow.generated),
+                    trie_keys=(),
+                )
+            )
+        return EngineSnapshot(
+            version=SNAPSHOT_VERSION,
+            page_size=fp["page_size"],
+            max_seq_len=fp["max_seq_len"],
+            top_k=fp["top_k"],
+            top_p=fp["top_p"],
+            speculative=fp["speculative"],
+            next_id=0,
+            requests=tuple(recs),
+            mesh=fp["mesh"],
+        )
+
+    # ------------------------------------------------------------- hedging
+
+    def _maybe_hedge(self) -> None:
+        """Tail-latency hedging: a request with no first token after
+        ``hedge_after_s`` gets an identical twin (same seed — determinism
+        makes the copies interchangeable) on the least-loaded OTHER live
+        replica. First to finish wins; see :meth:`_finalize` for dedup."""
+        if self.hedge_after_s is None:
+            return
+        now = self._clock()
+        for shadow in self._shadows.values():
+            if (
+                shadow.finished
+                or shadow.hedge_replica is not None
+                or shadow.first_token_s is not None
+                or now - shadow.submit_s < self.hedge_after_s
+            ):
+                continue
+            others = [
+                r for r in self._eligible() if r.name != shadow.replica
+            ]
+            if not others:
+                continue
+            target = min(others, key=lambda r: (self._load(r), r.index))
+            try:
+                req_id = target.engine.submit(
+                    list(shadow.prompt), shadow.params, shadow.metadata
+                )
+            except AdmissionError:
+                continue
+            shadow.hedge_replica = target.name
+            shadow.hedge_req_id = req_id
+            self._by_owner[(target.name, req_id)] = shadow.fid
+            self._c["hedges_total"].inc()
+
+    # ------------------------------------------------- drain / autoscaling
+
+    def drain_replica(
+        self, name: str, *, store=None, key: Optional[str] = None
+    ) -> int:
+        """The SIGTERM-with-notice handoff, fleet half: drain ``name``
+        (front door closed, in-flight step lands, snapshot taken), move
+        its queue to the least-loaded live survivor — through the elastic
+        KV store via :func:`publish_snapshot`/:func:`adopt_snapshot` when
+        ``store`` is given, else a direct restore — then close and retire
+        the engine (leak-checked). Zero token divergence: the snapshot
+        path is the same re-prefill machinery as failover, minus the lost
+        in-flight step (a clean drain finishes it first). Returns the
+        number of requests handed off."""
+        replica = self._by_name[name]
+        if replica.state in ("dead", "removed"):
+            raise ValueError(f"replica {name} is {replica.state}")
+        replica.state = "draining"
+        # Hedge twins hosted here are redundant copies, not primary work:
+        # cancel them rather than migrating a duplicate.
+        for shadow in self._shadows.values():
+            if not shadow.finished and shadow.hedge_replica == name:
+                replica.engine.cancel(shadow.hedge_req_id)
+                self._by_owner.pop((name, shadow.hedge_req_id), None)
+                shadow.hedge_replica = None
+                shadow.hedge_req_id = None
+        snap = drain_engine(replica.engine, reason="fleet_drain")
+        # finish_inflight may have completed requests whose final readback
+        # was in flight: deliver them before re-homing the remainder.
+        for shadow in list(self._shadows.values()):
+            if shadow.finished or shadow.replica != name:
+                continue
+            if replica.engine.poll(shadow.req_id).finished:
+                self._finalize(replica, shadow.req_id)
+        if snap.requests:
+            survivors = [
+                r for r in self._eligible() if r.name != name
+            ]
+            if not survivors:
+                raise NoLiveReplica(
+                    f"cannot drain {name}: {len(snap.requests)} requests "
+                    "and no live survivor to adopt them"
+                )
+            target = min(survivors, key=lambda r: (self._load(r), r.index))
+            if store is not None:
+                handoff_key = key or f"fleet/handoff/{name}"
+                publish_snapshot(store, handoff_key, snap)
+                adopt_snapshot(target.engine, store, handoff_key)
+            else:
+                restore_engine(target.engine, snap)
+            for shadow in self._shadows.values():
+                if shadow.finished or shadow.replica != name:
+                    continue
+                self._by_owner.pop((name, shadow.req_id), None)
+                shadow.replica = target.name
+                self._by_owner[(target.name, shadow.req_id)] = shadow.fid
+        replica.engine.close()
+        replica.state = "removed"
+        self._c["drain_handoffs_total"].inc()
+        return len(snap.requests)
+
+    def maybe_autoscale(self) -> Optional[Tuple[str, str]]:
+        """One autoscaler evaluation (also called from :meth:`step` every
+        ``autoscale_every`` rounds). Returns ``("out", name)`` /
+        ``("in", name)`` when it acted, else None."""
+        policy = self.autoscale
+        if policy is None:
+            return None
+        if self._round - self._last_scale_round < policy.cooldown_rounds:
+            return None
+        live = [r for r in self._replicas if r.state == "live"]
+        # Scale OUT: any live replica's SLO burn-rate alert is firing.
+        firing = []
+        for replica in live:
+            slo = getattr(replica.engine, "slo", None)
+            if slo is None:
+                continue
+            firing.extend(
+                name
+                for name, st in slo.state().items()
+                if st["firing"]
+            )
+        if (
+            firing
+            and len(live) < policy.max_replicas
+            and self.engine_factory is not None
+        ):
+            replica = self.add_replica(self.engine_factory())
+            self._c["scale_outs_total"].inc()
+            self._last_scale_round = self._round
+            print(
+                f"[fleet] scale-out -> {replica.name} "
+                f"(slo firing: {sorted(set(firing))})",
+                flush=True,
+            )
+            return ("out", replica.name)
+        # Scale IN: the fleet is paying for idle budget.
+        if len(live) > policy.min_replicas:
+            idle_fractions = []
+            for replica in live:
+                goodput = getattr(replica.engine, "goodput", None)
+                if goodput is None:
+                    continue
+                total = goodput.productive_s + goodput.wasted_total_s()
+                if total > 0:
+                    idle_fractions.append(
+                        goodput.wasted["budget_idle"] / total
+                    )
+            if idle_fractions and (
+                sum(idle_fractions) / len(idle_fractions)
+                >= policy.scale_in_idle_fraction
+            ):
+                victim = min(
+                    live, key=lambda r: (self._load(r), -r.index)
+                )
+                self.drain_replica(victim.name)
+                self._c["scale_ins_total"].inc()
+                self._last_scale_round = self._round
+                print(
+                    f"[fleet] scale-in <- {victim.name} (mean budget-idle "
+                    f"{sum(idle_fractions) / len(idle_fractions):.0%})",
+                    flush=True,
+                )
+                return ("in", victim.name)
+        return None
+
+    # --------------------------------------------------------------- chaos
+
+    def _apply_fault(self, fault) -> None:
+        """Apply one declared fleet fault (see ``chaos._FLEET_KINDS``).
+        ``fault.replica`` indexes attach order; a fault naming a replica
+        that is already dead/removed (or never attached) is a no-op —
+        the drill's kill landed on an empty chamber."""
+        if fault.replica is None or fault.replica >= len(self._replicas):
+            return
+        replica = self._replicas[fault.replica]
+        if replica.state in ("dead", "removed"):
+            return
+        now = self._clock()
+        if fault.kind == "kill_replica":
+            replica.killed_at = now
+        elif fault.kind == "partition_replica":
+            replica.partitioned_until = (
+                now + fault.duration if fault.duration > 0 else float("inf")
+            )
+        elif fault.kind == "slow_replica":
+            replica.slow_delay_s = max(0.0, float(fault.duration))
+
+    # --------------------------------------------------------------- admin
+
+    def fleet_snapshot(self, include_dead: bool = False) -> dict:
+        """Exact cross-replica metrics union: the router's own registry
+        merged with every attached replica's — same payload shape as
+        ``MetricsRegistry.merge_remote`` over served replicas."""
+        snaps = [self.registry.snapshot(include_state=True)]
+        for replica in self._replicas:
+            if replica.state == "removed":
+                continue
+            if replica.state == "dead" and not include_dead:
+                continue
+            snaps.append(
+                replica.engine.registry.snapshot(include_state=True)
+            )
+        return MetricsRegistry.merge(snaps)
+
+    def describe(self) -> dict:
+        """The fleet ``/statusz`` block: route table + shadow census."""
+        shadows = list(self._shadows.values())
+        return {
+            "round": self._round,
+            "replicas": [
+                {
+                    "name": r.name,
+                    "state": r.state,
+                    "index": r.index,
+                    "url": r.url,
+                    "dead_reason": r.dead_reason,
+                    "load": (
+                        self._load(r)
+                        if r.state in ("live", "draining")
+                        else None
+                    ),
+                    "owned": sum(
+                        1
+                        for s in shadows
+                        if not s.finished
+                        and r.name in (s.replica, s.hedge_replica)
+                    ),
+                }
+                for r in self._replicas
+            ],
+            "requests": {
+                "total": len(shadows),
+                "finished": sum(1 for s in shadows if s.finished),
+                "failed_over": sum(1 for s in shadows if s.failovers),
+                "hedged": sum(
+                    1
+                    for s in shadows
+                    if s.hedge_replica is not None
+                ),
+            },
+        }
+
+    def close(self) -> None:
+        """Close every live/draining replica (leak-checked, like a single
+        engine). Dead replicas' engines are NOT closed — a SIGKILLed
+        process never runs its destructors; survivors are the ones whose
+        quiescence the drill asserts — but their introspection servers
+        (router-side threads) are stopped."""
+        for replica in self._replicas:
+            if replica.state in ("live", "draining"):
+                replica.engine.close()
+                replica.state = "removed"
+            elif replica.state == "dead":
+                server = getattr(replica.engine, "_server", None)
+                if server is not None:
+                    try:
+                        server.stop()
+                    except Exception:
+                        pass
+
+
+__all__ = [
+    "AutoscalePolicy",
+    "FleetRouter",
+    "ID_STRIDE",
+    "NoLiveReplica",
+    "Replica",
+    "ShadowRequest",
+    "prefix_affinity_key",
+]

@@ -126,6 +126,45 @@ class TestFaultPlan:
         assert ei.value.kind == "drain_mid_prefill" and ei.value.step == 4
         plan.on_serving_phase("mid_prefill")  # fire-once
 
+    def test_fleet_kind_validation(self):
+        # Fleet kinds target a replica by router attach-order index and
+        # are applied by the router, not by signal/raise delivery.
+        f = Fault(kind="kill_replica", replica=1)
+        assert f.mode == "router"
+        with pytest.raises(ValueError, match="replica"):
+            Fault(kind="kill_replica")
+        with pytest.raises(ValueError, match="replica"):
+            Fault(kind="kill", replica=0)
+
+    def test_on_fleet_step_lower_bound_and_fire_once(self):
+        plan = FaultPlan(
+            [
+                Fault(kind="kill_replica", replica=2, at_step=3),
+                Fault(kind="slow_replica", replica=0, duration=0.5,
+                      at_step=1),
+            ]
+        )
+        due = plan.on_fleet_step()  # round 1: only the slow fault is due
+        assert [(f.kind, f.replica) for f in due] == [("slow_replica", 0)]
+        assert plan.on_fleet_step() == []  # round 2: nothing left due yet
+        due = plan.on_fleet_step()  # round 3 >= at_step: kill fires
+        assert [(f.kind, f.replica) for f in due] == [("kill_replica", 2)]
+        assert plan.on_fleet_step() == []  # fire-once
+
+    def test_on_fleet_step_unarmed_is_noop(self):
+        assert chaos.on_fleet_step() == []
+
+    def test_fleet_fault_notifies_observers(self):
+        plan = FaultPlan([Fault(kind="partition_replica", replica=1)])
+        seen = []
+        observer = lambda kind, step, mode: seen.append((kind, step, mode))
+        chaos.add_fault_observer(observer)
+        try:
+            plan.on_fleet_step()
+        finally:
+            chaos.remove_fault_observer(observer)
+        assert seen == [("partition_replica", 1, "router")]
+
     def test_reclaim_waits_for_queue_pressure(self):
         plan = FaultPlan(
             [

@@ -9,7 +9,9 @@ ledger, armed sentinel) keeps greedy tokens bitwise-identical.
 """
 
 import json
+import socket
 import threading
+import time
 import urllib.request
 
 import jax
@@ -567,3 +569,85 @@ class TestBenchHistory:
         cur_doc["rows"][0]["speculative"] = True  # different config key
         cur = extract_row(cur_doc)
         assert compare_rows(prev, cur) == []
+
+
+class TestScrapeHardening:
+    """scrape() must never wedge its caller: a peer that accepts the TCP
+    connection and then never answers — the classic half-dead replica —
+    has to raise within the configured timeout budget, and transient
+    transport blips get exactly the bounded retry, nothing more."""
+
+    @staticmethod
+    def _black_hole():
+        """A socket that accepts (kernel backlog) and never responds."""
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(5)
+        return srv
+
+    def test_accept_but_never_respond_raises_bounded(self):
+        srv = self._black_hole()
+        url = f"http://127.0.0.1:{srv.getsockname()[1]}"
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(OSError):
+                scrape(url, "/snapshot", timeout=0.2, retries=1,
+                       backoff_s=0.05)
+            elapsed = time.monotonic() - t0
+            # (retries+1) * timeout + backoff, with generous slack — the
+            # point is "seconds, not forever".
+            assert elapsed < 3.0
+        finally:
+            srv.close()
+
+    def test_merge_remote_dead_peer_raises_bounded(self):
+        srv = self._black_hole()
+        url = f"http://127.0.0.1:{srv.getsockname()[1]}"
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(OSError):
+                MetricsRegistry.merge_remote(
+                    [url], timeout=0.2, retries=1, backoff_s=0.05
+                )
+            assert time.monotonic() - t0 < 3.0
+        finally:
+            srv.close()
+
+    def test_retry_recovers_after_transport_blip(self):
+        """First connection reset before any response; the bounded retry
+        lands on a healthy answer."""
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(5)
+        url = f"http://127.0.0.1:{srv.getsockname()[1]}"
+
+        def serve():
+            conn, _ = srv.accept()
+            conn.close()  # blip: reset with no HTTP response
+            conn, _ = srv.accept()
+            conn.recv(65536)
+            body = b'{"ok": true}'
+            conn.sendall(
+                b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                b"\r\n" + body
+            )
+            conn.close()
+
+        thread = threading.Thread(target=serve, daemon=True)
+        thread.start()
+        try:
+            doc = scrape(url, "/snapshot", timeout=2.0, retries=1,
+                         backoff_s=0.01)
+            assert doc == {"ok": True}
+        finally:
+            thread.join(timeout=5)
+            srv.close()
+
+    def test_http_error_is_answered_not_retried(self, served_engine):
+        """A served error page comes from a live server: no retry, and
+        /healthz 503 still returns its JSON verdict."""
+        _, server = served_engine
+        with pytest.raises(urllib.error.HTTPError):
+            scrape(server.url, "/nope", retries=3)

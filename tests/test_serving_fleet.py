@@ -1,0 +1,674 @@
+"""Routed-fleet tests: affinity routing, health-checked failover, hedging
+dedup, drain handoff, and the fleet-level chaos drill.
+
+The headline drill mirrors the single-engine elastic story one level up:
+three replicas under seeded Poisson load, a ``kill_replica`` chaos fault
+SIGKILLs (in-process: abandons) the replica that affinity routing loaded
+mid-decode, and EVERY request — in flight on the dead replica, queued, or
+elsewhere — must finish with greedy tokens identical to an uninterrupted
+single-engine reference, with zero referenced pages left on any survivor.
+Determinism does the heavy lifting: token i of a request is drawn from
+``fold_in(key(seed), i)`` regardless of engine, slot, or batch, so the
+router's shadow snapshots re-admitted through ``restore_engine`` regenerate
+byte-identical tails.
+
+All on CPU (conftest pins JAX_PLATFORMS=cpu).
+"""
+
+import json
+import os
+import random
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_pytorch_tpu import chaos
+from distributed_pytorch_tpu.models.transformer import TransformerLM
+from distributed_pytorch_tpu.serving import (
+    FleetRouter,
+    InferenceEngine,
+    QueueFull,
+    SamplingParams,
+    drain_engine,
+    prefix_affinity_key,
+    restore_engine,
+)
+from distributed_pytorch_tpu.serving.fleet import (
+    ID_STRIDE,
+    AutoscalePolicy,
+    _rendezvous,
+)
+from distributed_pytorch_tpu.serving.kv_cache import (
+    PagedBlockAllocator,
+    PrefixCache,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _fresh_chaos_plan():
+    chaos._reset()
+    yield
+    os.environ.pop(chaos.ENV_VAR, None)
+    chaos._reset()
+
+
+def tiny_lm():
+    return TransformerLM(
+        vocab_size=48, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+        dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def target_and_params():
+    model = tiny_lm()
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+ENGINE_KW = dict(
+    max_slots=2, max_seq_len=32, page_size=4, token_budget=16,
+    max_prefill_chunk=8, debug=True,
+)
+MAX_NEW = 6
+PAGE = ENGINE_KW["page_size"]
+
+# One full page shared by the affinity group (page-aligned => routable).
+PREFIX = [5, 7, 11, 2]
+AFFINITY_PROMPTS = [PREFIX + [t, t + 1] for t in (1, 9, 17, 25, 33)]
+OTHER_PROMPTS = [[2, 2, 3, 17, 40], [6, 1, 9], [40, 41], [3, 3, 3, 3, 8]]
+DRILL_PROMPTS = AFFINITY_PROMPTS + OTHER_PROMPTS
+
+
+def make_engine(model, params, **kw):
+    opts = dict(ENGINE_KW)
+    opts.update(kw)
+    return InferenceEngine(model, params, **opts)
+
+
+def make_fleet(model, params, n=3, *, engine_kw=None, **router_kw):
+    engines = [
+        make_engine(model, params, **(engine_kw or {})) for _ in range(n)
+    ]
+    return FleetRouter(engines, **router_kw)
+
+
+def params_for(i):
+    return SamplingParams(max_new_tokens=MAX_NEW)
+
+
+@pytest.fixture(scope="module")
+def ref_outputs(target_and_params):
+    """Uninterrupted single-engine greedy reference, keyed by prompt
+    index. Output streams are batch/slot/engine-invariant, so this one
+    reference serves every fleet scenario."""
+    model, params = target_and_params
+    eng = make_engine(model, params)
+    ids = [
+        eng.submit(p, params_for(i)) for i, p in enumerate(DRILL_PROMPTS)
+    ]
+    eng.run()
+    out = {i: eng.poll(rid).generated for i, rid in enumerate(ids)}
+    eng.close()
+    return out
+
+
+def assert_parity(router, fids_by_prompt_idx, ref_outputs):
+    for idx, fid in fids_by_prompt_idx.items():
+        st = router.poll(fid)
+        assert st.finished, f"prompt {idx} (fid {fid}) never finished"
+        assert st.generated == ref_outputs[idx], (
+            f"prompt {idx}: fleet produced {st.generated}, "
+            f"reference {ref_outputs[idx]}"
+        )
+
+
+def arm(plan):
+    os.environ[chaos.ENV_VAR] = json.dumps(plan)
+    chaos._reset()
+
+
+# ----------------------------------------------------------------- routing
+
+
+def test_affinity_key_matches_trie_chain():
+    """The router's key and the trie's content address are THE SAME hash:
+    what the router computes from raw tokens is what any engine's
+    PrefixCache will call the cached page — that identity is the whole
+    basis of affinity routing."""
+    alloc = PagedBlockAllocator(8)
+    cache = PrefixCache(alloc, PAGE)
+    tokens = PREFIX + [1, 2, 3, 4, 9]
+    # Register the first two full pages in the trie, then compare chains.
+    p1, p2 = alloc.allocate(2)
+    node, _ = cache.register_full(cache.ROOT, tuple(tokens[:PAGE]), p1)
+    cache.register_full(node, tuple(tokens[PAGE : 2 * PAGE]), p2)
+    alloc.unref(p1)
+    alloc.unref(p2)
+    chain = cache.key_chain(tokens)
+    assert len(chain) == 2
+    assert prefix_affinity_key(tokens, PAGE, pages=1) == chain[0]
+    assert prefix_affinity_key(tokens, PAGE, pages=2) == chain[1]
+    # Sub-page prompts have nothing page-aligned to share.
+    assert prefix_affinity_key(PREFIX[:3], PAGE) is None
+
+
+def test_affinity_routing_colocates_shared_prefixes(target_and_params):
+    model, params = target_and_params
+    router = make_fleet(model, params, n=3)
+    try:
+        fids = [router.submit(p, params_for(0)) for p in AFFINITY_PROMPTS]
+        owners = {router._shadows[f].replica for f in fids}
+        assert len(owners) == 1, (
+            f"shared-prefix requests split across {owners}"
+        )
+        # And the owner is the rendezvous choice, not an accident of load.
+        key = prefix_affinity_key(AFFINITY_PROMPTS[0], PAGE)
+        assert owners == {_rendezvous(key, ["r0", "r1", "r2"])}
+        assert router.registry.read_counter("routed_affinity_total") == len(
+            fids
+        )
+        router.run()
+    finally:
+        router.close()
+
+
+def test_least_loaded_fallback_spreads_short_prompts(target_and_params):
+    model, params = target_and_params
+    router = make_fleet(model, params, n=3)
+    try:
+        # Sub-page prompts carry no affinity key: each goes to the least
+        # loaded replica, so six submits spread 2/2/2.
+        fids = [
+            router.submit([7 + i, 3], params_for(0)) for i in range(6)
+        ]
+        owners = [router._shadows[f].replica for f in fids]
+        assert sorted(owners) == ["r0", "r0", "r1", "r1", "r2", "r2"]
+        assert (
+            router.registry.read_counter("routed_least_loaded_total") == 6
+        )
+        router.run()
+    finally:
+        router.close()
+
+
+def test_replica_ids_are_namespaced(target_and_params):
+    """Per-replica id namespacing is the collision guard that lets one
+    survivor adopt several peers' requests: r0 mints from 0, r1 from
+    ID_STRIDE."""
+    model, params = target_and_params
+    router = make_fleet(model, params, n=2)
+    try:
+        f0 = router.submit([9, 1], params_for(0))
+        f1 = router.submit([9, 2], params_for(0))
+        ids = sorted(
+            router._shadows[f].req_id for f in (f0, f1)
+        )
+        assert ids[0] < ID_STRIDE <= ids[1]
+        router.run()
+    finally:
+        router.close()
+
+
+# --------------------------------------------------------- the chaos drill
+
+
+def test_fleet_kill_drill_token_parity(target_and_params, ref_outputs):
+    """The acceptance drill: SIGKILL (in-process) one of three replicas
+    mid-decode under seeded Poisson load; every request completes on the
+    survivors with greedy tokens identical to the single-engine reference
+    and zero referenced pages remain anywhere."""
+    model, params = target_and_params
+    # Kill the replica the affinity group routes to, so the fault lands on
+    # a replica that is provably decoding when it dies.
+    key = prefix_affinity_key(AFFINITY_PROMPTS[0], PAGE)
+    victim = _rendezvous(key, ["r0", "r1", "r2"])
+    victim_idx = int(victim[1:])
+    arm({
+        "seed": 1234,
+        "faults": [
+            {"kind": "kill_replica", "replica": victim_idx, "at_step": 3}
+        ],
+    })
+    router = make_fleet(model, params, n=3, probe_every=2)
+    rng = random.Random(1234)
+    # Seeded Poisson-ish arrivals: every prompt gets a submit round drawn
+    # from a geometric gap process; the affinity group goes first so the
+    # victim holds their decode when round 3 kills it.
+    schedule = {}
+    rnd = 0
+    for idx in range(len(DRILL_PROMPTS)):
+        schedule.setdefault(rnd, []).append(idx)
+        while rng.random() < 0.5:
+            rnd += 1
+    fids = {}
+    try:
+        rounds = 0
+        while True:
+            for idx in schedule.pop(rounds, []):
+                fids[idx] = router.submit(
+                    DRILL_PROMPTS[idx], params_for(idx)
+                )
+            done = not schedule and all(
+                s.finished for s in router._shadows.values()
+            )
+            if done and len(fids) == len(DRILL_PROMPTS):
+                break
+            router.step()
+            rounds += 1
+            assert rounds < 500, "drill did not converge"
+
+        dead = [r for r in router.replicas() if r.state == "dead"]
+        assert [r.name for r in dead] == [victim]
+        assert dead[0].dead_reason == "kill_replica"
+        assert (
+            router.registry.read_counter("requests_failed_over_total") >= 1
+        )
+        # Detection latency was recorded (kill -> declaration, same pump
+        # loop here, so small but present).
+        assert (
+            router.registry.read_gauge("dead_replica_detection_seconds")
+            >= 0.0
+        )
+        assert router._detect_hist.count == 1
+        assert_parity(router, fids, ref_outputs)
+        # Zero leaked pages on every survivor.
+        for rep in router.replicas():
+            if rep.state == "dead":
+                continue
+            assert (
+                rep.engine.registry.read_gauge("pages_referenced") == 0
+            ), f"{rep.name} leaked referenced pages"
+    finally:
+        router.close()  # closes survivors; close() leak-checks them
+
+
+def test_partition_death_and_blip(target_and_params, ref_outputs):
+    """A partitioned replica that stays unreachable past the probe
+    threshold is declared dead and its work fails over; one that heals
+    within the window is a blip — nothing moves, nothing diverges."""
+    model, params = target_and_params
+    # Death: permanent partition, threshold 2, probing every round.
+    router = make_fleet(
+        model, params, n=2, probe_every=1, probe_fail_threshold=2
+    )
+    fids = {}
+    try:
+        for idx, p in enumerate(DRILL_PROMPTS[:4]):
+            fids[idx] = router.submit(p, params_for(idx))
+        router.step()
+        victim = router._shadows[fids[0]].replica
+        router._apply_fault(
+            chaos.Fault(
+                kind="partition_replica",
+                replica=int(victim[1:]),
+                duration=0.0,  # 0 = until the run ends
+            )
+        )
+        router.run()
+        assert router._by_name[victim].state == "dead"
+        assert router._by_name[victim].dead_reason == "probe_failures"
+        assert_parity(router, fids, ref_outputs)
+    finally:
+        router.close()
+
+    # Blip: partition shorter than the detection window heals in place.
+    router = make_fleet(
+        model, params, n=2, probe_every=1, probe_fail_threshold=50
+    )
+    fids = {}
+    try:
+        for idx, p in enumerate(DRILL_PROMPTS[:4]):
+            fids[idx] = router.submit(p, params_for(idx))
+        router.step()
+        router._apply_fault(
+            chaos.Fault(
+                kind="partition_replica", replica=0, duration=0.05
+            )
+        )
+        router.run()
+        assert all(r.state == "live" for r in router.replicas())
+        assert (
+            router.registry.read_counter("requests_failed_over_total") == 0
+        )
+        assert_parity(router, fids, ref_outputs)
+    finally:
+        router.close()
+
+
+# ----------------------------------------------------- draining (satellite)
+
+
+def test_draining_replica_streams_to_completion(
+    target_and_params, ref_outputs
+):
+    """A replica answering *draining* (the /healthz-503 verdict) leaves
+    the admission rotation but is NOT evicted: its in-flight requests
+    keep streaming to completion on it while new traffic lands
+    elsewhere."""
+    model, params = target_and_params
+    router = make_fleet(model, params, n=2, probe_every=1)
+    fids = {}
+    try:
+        for idx in range(4):
+            fids[idx] = router.submit(
+                DRILL_PROMPTS[idx], params_for(idx)
+            )
+        router.step()
+        drainer = router._shadows[fids[0]].replica
+        other = "r1" if drainer == "r0" else "r0"
+        in_flight_on_drainer = [
+            f
+            for f in fids.values()
+            if router._shadows[f].replica == drainer
+        ]
+        assert in_flight_on_drainer
+        # The external notice: admission closes, health() says draining.
+        router._by_name[drainer].engine.stop_admission()
+        router.step()  # probe sweep picks the verdict up
+        assert router._by_name[drainer].state == "draining"
+        # New traffic routes around it — including affinity traffic whose
+        # rendezvous choice it may have been.
+        for idx in range(4, 8):
+            fids[idx] = router.submit(
+                DRILL_PROMPTS[idx], params_for(idx)
+            )
+            assert router._shadows[fids[idx]].replica == other
+        router.run()
+        # Never evicted, never died: the drainer finished its own work.
+        assert router._by_name[drainer].state == "draining"
+        for f in in_flight_on_drainer:
+            assert router._shadows[f].replica == drainer
+        assert (
+            router.registry.read_counter("requests_failed_over_total") == 0
+        )
+        assert_parity(router, fids, ref_outputs)
+    finally:
+        router.close()
+
+
+def test_submit_discovers_draining_before_probe(target_and_params):
+    """EngineDraining from submit is 'retry elsewhere, now': even with
+    probes effectively off, the router reroutes on the spot and flips the
+    route-table state."""
+    model, params = target_and_params
+    router = make_fleet(model, params, n=2, probe_every=10_000)
+    try:
+        router._by_name["r0"].engine.stop_admission()
+        fid = router.submit([9, 4], params_for(0))
+        fid2 = router.submit(AFFINITY_PROMPTS[0], params_for(0))
+        assert router._shadows[fid].replica == "r1"
+        assert router._shadows[fid2].replica == "r1"
+        assert router._by_name["r0"].state == "draining"
+        router.run()
+    finally:
+        router.close()
+
+
+class _DictStore:
+    """Minimal in-process stand-in for KVStoreClient's get/set/delete."""
+
+    def __init__(self):
+        self.data = {}
+
+    def set(self, key, value):
+        self.data[key] = value
+
+    def get(self, key):
+        return self.data.get(key)
+
+    def delete(self, key):
+        self.data.pop(key, None)
+
+
+def test_drain_replica_handoff_via_store(target_and_params, ref_outputs):
+    """Router-initiated SIGTERM handoff: drain one replica, publish its
+    snapshot through the elastic store, adopt on the survivor — zero
+    token divergence and the drained engine closes leak-checked."""
+    model, params = target_and_params
+    router = make_fleet(model, params, n=2)
+    store = _DictStore()
+    fids = {}
+    try:
+        for idx in range(6):
+            fids[idx] = router.submit(
+                DRILL_PROMPTS[idx], params_for(idx)
+            )
+        router.step()
+        victim = router._shadows[fids[0]].replica
+        moved = router.drain_replica(victim, store=store)
+        assert moved >= 1
+        assert router._by_name[victim].state == "removed"
+        assert not store.data, "handoff key should be adopt-once deleted"
+        router.run()
+        assert_parity(router, fids, ref_outputs)
+        assert (
+            router.registry.read_counter("drain_handoffs_total") == 1
+        )
+    finally:
+        router.close()
+
+
+# ----------------------------------------------------------------- hedging
+
+
+def test_hedging_dedup_single_emission(target_and_params, ref_outputs):
+    """With an aggressive hedge deadline every request gets a twin on the
+    other replica; determinism makes the copies identical, the first to
+    finish wins, and the dedup rule guarantees exactly one emission per
+    fleet id."""
+    model, params = target_and_params
+    router = make_fleet(model, params, n=2, hedge_after_s=0.0)
+    fids = {}
+    emitted = []
+    try:
+        for idx in range(4):
+            fids[idx] = router.submit(
+                DRILL_PROMPTS[idx], params_for(idx)
+            )
+        rounds = 0
+        while not all(s.finished for s in router._shadows.values()):
+            emitted.extend(router.step())
+            rounds += 1
+            assert rounds < 200
+        assert router.registry.read_counter("hedges_total") >= 1
+        # Exactly one emission per fleet id, ever.
+        assert sorted(emitted) == sorted(fids.values())
+        assert_parity(router, fids, ref_outputs)
+    finally:
+        router.close()
+
+
+def test_slow_replica_fault_triggers_hedge(target_and_params, ref_outputs):
+    """The chaos straggler: slow_replica injects per-step delay on one
+    replica, the hedge fires against the wall-clock deadline, and the
+    fast twin wins without double emission."""
+    model, params = target_and_params
+    arm({
+        "seed": 5,
+        "faults": [
+            {"kind": "slow_replica", "replica": 0, "duration": 0.02,
+             "at_step": 1}
+        ],
+    })
+    router = make_fleet(model, params, n=2, hedge_after_s=0.01)
+    fids = {}
+    emitted = []
+    try:
+        # Pin the first request to r0 (both empty, tie broken by index).
+        fids[0] = router.submit(DRILL_PROMPTS[0], params_for(0))
+        rounds = 0
+        while not all(s.finished for s in router._shadows.values()):
+            emitted.extend(router.step())
+            rounds += 1
+            assert rounds < 200
+        assert router._by_name["r0"].slow_delay_s == 0.02
+        assert router.registry.read_counter("hedges_total") >= 1
+        assert sorted(emitted) == sorted(fids.values())
+        assert_parity(router, fids, ref_outputs)
+    finally:
+        router.close()
+
+
+# --------------------------------------------------------------- admission
+
+
+def test_queue_full_retries_across_replicas(target_and_params):
+    """QueueFull means 'retry later': bounded backoff, then the next-best
+    replica. Affinity traffic overflowing its home replica spills; a
+    fleet-wide full queue surfaces the error to the caller."""
+    model, params = target_and_params
+    router = make_fleet(
+        model, params, n=2, engine_kw=dict(max_queue=1),
+        retry_backoff_s=0.001,
+    )
+    try:
+        a = router.submit(AFFINITY_PROMPTS[0], params_for(0))
+        b = router.submit(AFFINITY_PROMPTS[1], params_for(1))
+        owners = {
+            router._shadows[f].replica for f in (a, b)
+        }
+        assert len(owners) == 2, "overflow should spill to the peer"
+        assert (
+            router.registry.read_counter("submit_retries_total") >= 1
+        )
+        with pytest.raises(QueueFull):
+            router.submit(AFFINITY_PROMPTS[2], params_for(2))
+        assert (
+            router.registry.read_counter("submit_rejected_total") == 1
+        )
+        router.run()
+    finally:
+        router.close()
+
+
+# ------------------------------------------------------------- autoscaling
+
+
+class _FiringSLO:
+    def state(self):
+        return {"ttft_p95": {"firing": True}}
+
+
+class _IdleGoodput:
+    productive_s = 1.0
+    wasted = {"budget_idle": 9.0}
+
+    def wasted_total_s(self):
+        return 9.0
+
+    def note_drain(self):
+        pass
+
+
+def test_autoscale_out_on_slo_and_in_on_idle(target_and_params):
+    """The closed SRE loop: a firing burn-rate alert grows the fleet from
+    the factory; fleet-wide budget-idle waste shrinks it through a clean
+    drain."""
+    model, params = target_and_params
+    policy = AutoscalePolicy(min_replicas=1, max_replicas=3)
+    router = make_fleet(
+        model, params, n=2, autoscale=policy,
+        engine_factory=lambda: make_engine(model, params),
+    )
+    try:
+        router._by_name["r0"].engine.slo = _FiringSLO()
+        action = router.maybe_autoscale()
+        assert action == ("out", "r2")
+        assert len(router._eligible()) == 3
+        assert router.registry.read_counter("scale_outs_total") == 1
+        # New replica minted into its own id namespace.
+        assert router._by_name["r2"].engine._next_id == 2 * ID_STRIDE
+
+        router._by_name["r0"].engine.slo = None
+        for rep in router.replicas():
+            rep.engine.goodput = _IdleGoodput()
+        action = router.maybe_autoscale()
+        assert action is not None and action[0] == "in"
+        assert router.registry.read_counter("scale_ins_total") == 1
+        assert len(router._eligible()) == 2
+    finally:
+        router.close()
+
+
+# ----------------------------------------------------------- observability
+
+
+def test_fleet_snapshot_merges_router_and_replicas(target_and_params):
+    model, params = target_and_params
+    router = make_fleet(model, params, n=2)
+    try:
+        fid = router.submit([4, 4, 4], params_for(0))
+        router.run()
+        assert router.poll(fid).finished
+        snap = router.fleet_snapshot()
+        assert snap["counters"]["fleet_submitted_total"] == 1
+        # Replica registries merged in: serving-side metrics present and
+        # summed across both replicas.
+        assert any(
+            name.startswith("serving_") for name in snap["counters"]
+        )
+        assert snap["gauges"]["fleet_replicas_live"] == 2
+        assert router.registry.read_gauge("replica_r0_health") == 1.0
+        # Health gauge tracks the route table.
+        router._apply_fault(
+            chaos.Fault(kind="kill_replica", replica=1)
+        )
+        router.step()
+        assert router.registry.read_gauge("replica_r1_health") == 0.0
+        assert router.describe()["replicas"][1]["state"] == "dead"
+    finally:
+        router.close()
+
+
+def test_fingerprint_mismatch_refused(target_and_params):
+    model, params = target_and_params
+    e1 = make_engine(model, params)
+    e2 = make_engine(model, params, page_size=8)
+    try:
+        with pytest.raises(ValueError, match="fingerprint"):
+            FleetRouter([e1, e2])
+    finally:
+        e1.close()
+        e2.close()
+
+
+# ----------------------------------------------- id collision (satellite 2)
+
+
+def test_overlapping_snapshot_ids_need_rebase(
+    target_and_params, ref_outputs
+):
+    """Failover re-admission of two replicas' snapshots into one survivor
+    must not collide request ids: without namespacing the duplicate id is
+    refused loudly, and ``rebase_ids=True`` mints fresh ids with no token
+    divergence."""
+    model, params = target_and_params
+    a = make_engine(model, params)
+    b = make_engine(model, params)
+    for idx in range(2):
+        a.submit(DRILL_PROMPTS[idx], params_for(idx))
+    for idx in range(2, 4):
+        b.submit(DRILL_PROMPTS[idx], params_for(idx))
+    snap_a, snap_b = drain_engine(a), drain_engine(b)
+    # Both engines minted ids from 0: the id spaces overlap exactly.
+    assert {r.req_id for r in snap_a.requests} == {
+        r.req_id for r in snap_b.requests
+    }
+    survivor = make_engine(model, params, max_queue=16)
+    ids_a = restore_engine(survivor, snap_a)
+    with pytest.raises(ValueError, match="rebase_ids"):
+        restore_engine(survivor, snap_b)
+    ids_b = restore_engine(survivor, snap_b, rebase_ids=True)
+    assert not set(ids_a) & set(ids_b)
+    survivor.run()
+    for idx, rid in enumerate(ids_a + ids_b):
+        assert survivor.poll(rid).generated == ref_outputs[idx]
+    survivor.close()
+    a.close()
+    b.close()

@@ -100,6 +100,25 @@ def extract_row(bench: dict) -> dict:
             )
             if key in obs
         }
+    fleet = bench.get("fleet")
+    if fleet:
+        # Un-gated (a seeded kill mid-run makes aggregate tok/s too noisy
+        # for the +/-10% gate), but recorded: the failover-cost trajectory
+        # is the point of running the fleet drill in CI at all.
+        out["fleet"] = {
+            key: fleet.get(key)
+            for key in (
+                "n_replicas",
+                "aggregate_tokens_per_sec",
+                "requests_failed_over",
+                "detection_latency_s",
+                "failover_ttft_s_p50",
+                "failover_ttft_spike_x",
+                "greedy_tokens_match_single_engine",
+                "pages_leaked_on_survivors",
+            )
+            if key in fleet
+        }
     return out
 
 

@@ -1,0 +1,191 @@
+#!/usr/bin/env bash
+# Seeded 3-replica routed-fleet chaos drill on CPU: one FleetRouter over
+# three in-process inference engines, a kill_replica fault landing on the
+# replica that prefix-affinity routing loaded (the rendezvous target of the
+# shared prompt prefix — so the kill provably hits live decodes), and
+# token-identical failover onto the survivors.
+#
+#   bash tools/fleet_smoke.sh
+#
+# What it proves:
+#   * the shared-prefix requests all rendezvous-route to ONE replica (the
+#     victim) and the short prompts spread by least-loaded fallback;
+#   * at router round 4 the victim is abandoned mid-decode (in-process
+#     SIGKILL analogy: its engine object is never stepped or closed again);
+#   * the router detects the death on next contact, rebuilds shadow
+#     RequestSnapshots from committed tokens only, and re-admits them on a
+#     survivor — every request completes with greedy output token-identical
+#     to ONE uninterrupted single-engine reference run (union parity:
+#     pre-kill finishes + failed-over finishes together equal the
+#     reference);
+#   * each replica runs with the flight recorder on: the chaos fault dumps
+#     a postmortem ring the instant it fires (validated here by replaying
+#     the victim's dump into a Chrome trace-event document, then preserved
+#     as traces/fleet_chaos_postmortem.json for the CI artifact upload);
+#   * zero leaked pages: survivors' pages_referenced gauges read 0 after
+#     the last finish, and router.close() runs assert_quiescent on every
+#     surviving allocator (the dead replica is exempt — its pages died with
+#     it, exactly like a real SIGKILL).
+#
+# The <90s pytest version of this drill is
+# tests/test_serving_fleet.py::test_fleet_kill_drill_token_parity; this
+# script adds the flight-recorder postmortem path and the artifact upload.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+REPO="$PWD"
+
+WORK="$(mktemp -d /tmp/fleet_smoke.XXXXXX)"
+trap 'rm -rf "$WORK"' EXIT
+echo "[fleet_smoke] workdir: $WORK"
+
+cat > "$WORK/drill.py" <<'EOF'
+"""Fleet chaos drill driver: reference run, then routed run with a seeded
+replica kill (see fleet_smoke.sh for the full scenario)."""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+
+from distributed_pytorch_tpu import chaos
+from distributed_pytorch_tpu.models.transformer import TransformerLM
+from distributed_pytorch_tpu.obs import FlightRecorder
+from distributed_pytorch_tpu.serving import (
+    FleetRouter,
+    InferenceEngine,
+    SamplingParams,
+    prefix_affinity_key,
+)
+from distributed_pytorch_tpu.serving.fleet import _rendezvous
+
+PAGE = 4
+PREFIX = [5, 7, 11, 2]  # one full page -> a routable affinity key
+PROMPTS = (
+    [PREFIX + [t, t + 1] for t in (1, 9, 17, 25)]  # affinity -> victim
+    + [[3, 3, 7], [6, 1, 9, 9, 2], [2, 40, 17], [8, 8, 8, 1]]
+)
+MAX_NEW = 8
+
+model = TransformerLM(vocab_size=48, d_model=16, n_layers=2, n_heads=2,
+                      d_ff=32, dtype=jnp.float32)
+params = model.init(
+    jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+)["params"]
+
+
+def mk(flight=None):
+    # 2 slots per replica under 8 requests: real queue pressure, so the
+    # kill lands while the victim still has waiting AND decoding work.
+    return InferenceEngine(
+        model, params, max_slots=2, max_seq_len=32, page_size=PAGE,
+        token_budget=16, max_prefill_chunk=8, debug=True, flight=flight,
+    )
+
+
+# Uninterrupted single-engine reference: the token-parity oracle.
+ref = mk()
+ref_ids = [ref.submit(p, SamplingParams(max_new_tokens=MAX_NEW))
+           for p in PROMPTS]
+ref.run()
+REF = [ref.poll(i).generated for i in ref_ids]
+ref.close()
+
+# The kill must land on the replica the shared prefix routes to.
+names = ["r0", "r1", "r2"]
+victim = _rendezvous(prefix_affinity_key(PROMPTS[0], PAGE), names)
+vidx = int(victim[1:])
+os.environ[chaos.ENV_VAR] = json.dumps({
+    "seed": 7,
+    "faults": [{"kind": "kill_replica", "replica": vidx, "at_step": 4}],
+})
+chaos._reset()
+
+router = FleetRouter([
+    mk(flight=FlightRecorder(capacity=2048, path=f"postmortem_r{i}.json"))
+    for i in range(3)
+])
+queue = list(enumerate(PROMPTS))
+fids = {}
+rounds = 0
+while queue or any(not s.finished for s in router._shadows.values()):
+    for _ in range(2):  # 2 admissions per router round: open-loop load
+        if queue:
+            i, p = queue.pop(0)
+            fids[i] = router.submit(p, SamplingParams(max_new_tokens=MAX_NEW))
+    router.step()
+    rounds += 1
+    assert rounds < 500, "fleet never drained"
+
+vrep = next(r for r in router.replicas() if r.name == victim)
+assert vrep.state == "dead", f"victim {victim} state={vrep.state}"
+assert vrep.dead_reason == "kill_replica", vrep.dead_reason
+failed_over = int(router.registry.read_counter("requests_failed_over_total"))
+assert failed_over >= 1, "kill landed on an idle replica (no failover)"
+detection_s = router.registry.read_gauge("dead_replica_detection_seconds")
+
+outs = [router.poll(fids[i]).generated for i in range(len(PROMPTS))]
+for i, (got, want) in enumerate(zip(outs, REF)):
+    assert got == want, f"request {i} diverged after failover: {got} != {want}"
+
+# Zero leaked pages on the survivors (dead replica exempt — SIGKILL).
+for rep in router.replicas():
+    if rep.state != "dead":
+        held = rep.engine.registry.read_gauge("pages_referenced")
+        assert held == 0, f"{rep.name} leaked {held} page(s)"
+router.close()  # runs assert_quiescent on every surviving allocator
+
+print(json.dumps({
+    "victim": victim,
+    "victim_postmortem": f"postmortem_r{vidx}.json",
+    "requests_failed_over": failed_over,
+    "detection_ms": round(detection_s * 1e3, 3),
+    "rounds": rounds,
+    "routed_affinity": int(
+        router.registry.read_counter("routed_affinity_total")
+    ),
+}))
+print("FLEET-DRILL-OK")
+EOF
+
+cd "$WORK"
+rc=0
+env PYTHONPATH="$REPO" JAX_PLATFORMS=cpu python drill.py > drill.log 2>&1 || rc=$?
+echo "--- drill.log"
+cat drill.log
+
+fail() { echo "[fleet_smoke] FAIL: $1"; exit 1; }
+[ "$rc" -eq 0 ] || fail "drill exited with $rc"
+grep -q "FLEET-DRILL-OK" drill.log || fail "drill never reached the final assertion"
+grep -q "fleet fault kill_replica" drill.log || fail "kill_replica never fired"
+grep -q "dead (kill_replica)" drill.log || fail "router never marked the victim dead"
+
+POSTMORTEM="$(grep -oE 'postmortem_r[0-9]+\.json' drill.log | head -1)"
+[ -n "$POSTMORTEM" ] && [ -e "$POSTMORTEM" ] || fail "no victim postmortem dump"
+
+# The victim's postmortem must replay into a valid Chrome trace-event doc.
+env PYTHONPATH="$REPO" POSTMORTEM="$POSTMORTEM" python - <<'EOF'
+import json
+import os
+
+from distributed_pytorch_tpu.obs import replay_to_tracer
+
+dump = json.load(open(os.environ["POSTMORTEM"]))
+assert dump["reason"] == "chaos:kill_replica", dump["reason"]
+assert dump["events"], "postmortem ring buffer is empty"
+kinds = {e["kind"] for e in dump["events"]}
+assert "chaos_fault" in kinds, f"no chaos_fault event in dump: {kinds}"
+assert "step" in kinds, f"no engine step records in dump: {kinds}"
+doc = json.loads(json.dumps(replay_to_tracer(dump).to_perfetto()))
+events = doc["traceEvents"]
+assert isinstance(events, list) and events, "replay produced no trace events"
+print(f"[fleet_smoke] postmortem: {len(dump['events'])} events "
+      f"(reason={dump['reason']}) -> {len(events)} trace events, replay OK")
+EOF
+
+# Preserve the victim's postmortem for the CI artifact upload (WORK is
+# wiped on exit).
+mkdir -p "$REPO/traces"
+cp "$POSTMORTEM" "$REPO/traces/fleet_chaos_postmortem.json"
+
+echo "[fleet_smoke] PASS"

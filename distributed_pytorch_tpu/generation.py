@@ -98,13 +98,38 @@ def make_sampler(temperature: float, top_k: int, top_p: float):
     over the temperature-scaled, top-k/top-p-truncated logits. This is THE
     next-token rule — ``generate``'s loop body and the serving engine's
     continuous-batching decode step both call it, so offline and served
-    sampling can never drift apart."""
+    sampling can never drift apart. ``bias`` is an optional additive
+    ``[B, V]`` logit offset (per-request logit-bias / grammar masks);
+    a zeros bias is a bitwise no-op on the sampled tokens."""
 
-    def sample(logits, step_rng):
+    def sample(logits, step_rng, bias=None):
+        if bias is not None:
+            logits = logits + bias
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         scaled = truncate_logits(logits / temperature, top_k, top_p)
         return jax.random.categorical(step_rng, scaled).astype(jnp.int32)
+
+    return sample
+
+
+def make_row_sampler(top_k: int, top_p: float):
+    """Return ``sample(logits [B, V], temps [B], keys [B, 2], bias
+    [B, V]) -> tokens [B]`` — the PER-ROW variant of :func:`make_sampler`
+    for the serving engine's one compiled decode program, where each
+    batch row carries its own temperature (``<= 0`` = greedy), fold-in
+    RNG key, and additive logit bias (zeros = bitwise no-op; per-request
+    logit-bias and grammar-mask rows land here as data, never as a
+    recompile). Same math, same order of operations as the static rule,
+    so mods-off serving stays token-identical to offline decode."""
+
+    def sample(logits, temps, keys, bias):
+        logits = logits + bias
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        safe_t = jnp.where(temps > 0, temps, 1.0)
+        scaled = truncate_logits(logits / safe_t[:, None], top_k, top_p)
+        sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+        return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
 
     return sample
 

@@ -43,6 +43,21 @@ from distributed_pytorch_tpu.serving.fleet import (
     NoLiveReplica,
     prefix_affinity_key,
 )
+from distributed_pytorch_tpu.serving.frontdoor import (
+    FrontDoor,
+    TenantConfig,
+    TenantQuotaExceeded,
+    TokenStream,
+)
+from distributed_pytorch_tpu.serving.grammar import (
+    TokenDFA,
+    compile_grammar,
+)
+from distributed_pytorch_tpu.serving.mods import (
+    AdapterStore,
+    Mods,
+    ModState,
+)
 from distributed_pytorch_tpu.serving.kv_cache import (
     BlockTable,
     OutOfPages,
@@ -64,6 +79,7 @@ from distributed_pytorch_tpu.serving.scheduler import (
 )
 
 __all__ = [
+    "AdapterStore",
     "AdmissionController",
     "AdmissionError",
     "AutoscalePolicy",
@@ -72,7 +88,10 @@ __all__ = [
     "EngineDraining",
     "EngineSnapshot",
     "FleetRouter",
+    "FrontDoor",
     "InferenceEngine",
+    "ModState",
+    "Mods",
     "NoLiveReplica",
     "OutOfPages",
     "PENDING_TOKEN",
@@ -88,7 +107,12 @@ __all__ = [
     "Scheduler",
     "ServingMetrics",
     "StepPlan",
+    "TenantConfig",
+    "TenantQuotaExceeded",
+    "TokenDFA",
+    "TokenStream",
     "adopt_snapshot",
+    "compile_grammar",
     "drain_engine",
     "make_serving_mesh",
     "mesh_fingerprint",

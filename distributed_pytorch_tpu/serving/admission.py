@@ -71,6 +71,10 @@ class AdmissionController:
         self.rejected_too_long = 0
         self.rejected_draining = 0
         self.cached_tokens_admitted = 0
+        # Typed tenancy (promoted out of the opaque ``metadata`` dict):
+        # per-tenant admission counts, the billing-grade view of who is
+        # actually getting through the gate.
+        self.accepted_by_tenant: Dict[str, int] = {}
         self.draining = False
 
     def close(self) -> None:
@@ -89,12 +93,15 @@ class AdmissionController:
         *,
         cached_tokens: int = 0,
         queued_uncached_tokens: int = 0,
+        tenant_id: str = "anon",
     ) -> None:
         """Raise an :class:`AdmissionError` subclass iff the request must be
         rejected; otherwise count it accepted. ``cached_tokens`` is the
         prefix-cache match for this prompt at submit time;
         ``queued_uncached_tokens`` the uncached prefill work already
-        waiting — both feed the optional queue-token budget."""
+        waiting — both feed the optional queue-token budget.
+        ``tenant_id`` keys the per-tenant accepted counter (fair-share
+        policy itself lives a layer up, in the front door)."""
         if self.draining:
             self.rejected_draining += 1
             raise EngineDraining(
@@ -131,6 +138,9 @@ class AdmissionController:
                 )
         self.accepted += 1
         self.cached_tokens_admitted += cached_tokens
+        self.accepted_by_tenant[tenant_id] = (
+            self.accepted_by_tenant.get(tenant_id, 0) + 1
+        )
 
     def status(self) -> Dict[str, object]:
         """The ``/statusz`` admission block: every rejection counter plus

@@ -49,6 +49,7 @@ from typing import Dict, List, Optional, Tuple
 import jax
 
 from distributed_pytorch_tpu import chaos
+from distributed_pytorch_tpu.serving.mods import Mods, ModState
 from distributed_pytorch_tpu.serving.scheduler import (
     Request,
     SamplingParams,
@@ -86,6 +87,18 @@ class RequestSnapshot:
     ttft_s: Optional[float]
     kv_committed: int
     trie_keys: Tuple[str, ...]
+    # Defaulted-last for wire compatibility (snapshots written before the
+    # front door existed decode as anonymous, nothing-delivered, modless).
+    # ``tenant_id`` preserves tenancy across drain/restore and failover;
+    # ``delivered`` is the streaming high-water mark (tokens the client
+    # already consumed) so a resumed stream neither replays nor skips;
+    # ``stop_sequences``/``mods`` rebuild SamplingParams and the live
+    # ModState (grammar DFAs re-walk ``generated`` — pure, so the state
+    # lands exactly where it was).
+    tenant_id: str = "anon"
+    delivered: int = 0
+    stop_sequences: Tuple[Tuple[int, ...], ...] = ()
+    mods: Optional[dict] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -134,6 +147,10 @@ class EngineSnapshot:
             entry["prompt"] = tuple(entry["prompt"])
             entry["generated"] = tuple(entry["generated"])
             entry["trie_keys"] = tuple(entry["trie_keys"])
+            entry["stop_sequences"] = tuple(
+                tuple(int(t) for t in seq)
+                for seq in entry.get("stop_sequences", ())
+            )
             reqs.append(RequestSnapshot(**entry))
         doc["requests"] = tuple(reqs)
         return cls(**doc)
@@ -211,6 +228,18 @@ def snapshot_engine(engine) -> EngineSnapshot:
                 ),
                 kv_committed=kv_committed,
                 trie_keys=trie_keys,
+                tenant_id=req.tenant_id,
+                # Delivery can never outrun commitment: the stream hands
+                # out ``generated`` entries, and those are committed.
+                delivered=min(req.delivered, len(generated)),
+                stop_sequences=tuple(
+                    tuple(int(t) for t in seq)
+                    for seq in req.params.stop_sequences
+                ),
+                mods=(
+                    req.mods.mods.to_spec() if req.mods is not None
+                    else None
+                ),
             )
         )
     return EngineSnapshot(
@@ -323,7 +352,19 @@ def restore_engine(
                 seed=rec.seed,
                 stop_token=rec.stop_token,
                 deadline_s=rec.deadline_s,
+                stop_sequences=tuple(
+                    tuple(int(t) for t in seq)
+                    for seq in rec.stop_sequences
+                ),
             )
+            mod_state = None
+            if rec.mods:
+                mod_state = ModState(
+                    Mods.from_spec(rec.mods), engine.vocab_size
+                )
+                # The DFA is pure: re-walking the committed tokens lands
+                # the grammar state exactly where the dead engine left it.
+                mod_state.replay(rec.generated)
             req = Request(
                 req_id=req_id,
                 prompt=list(rec.prompt),
@@ -335,6 +376,9 @@ def restore_engine(
                 metadata=(
                     dict(rec.metadata) if rec.metadata is not None else None
                 ),
+                tenant_id=rec.tenant_id,
+                delivered=rec.delivered,
+                mods=mod_state,
             )
             if rec.ttft_s is not None:
                 req.first_token_time = req.submit_time + rec.ttft_s

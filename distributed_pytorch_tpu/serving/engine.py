@@ -83,6 +83,7 @@ from distributed_pytorch_tpu import chaos
 from distributed_pytorch_tpu.generation import (
     decode_chunk_step,
     decode_token_step,
+    make_row_sampler,
     truncate_logits,
 )
 from distributed_pytorch_tpu.obs import MetricsRegistry, Tracer
@@ -108,6 +109,7 @@ from distributed_pytorch_tpu.serving.kv_cache import (
     PagePoolGroup,
     PrefixCache,
 )
+from distributed_pytorch_tpu.serving.mods import AdapterStore, Mods, ModState
 from distributed_pytorch_tpu.serving.mesh import (
     axis_sizes,
     kv_pool_shardings,
@@ -206,6 +208,7 @@ class InferenceEngine:
         slo: Optional[Sequence[SLObjective]] = None,
         goodput=None,
         xla_ledger=None,
+        max_live_adapters: int = 4,
     ):
         if max_seq_len % page_size:
             raise ValueError(
@@ -341,6 +344,13 @@ class InferenceEngine:
             max_queue_tokens=max_queue_tokens,
         )
         self.metrics = ServingMetrics(speculative=self.speculative)
+        self.vocab_size = int(getattr(model, "vocab_size", 0))
+        # Per-request LoRA adapters: merged-weight trees are full model
+        # copies, so they get the KV-page treatment — an LRU device cache
+        # capped at ``max_live_adapters``. Unsharded engines only (a
+        # merged tree would need re-placement under the param shardings);
+        # submit() refuses adapter mods on meshed/speculative engines.
+        self.adapters = AdapterStore(self.params, max_live=max_live_adapters)
         # Elastic lifecycle counters (serving/elastic.py increments the
         # first three; close() flips _closed). Surfaced via the registry so
         # a drill can cross-check them against ground truth.
@@ -419,6 +429,24 @@ class InferenceEngine:
         self._stage_keys = np.zeros((max_slots, 2), np.uint32)
         self._stage_use_prev = np.zeros((max_slots,), np.int32)
         self._zero_prev = jnp.zeros((max_slots,), jnp.int32)
+        # Fixed-shape additive-logit operand for per-request mods. The
+        # all-zeros device constant serves every dispatch with no modded
+        # rows (no extra host->device bytes on the mods-off path); the
+        # host buffer is filled per group only when some row carries a
+        # bias/grammar row.
+        self._stage_bias = np.zeros(
+            (max_slots, self.vocab_size), np.float32
+        )
+        self._zero_bias = jnp.zeros(
+            (max_slots, self.vocab_size), jnp.float32
+        )
+        if mesh is not None:
+            self._zero_prev = jax.device_put(
+                self._zero_prev, self._replicated
+            )
+            self._zero_bias = jax.device_put(
+                self._zero_bias, self._replicated
+            )
         # (sampled-token device array, decode slots, their requests) of the
         # not-yet-resolved dispatch, or None.
         self._inflight: Optional[
@@ -475,6 +503,18 @@ class InferenceEngine:
         )
         reg.counter_fn(
             "requests_cancelled_total", lambda: self.scheduler.cancelled
+        )
+        reg.counter_fn(
+            "adapter_cache_hits_total", lambda: self.adapters.hits
+        )
+        reg.counter_fn(
+            "adapter_cache_misses_total", lambda: self.adapters.misses
+        )
+        reg.counter_fn(
+            "adapter_evictions_total", lambda: self.adapters.evictions
+        )
+        reg.gauge_fn(
+            "adapters_live", lambda: len(self.adapters.live)
         )
         reg.counter_fn(
             "cow_copies_total", lambda: self.allocator.cow_copies
@@ -593,23 +633,22 @@ class InferenceEngine:
         lifetime. Greedy and sampled rows coexist via a per-slot temperature
         vector (0 = greedy); ``prev``/``use_prev`` splice the previous
         step's device-resident samples in as inputs so overlapped slots
-        never wait on a host readback."""
-        top_k, top_p = self._top_k, self._top_p
+        never wait on a host readback. ``bias`` is the fixed-shape
+        ``[max_slots, vocab]`` additive logit operand carrying
+        per-request logit-bias and grammar-mask rows — always present
+        (all-zeros when no row has mods, a cached device constant so the
+        common path stages no extra bytes), so mods arrive as data and
+        the program NEVER recompiles for them."""
+        row_sample = make_row_sampler(self._top_k, self._top_p)
 
         def run(params, cache, tokens, prev, use_prev, tables, lens, temps,
-                keys):
+                keys, bias):
             tok = jnp.where(use_prev > 0, prev, tokens)
             last_logits, cache = decode_token_step(
                 self.decode_model, params, cache, tok[:, None],
                 block_tables=tables, seq_lens=lens,
             )
-            greedy = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
-            safe_t = jnp.where(temps > 0, temps, 1.0)
-            scaled = truncate_logits(
-                last_logits / safe_t[:, None], top_k, top_p
-            )
-            sampled = jax.vmap(jax.random.categorical)(keys, scaled)
-            nxt = jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+            nxt = row_sample(last_logits, temps, keys, bias)
             return nxt, cache
 
         if self.mesh is None:
@@ -628,7 +667,7 @@ class InferenceEngine:
                 donate=(1,),
                 in_shardings=(
                     self._param_shardings, pool, rep, rep, rep, rep, rep,
-                    rep, rep,
+                    rep, rep, rep,
                 ),
                 out_shardings=(rep, pool),
             ),
@@ -873,11 +912,33 @@ class InferenceEngine:
 
     # ----------------------------------------------------------------- API
 
+    def register_adapter(
+        self,
+        name: str,
+        adapters,
+        *,
+        rank: int,
+        alpha: Optional[float] = None,
+    ) -> None:
+        """Register a named LoRA adapter (a ``training/lora.py`` low-rank
+        tree) for per-request multiplexing. Merging happens eagerly here
+        — the merge jit compiles NOW, so register every adapter before
+        ``arm_recompile_sentinel()`` and the sentinel stays zero at
+        steady state no matter how requests mix adapters."""
+        if self.mesh is not None:
+            raise ValueError(
+                "adapter mods are not supported on meshed engines"
+            )
+        self.adapters.register(name, adapters, rank=rank, alpha=alpha)
+
     def submit(
         self,
         prompt: Sequence[int],
         params: Optional[SamplingParams] = None,
         metadata: Optional[dict] = None,
+        *,
+        tenant_id: str = "anon",
+        mods: Optional[Mods] = None,
     ) -> int:
         """Queue one request; returns its id. Raises
         :class:`~.admission.QueueFull` (backpressure),
@@ -886,21 +947,51 @@ class InferenceEngine:
         admission is decided NOW, not at first schedule, and counts the
         currently-cached prefix: a shared-prompt request costs only its
         uncached tail of prefill work against the queue-token budget.
-        ``metadata`` is a tenant-opaque JSON-serializable dict carried
-        through scheduling (and the elastic snapshot) untouched."""
+        ``tenant_id`` is the typed tenancy key (fair-share, quotas,
+        per-tenant SLOs, preserved across drain/restore); ``metadata``
+        remains a tenant-opaque JSON-serializable dict carried through
+        scheduling (and the elastic snapshot) untouched. ``mods`` is an
+        optional :class:`~.mods.Mods` spec (logit bias / grammar /
+        adapter); device mods are refused on speculative engines (the
+        fused verify program has no bias operand) and adapter mods on
+        meshed engines (merged trees are placed unsharded)."""
         if self._server is None:
-            return self._submit_impl(prompt, params, metadata)
+            return self._submit_impl(
+                prompt, params, metadata, tenant_id, mods
+            )
         with self.registry.lock:
-            return self._submit_impl(prompt, params, metadata)
+            return self._submit_impl(
+                prompt, params, metadata, tenant_id, mods
+            )
 
     def _submit_impl(
         self,
         prompt: Sequence[int],
         params: Optional[SamplingParams],
         metadata: Optional[dict],
+        tenant_id: str = "anon",
+        mods: Optional[Mods] = None,
     ) -> int:
         params = params or SamplingParams()
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        mod_state: Optional[ModState] = None
+        if mods is not None and mods.device_mods:
+            if self.speculative:
+                raise ValueError(
+                    "logit-bias/grammar/adapter mods are not supported "
+                    "on speculative engines (stop_sequences are)"
+                )
+            if mods.adapter is not None:
+                if self.mesh is not None:
+                    raise ValueError(
+                        "adapter mods are not supported on meshed engines"
+                    )
+                if mods.adapter not in self.adapters:
+                    raise KeyError(
+                        f"unknown adapter {mods.adapter!r} — call "
+                        "register_adapter() first"
+                    )
+            mod_state = ModState(mods, self.vocab_size)
         cached = 0
         if self.prefix_cache is not None and prompt:
             cached = self.prefix_cache.peek(prompt)
@@ -910,6 +1001,7 @@ class InferenceEngine:
             queued_uncached_tokens=sum(
                 r.est_uncached for r in self.scheduler.waiting
             ),
+            tenant_id=tenant_id,
         )
         req = Request(
             req_id=self._next_id,
@@ -918,6 +1010,8 @@ class InferenceEngine:
             submit_time=time.perf_counter(),
             est_uncached=max(0, len(prompt) - 1 - cached),
             metadata=metadata,
+            tenant_id=tenant_id,
+            mods=mod_state,
         )
         self._next_id += 1
         self.requests[req.req_id] = req
@@ -938,6 +1032,13 @@ class InferenceEngine:
         fill in sampled tokens, retire what finished."""
         nxt, slots, reqs = self._inflight
         self._inflight = None
+        return self._resolve_rows(nxt, slots, reqs)
+
+    def _resolve_rows(
+        self, nxt, slots: List[int], reqs: List[Request]
+    ) -> List[int]:
+        """Resolve one decode dispatch's sampled tokens (async inflight
+        or an in-step sync mod group): fill values, retire finishers."""
         nxt_host = np.asarray(nxt)
         if self.xla is not None:
             self.xla.count_d2h(nxt_host.nbytes)
@@ -957,6 +1058,67 @@ class InferenceEngine:
                 self._keys.pop(done.req_id, None)
                 finished.append(done.req_id)
         return finished
+
+    def _dispatch_decode(self, slots: List[int], params, prev):
+        """Stage and run THE decode program for ``slots``. Rows outside
+        the group stage a zeroed block table and length, so their masked
+        K/V writes land in the null page — per-group dispatch commits
+        state for its own rows only, which is what lets one step issue
+        the base program plus per-adapter groups against one cache."""
+        self._stage_tables.fill(0)
+        self._stage_lens.fill(0)
+        self._stage_use_prev.fill(0)
+        bias = None
+        for slot in slots:
+            req = self.scheduler.slots[slot]
+            pos = req.len_cached
+            tok = req.tokens[pos]
+            if tok == PENDING_TOKEN:
+                # Input is last step's still-in-flight sample: select it
+                # device-side from ``prev``.
+                self._stage_use_prev[slot] = 1
+                self._stage_tokens[slot] = 0
+            else:
+                self._stage_tokens[slot] = tok
+            self._stage_tables[slot] = req.table.as_row(self.pages_per_seq)
+            self._stage_lens[slot] = pos
+            self._stage_temps[slot] = req.params.temperature
+            self._stage_keys[slot] = np.asarray(
+                jax.random.fold_in(self._keys[req.req_id], req.n_issued),
+                np.uint32,
+            )
+            row = req.mods.bias_row() if req.mods is not None else None
+            if row is not None:
+                if bias is None:
+                    bias = self._stage_bias
+                    bias.fill(0.0)
+                bias[slot] = row
+        if self.xla is not None:
+            staged = (
+                self._stage_tokens.nbytes
+                + self._stage_use_prev.nbytes
+                + self._stage_tables.nbytes
+                + self._stage_lens.nbytes
+                + self._stage_temps.nbytes
+                + self._stage_keys.nbytes
+            )
+            if bias is not None:
+                staged += bias.nbytes
+            self.xla.count_h2d(staged)
+        # No modded rows: reuse the zeros device constant — the bias
+        # operand costs the common path nothing.
+        bias_arr = self._zero_bias if bias is None else jnp.asarray(bias)
+        nxt, self.cache = self._decode_step(
+            params, self.cache,
+            jnp.asarray(self._stage_tokens), prev,
+            jnp.asarray(self._stage_use_prev),
+            jnp.asarray(self._stage_tables),
+            jnp.asarray(self._stage_lens),
+            jnp.asarray(self._stage_temps),
+            jnp.asarray(self._stage_keys),
+            bias_arr,
+        )
+        return nxt
 
     def _end_step_trace(self, plan) -> None:
         """Close the tracer's step slice with the per-step gauges: batch
@@ -1137,8 +1299,17 @@ class InferenceEngine:
                     table = req.table.as_row(self.pages_per_seq)[None]
                     if self.xla is not None:
                         self.xla.count_h2d(tok.nbytes + table.nbytes + 4)
+                    # Adapter rows prefill under their merged weights —
+                    # K/V written under base params would poison every
+                    # decode step that attends to it.
+                    ms = req.mods
+                    chunk_params = (
+                        self.adapters.params_for(ms.adapter)
+                        if ms is not None and ms.adapter is not None
+                        else self.params
+                    )
                     self.cache = self._prefill_step(chunk)(
-                        self.params, self.cache, jnp.asarray(tok),
+                        chunk_params, self.cache, jnp.asarray(tok),
                         jnp.asarray(table),
                         jnp.asarray([start], jnp.int32),
                     )
@@ -1148,61 +1319,61 @@ class InferenceEngine:
         dispatched = None
         if plan.decode_slots:
             with tr.phase("dispatch"):
-                self._stage_tables.fill(0)
-                self._stage_lens.fill(0)
-                self._stage_use_prev.fill(0)
+                # Partition this step's decode rows. Async rows (no mods,
+                # or bias-only — their bias row is request-constant) keep
+                # the classic one-dispatch overlap via ``prev``/
+                # ``use_prev``. Grammar rows (the next mask depends on
+                # this step's token) and each adapter's rows (their group
+                # swaps merged params into the SAME compiled program — a
+                # jit cache hit, never a recompile) dispatch as separate
+                # SYNC groups resolved in-step: the "mods tax" is losing
+                # dispatch/readback overlap for those rows only.
+                async_slots: List[int] = []
+                sync_groups: Dict[Optional[str], List[int]] = {}
                 for slot in plan.decode_slots:
-                    req = self.scheduler.slots[slot]
-                    pos = req.len_cached
-                    tok = req.tokens[pos]
-                    if tok == PENDING_TOKEN:
-                        # Input is last step's still-in-flight sample:
-                        # select it device-side from ``prev``.
-                        self._stage_use_prev[slot] = 1
-                        self._stage_tokens[slot] = 0
+                    ms = self.scheduler.slots[slot].mods
+                    if ms is not None and ms.needs_sync:
+                        sync_groups.setdefault(ms.adapter, []).append(slot)
                     else:
-                        self._stage_tokens[slot] = tok
-                    self._stage_tables[slot] = req.table.as_row(
-                        self.pages_per_seq
+                        async_slots.append(slot)
+                if async_slots:
+                    prev = (
+                        self._inflight[0] if self._inflight is not None
+                        else self._zero_prev
                     )
-                    self._stage_lens[slot] = pos
-                    self._stage_temps[slot] = req.params.temperature
-                    self._stage_keys[slot] = np.asarray(
-                        jax.random.fold_in(
-                            self._keys[req.req_id], req.n_issued
-                        ),
-                        np.uint32,
+                    nxt = self._dispatch_decode(
+                        async_slots, self.params, prev
                     )
-                prev = (
-                    self._inflight[0] if self._inflight is not None
-                    else self._zero_prev
-                )
-                if self.xla is not None:
-                    self.xla.count_h2d(
-                        self._stage_tokens.nbytes
-                        + self._stage_use_prev.nbytes
-                        + self._stage_tables.nbytes
-                        + self._stage_lens.nbytes
-                        + self._stage_temps.nbytes
-                        + self._stage_keys.nbytes
+                    dispatched = (
+                        nxt,
+                        async_slots,
+                        [
+                            self.scheduler.note_decode_dispatched(s)
+                            for s in async_slots
+                        ],
                     )
-                nxt, self.cache = self._decode_step(
-                    self.params, self.cache,
-                    jnp.asarray(self._stage_tokens), prev,
-                    jnp.asarray(self._stage_use_prev),
-                    jnp.asarray(self._stage_tables),
-                    jnp.asarray(self._stage_lens),
-                    jnp.asarray(self._stage_temps),
-                    jnp.asarray(self._stage_keys),
-                )
-                dispatched = (
-                    nxt,
-                    list(plan.decode_slots),
-                    [
-                        self.scheduler.note_decode_dispatched(s)
-                        for s in plan.decode_slots
-                    ],
-                )
+                sync_rounds = []
+                for adapter, slots in sorted(
+                    sync_groups.items(),
+                    key=lambda kv: (kv[0] is not None, kv[0] or ""),
+                ):
+                    group_params = (
+                        self.params if adapter is None
+                        else self.adapters.params_for(adapter)
+                    )
+                    nxt = self._dispatch_decode(
+                        slots, group_params, self._zero_prev
+                    )
+                    sync_rounds.append((
+                        nxt,
+                        slots,
+                        [
+                            self.scheduler.note_decode_dispatched(s)
+                            for s in slots
+                        ],
+                    ))
+                for nxt, slots, reqs in sync_rounds:
+                    finished.extend(self._resolve_rows(nxt, slots, reqs))
         if dispatched is not None:
             # The dispatched decode is in flight, its readback not taken:
             # the window a kill_mid_verify drill targets.

@@ -194,6 +194,11 @@ class ShadowRequest:
     first_token_s: Optional[float] = None
     failover_pending_since: Optional[float] = None
     len_at_failover: int = 0
+    # Front-door tenancy and mods ride the shadow so failover and hedging
+    # preserve them (the rebuilt RequestSnapshot carries both).
+    tenant_id: str = "anon"
+    mods: Optional["Mods"] = None
+    cancelled: bool = False
 
 
 @dataclasses.dataclass
@@ -429,6 +434,9 @@ class FleetRouter:
         prompt: Sequence[int],
         params: Optional[SamplingParams] = None,
         metadata: Optional[dict] = None,
+        *,
+        tenant_id: str = "anon",
+        mods=None,
     ) -> int:
         """Route one request; returns its FLEET id (stable across
         failover and hedging — engine-level ids are an implementation
@@ -453,7 +461,10 @@ class FleetRouter:
             if attempts > self.max_retries:
                 break
             try:
-                req_id = replica.engine.submit(prompt, params, metadata)
+                req_id = replica.engine.submit(
+                    prompt, params, metadata,
+                    tenant_id=tenant_id, mods=mods,
+                )
             except EngineDraining as exc:
                 # "Retry ELSEWHERE, now": the draining flag beat our last
                 # probe; update the table and go straight to the next.
@@ -481,6 +492,8 @@ class FleetRouter:
                 submit_s=self._clock(),
                 replica=replica.name,
                 req_id=req_id,
+                tenant_id=tenant_id,
+                mods=mods,
             )
             self._shadows[fid] = shadow
             self._by_owner[(replica.name, req_id)] = fid
@@ -576,7 +589,7 @@ class FleetRouter:
         if shadow.finished:
             return RequestStatus(
                 req_id=fid,
-                state="finished",
+                state="cancelled" if shadow.cancelled else "finished",
                 prompt_len=len(shadow.prompt),
                 generated=list(shadow.tokens[len(shadow.prompt):]),
                 finished=True,
@@ -597,6 +610,30 @@ class FleetRouter:
             finished=False,
             preempt_count=shadow.failovers,
         )
+
+    def cancel(self, fid: int) -> None:
+        """Client cancellation, fleet half: cancel the owning engine's
+        copy AND any hedge twin, freeze the shadow at its committed
+        tokens, and mark it cancelled (``poll`` reports the terminal
+        state; a later failover will not resurrect it). Idempotent on
+        already-finished requests."""
+        shadow = self._shadows[fid]
+        if shadow.finished:
+            return
+        targets = [(shadow.replica, shadow.req_id)]
+        if shadow.hedge_replica is not None:
+            targets.append((shadow.hedge_replica, shadow.hedge_req_id))
+        for name, rid in targets:
+            replica = self._by_name.get(name)
+            if replica is None or replica.state in ("dead", "removed"):
+                continue
+            try:
+                replica.engine.cancel(rid)
+            except KeyError:
+                pass
+        shadow.finished = True
+        shadow.cancelled = True
+        shadow.tokens = list(shadow.prompt) + list(shadow.generated)
 
     def _finalize(self, replica: Replica, req_id: int) -> Optional[int]:
         """One engine-level completion. The dedup rule lives here: the
@@ -850,6 +887,16 @@ class FleetRouter:
                     # restore_reprefill; a prefix-cache hit shrinks it).
                     kv_committed=len(shadow.prompt) + len(shadow.generated),
                     trie_keys=(),
+                    tenant_id=shadow.tenant_id,
+                    stop_sequences=tuple(
+                        tuple(int(t) for t in seq)
+                        for seq in p.stop_sequences
+                    ),
+                    mods=(
+                        shadow.mods.to_spec()
+                        if shadow.mods is not None
+                        else None
+                    ),
                 )
             )
         return EngineSnapshot(
@@ -890,7 +937,8 @@ class FleetRouter:
             target = min(others, key=lambda r: (self._load(r), r.index))
             try:
                 req_id = target.engine.submit(
-                    list(shadow.prompt), shadow.params, shadow.metadata
+                    list(shadow.prompt), shadow.params, shadow.metadata,
+                    tenant_id=shadow.tenant_id, mods=shadow.mods,
                 )
             except AdmissionError:
                 continue

@@ -1,0 +1,720 @@
+"""The production front door: streaming, cancellation, multi-tenant
+fair-share admission, and per-request model mods over one surface.
+
+Everything below `submit/step/poll` is an engine implementation detail;
+everything a *client* touches lives here, behind
+:meth:`FrontDoor.open_stream`:
+
+* **Token streaming.** The engine's overlapped step loop already
+  produces tokens incrementally (``note_decode_dispatched`` at dispatch,
+  ``resolve_decoded`` at readback); a :class:`TokenStream` exposes that
+  split as an ordered per-request iterator. Delivery is zero-copy — the
+  stream reads straight out of the request's committed ``generated``
+  list at its ``delivered`` high-water mark, so streamed tokens are
+  definitionally bitwise-identical to polled ones. The high-water mark
+  lives ON the request, which is what lets a drain snapshot record it
+  and a restored stream resume without replaying or skipping a token.
+* **Backpressure.** A slow consumer's undelivered backlog
+  (``len(generated) - delivered``) is bounded by ``max_stream_buffer``:
+  the pump refuses to step the engine while any open stream is over
+  budget (counted in ``backpressure_stalls_total``), so generation never
+  runs unboundedly ahead of consumption.
+* **Cancellation.** ``stream.cancel()`` plumbs the engine's ``cancel()``
+  through the handle — pages freed mid-flight, partial output still
+  drainable, ``cancelled_by_client_total`` counted. Queued-but-unadmitted
+  streams cancel without ever touching the engine.
+* **Fair share.** Stride scheduling (WFQ) over per-tenant queues: each
+  admission advances the tenant's virtual time by ``cost / weight``
+  (cost = prompt + max_new_tokens), and the backlogged tenant with the
+  LOWEST virtual time admits next, so throughput converges to the
+  weight ratio under contention. A tenant returning from idle re-enters
+  at ``max(own, global)`` virtual time — idle credit does not bank, and
+  its share redistributes to active tenants while it is away. Engine
+  priority remains submission order, so door-admission order IS engine
+  priority. Per-tenant token-rate buckets and queue quotas bound each
+  tenant independently of the shared engine queue.
+* **Per-tenant SLOs.** The door measures what the *client* sees — TTFT
+  and TPOT at token visibility, per tenant, in ``ReservoirGroup``
+  reservoirs — and feeds them to ``obs/slo.py`` burn-rate objectives per
+  tenant class, so one tenant's overload fires that tenant's alerts and
+  nobody else's.
+* **Model mods.** ``open_stream(mods=Mods(...))`` threads per-request
+  stop-sequences (via ``SamplingParams``), logit-bias, grammar masks,
+  and LoRA adapter selection down to the engine's one compiled decode
+  program as fixed-shape operands / params swaps — never a recompile.
+
+The door fronts either a single :class:`~.engine.InferenceEngine` or a
+:class:`~.fleet.FleetRouter` (streams then ride fleet ids, surviving
+failover and hedging); the backend is detected by duck type.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Deque, Dict, List, Optional, Tuple
+
+import dataclasses
+
+from distributed_pytorch_tpu.metrics import ReservoirGroup
+from distributed_pytorch_tpu.obs import MetricsRegistry
+from distributed_pytorch_tpu.obs.slo import SLObjective, SLOMonitor
+from distributed_pytorch_tpu.serving.admission import (
+    AdmissionError,
+    EngineDraining,
+    QueueFull,
+    RequestTooLong,
+)
+from distributed_pytorch_tpu.serving.mods import Mods
+from distributed_pytorch_tpu.serving.scheduler import SamplingParams
+
+
+class TenantQuotaExceeded(AdmissionError):
+    """The tenant's own door-queue quota is full (the shared engine queue
+    may be empty — quotas isolate tenants from each other's bursts)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantConfig:
+    """One tenant class's contract with the front door.
+
+    ``weight`` is the fair-share stride weight (2.0 gets twice the
+    admissions of 1.0 under contention). ``max_queued`` bounds the
+    tenant's DOOR queue (None = unbounded); ``rate_tokens_per_s`` /
+    ``burst_tokens`` configure the admission token bucket, charged at
+    admission with the request's cost (prompt + max_new_tokens).
+    ``ttft_slo_s`` / ``tpot_slo_s`` declare per-tenant latency
+    objectives: set, they become ``obs/slo.py`` burn-rate alerts over
+    the door's per-tenant reservoirs."""
+
+    weight: float = 1.0
+    max_queued: Optional[int] = None
+    rate_tokens_per_s: Optional[float] = None
+    burst_tokens: Optional[float] = None
+    ttft_slo_s: Optional[float] = None
+    tpot_slo_s: Optional[float] = None
+
+    def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError(f"weight must be > 0, got {self.weight}")
+
+
+class _Pending:
+    """A stream waiting in its tenant's door queue for fair-share
+    admission."""
+
+    __slots__ = ("stream", "prompt", "params", "mods", "metadata")
+
+    def __init__(self, stream, prompt, params, mods, metadata):
+        self.stream = stream
+        self.prompt = prompt
+        self.params = params
+        self.mods = mods
+        self.metadata = metadata
+
+    @property
+    def cost(self) -> int:
+        return len(self.prompt) + self.params.max_new_tokens
+
+
+class TokenStream:
+    """Ordered per-request token iterator with a final-status terminator.
+
+    Iteration yields committed tokens as they resolve (pumping the door
+    as needed) and raises ``StopIteration`` once the request is terminal
+    and fully delivered; ``status`` then reports the terminator
+    (``"finished"``, ``"cancelled"``, ``"expired"``, or ``"rejected"``).
+    ``delivered`` is the client-visible high-water mark — it advances
+    only when the consumer takes a token, and it is what a drain
+    snapshot records mid-stream."""
+
+    def __init__(self, door: "FrontDoor", tenant: str):
+        self._door = door
+        self.tenant = tenant
+        self.req_id: Optional[int] = None
+        self.delivered = 0
+        # Door-side terminal override for streams that never reached the
+        # engine ("cancelled" while queued, "rejected" at admission).
+        self._override: Optional[str] = None
+        self._reject_reason: Optional[str] = None
+        self._finalized = False
+        # Client-visibility timing (what the per-tenant SLO reservoirs
+        # record): set by the door's pump as tokens become visible.
+        self.submit_t: float = 0.0
+        self.first_token_t: Optional[float] = None
+        self.last_token_t: Optional[float] = None
+        self.seen = 0
+
+    # ------------------------------------------------------------- status
+
+    @property
+    def status(self) -> str:
+        if self._override is not None:
+            return self._override
+        if self.req_id is None:
+            return "queued"
+        return self._door._backend.state(self.req_id)
+
+    @property
+    def done(self) -> bool:
+        if self._override is not None:
+            return True
+        if self.req_id is None:
+            return False
+        return self._door._backend.done(self.req_id)
+
+    def backlog(self) -> int:
+        """Committed-but-undelivered tokens (the backpressure measure)."""
+        if self.req_id is None:
+            return 0
+        return len(self._door._backend.generated(self.req_id)) - (
+            self.delivered
+        )
+
+    # ----------------------------------------------------------- consume
+
+    def __iter__(self) -> "TokenStream":
+        return self
+
+    def __next__(self) -> int:
+        pumps = 0
+        while True:
+            if self._override is not None and self.req_id is None:
+                raise StopIteration
+            if self.req_id is not None:
+                gen = self._door._backend.generated(self.req_id)
+                if self.delivered < len(gen):
+                    tok = int(gen[self.delivered])
+                    self.delivered += 1
+                    self._door._backend.note_delivered(
+                        self.req_id, self.delivered
+                    )
+                    return tok
+                if self.done:
+                    raise StopIteration
+            self._door.pump()
+            pumps += 1
+            if pumps > self._door.max_pumps_per_token:
+                raise RuntimeError(
+                    f"stream for tenant {self.tenant!r} made no progress "
+                    f"after {pumps} pumps — another stream is likely "
+                    "holding the door at its backpressure cap without "
+                    "being consumed"
+                )
+
+    def drain(self) -> List[int]:
+        """Consume the stream to its terminator; returns the tokens taken
+        by THIS call (resuming mid-stream returns only the remainder)."""
+        return list(self)
+
+    def cancel(self) -> None:
+        self._door.cancel(self)
+
+
+class FrontDoor:
+    """Async-style serving gateway over an engine or fleet router.
+
+    Single-threaded by design, like everything in the serving stack:
+    ``pump()`` is one cooperative round (refill rate buckets, fair-share
+    admit, step the backend unless backpressured, observe per-tenant
+    latencies, tick SLOs), and stream iteration pumps on demand. Tests
+    and the bench drive it in a loop; an async wrapper would call it
+    from an event loop."""
+
+    def __init__(
+        self,
+        backend,
+        *,
+        tenants: Optional[Dict[str, TenantConfig]] = None,
+        default_tenant: str = "anon",
+        max_stream_buffer: int = 64,
+        max_inflight: Optional[int] = None,
+        reservoir_capacity: int = 1024,
+        clock=time.perf_counter,
+        slo: bool = True,
+        max_pumps_per_token: int = 10_000,
+    ):
+        self._backend = _make_backend(backend)
+        self._clock = clock
+        self.max_stream_buffer = int(max_stream_buffer)
+        self.max_pumps_per_token = int(max_pumps_per_token)
+        self.tenants: Dict[str, TenantConfig] = dict(tenants or {})
+        self.tenants.setdefault(default_tenant, TenantConfig())
+        self.default_tenant = default_tenant
+        # Admitted-but-unfinished cap: bounds how deep the door stuffs
+        # the engine queue. Too deep and engine FIFO (id = priority)
+        # overrides fair share; one batch-worth of headroom keeps slots
+        # fed while leaving ordering decisions at the door.
+        self.max_inflight = (
+            int(max_inflight)
+            if max_inflight is not None
+            else 2 * self._backend.slots_hint()
+        )
+        self._queues: Dict[str, Deque[_Pending]] = {
+            t: collections.deque() for t in self.tenants
+        }
+        # Stride/WFQ state. ``_global_v`` tracks the virtual time of the
+        # last admission; a tenant going from idle to backlogged rejoins
+        # at max(own, global) so idle time never banks credit.
+        self._vtime: Dict[str, float] = {t: 0.0 for t in self.tenants}
+        self._global_v = 0.0
+        # Token buckets: level (tokens) + last refill stamp, per tenant.
+        now = self._clock()
+        self._bucket: Dict[str, Tuple[float, float]] = {}
+        for t, cfg in self.tenants.items():
+            if cfg.rate_tokens_per_s is not None:
+                burst = (
+                    cfg.burst_tokens
+                    if cfg.burst_tokens is not None
+                    else cfg.rate_tokens_per_s
+                )
+                self._bucket[t] = (float(burst), now)
+        # Streams the pump still watches (admitted or queued, not yet
+        # finalized). Finalized streams stay iterable — they just stop
+        # costing the pump anything.
+        self._active: List[TokenStream] = []
+        self._by_req: Dict[int, TokenStream] = {}
+        # Counters (pull-registered below).
+        self.streams_opened = 0
+        self.admitted = 0
+        self.finished = 0
+        self.cancelled_by_client = 0
+        self.rejected_quota = 0
+        self.rejected = 0
+        self.backpressure_stalls = 0
+        self.pumps = 0
+        labels = tuple(sorted(self.tenants))
+        self._ttft = ReservoirGroup(
+            labels, capacity=reservoir_capacity, seed=11
+        )
+        self._tpot = ReservoirGroup(
+            labels, capacity=reservoir_capacity, seed=13
+        )
+        self.registry = self._build_registry()
+        objectives = self.slo_objectives()
+        self.slo = (
+            SLOMonitor(self.registry, objectives, clock=clock)
+            if slo and objectives
+            else None
+        )
+
+    # ------------------------------------------------------------ metrics
+
+    def _build_registry(self) -> MetricsRegistry:
+        reg = MetricsRegistry(namespace="frontdoor")
+        reg.counter_fn("streams_opened_total", lambda: self.streams_opened)
+        reg.counter_fn("admitted_total", lambda: self.admitted)
+        reg.counter_fn("finished_total", lambda: self.finished)
+        reg.counter_fn(
+            "cancelled_by_client_total", lambda: self.cancelled_by_client
+        )
+        reg.counter_fn(
+            "rejected_quota_total", lambda: self.rejected_quota
+        )
+        reg.counter_fn("rejected_total", lambda: self.rejected)
+        reg.counter_fn(
+            "backpressure_stalls_total", lambda: self.backpressure_stalls
+        )
+        reg.counter_fn("pumps_total", lambda: self.pumps)
+        reg.gauge_fn(
+            "queued_streams",
+            lambda: sum(len(q) for q in self._queues.values()),
+        )
+        reg.gauge_fn("active_streams", lambda: len(self._active))
+        reg.reservoir(
+            "ttft_by_tenant",
+            lambda: self._ttft,
+            label="tenant",
+            help="Client-visible time to first token, per tenant",
+        )
+        reg.reservoir(
+            "tpot_by_tenant",
+            lambda: self._tpot,
+            label="tenant",
+            help="Client-visible per-token latency, per tenant",
+        )
+        return reg
+
+    def slo_objectives(self) -> List[SLObjective]:
+        """Burn-rate objectives derived from the tenant contracts — one
+        latency objective per declared threshold, labeled by tenant, so
+        each class burns its own budget and only its own."""
+        objs: List[SLObjective] = []
+        for tenant, cfg in sorted(self.tenants.items()):
+            if cfg.ttft_slo_s is not None:
+                objs.append(
+                    SLObjective(
+                        name=f"ttft_{tenant}",
+                        metric="ttft_by_tenant",
+                        quantile=0.95,
+                        threshold_s=cfg.ttft_slo_s,
+                        label=tenant,
+                    )
+                )
+            if cfg.tpot_slo_s is not None:
+                objs.append(
+                    SLObjective(
+                        name=f"tpot_{tenant}",
+                        metric="tpot_by_tenant",
+                        quantile=0.95,
+                        threshold_s=cfg.tpot_slo_s,
+                        label=tenant,
+                    )
+                )
+        return objs
+
+    # ---------------------------------------------------------------- API
+
+    def open_stream(
+        self,
+        prompt,
+        tenant: Optional[str] = None,
+        *,
+        params: Optional[SamplingParams] = None,
+        mods: Optional[Mods] = None,
+        metadata: Optional[dict] = None,
+    ) -> TokenStream:
+        """Enqueue one request under ``tenant`` and return its stream.
+
+        The request reaches the engine at the door's fair-share pace (the
+        stream pumps as you iterate — callers never wait on admission
+        explicitly). Raises :class:`TenantQuotaExceeded` when the
+        tenant's own queue quota is full and ``KeyError`` for an
+        undeclared tenant."""
+        tenant = tenant if tenant is not None else self.default_tenant
+        cfg = self.tenants.get(tenant)
+        if cfg is None:
+            raise KeyError(
+                f"undeclared tenant {tenant!r}; declared: "
+                f"{sorted(self.tenants)}"
+            )
+        queue = self._queues[tenant]
+        if cfg.max_queued is not None and len(queue) >= cfg.max_queued:
+            self.rejected_quota += 1
+            raise TenantQuotaExceeded(
+                f"tenant {tenant!r} queue quota ({cfg.max_queued}) full"
+            )
+        params = params or SamplingParams()
+        stream = TokenStream(self, tenant)
+        stream.submit_t = self._clock()
+        if not queue:
+            # Idle -> backlogged: rejoin the stride race at the current
+            # global virtual time (no banked credit from idling).
+            self._vtime[tenant] = max(self._vtime[tenant], self._global_v)
+        queue.append(
+            _Pending(stream, [int(t) for t in prompt], params, mods,
+                     metadata)
+        )
+        self._active.append(stream)
+        self.streams_opened += 1
+        return stream
+
+    def cancel(self, stream: TokenStream) -> None:
+        """Client cancellation through the stream handle. Queued streams
+        die at the door; admitted ones cancel in the engine (pages freed
+        mid-flight, partial output still drainable). Idempotent."""
+        if stream.done:
+            return
+        if stream.req_id is None:
+            queue = self._queues[stream.tenant]
+            try:
+                queue.remove(
+                    next(p for p in queue if p.stream is stream)
+                )
+            except StopIteration:
+                pass
+            stream._override = "cancelled"
+        else:
+            self._backend.cancel(stream.req_id)
+        self.cancelled_by_client += 1
+
+    def pump(self) -> List[int]:
+        """One cooperative round; returns backend-finished request ids."""
+        self.pumps += 1
+        self._admit()
+        blocked = any(
+            s.backlog() >= self.max_stream_buffer
+            for s in self._active
+            if s.req_id is not None and not s.done
+        )
+        if blocked:
+            self.backpressure_stalls += 1
+            finished: List[int] = []
+        else:
+            finished = self._backend.step()
+        self._observe()
+        if self.slo is not None:
+            self.slo.tick()
+        return finished
+
+    def drive(self, max_pumps: int = 100_000) -> None:
+        """Pump until every watched stream is terminal (admitted work
+        drained, queues empty). Consumers must still iterate their
+        streams if buffers could fill — this is the poll-style helper
+        for tests and the bench."""
+        for _ in range(max_pumps):
+            if not self._active and not any(
+                self._queues[t] for t in self._queues
+            ):
+                return
+            self.pump()
+        raise RuntimeError(f"drive() did not quiesce in {max_pumps} pumps")
+
+    def adopt_streams(self) -> Dict[int, TokenStream]:
+        """Resume streaming after an elastic restore: build a stream for
+        every live backend request, resuming delivery at each request's
+        restored ``delivered`` high-water mark — the client sees one
+        uninterrupted token sequence across the migration. Returns
+        ``{req_id: stream}``."""
+        adopted: Dict[int, TokenStream] = {}
+        for req_id, tenant, delivered in self._backend.live_requests():
+            if req_id in self._by_req:
+                continue
+            if tenant not in self.tenants:
+                # Restored tenancy the door was not configured with:
+                # deliver under the default class rather than dropping.
+                tenant = self.default_tenant
+            stream = TokenStream(self, tenant)
+            stream.req_id = req_id
+            stream.delivered = delivered
+            stream.seen = delivered
+            stream.submit_t = self._clock()
+            self._active.append(stream)
+            self._by_req[req_id] = stream
+            adopted[req_id] = stream
+        return adopted
+
+    # ----------------------------------------------------------- internals
+
+    def _bucket_level(self, tenant: str, now: float) -> Optional[float]:
+        state = self._bucket.get(tenant)
+        if state is None:
+            return None
+        cfg = self.tenants[tenant]
+        level, last = state
+        burst = (
+            cfg.burst_tokens
+            if cfg.burst_tokens is not None
+            else cfg.rate_tokens_per_s
+        )
+        level = min(burst, level + cfg.rate_tokens_per_s * (now - last))
+        self._bucket[tenant] = (level, now)
+        return level
+
+    def _admit(self) -> None:
+        """Fair-share admission: repeatedly admit the backlogged,
+        rate-eligible tenant with the lowest virtual time until the
+        inflight cap, the engine queue, or every bucket says stop."""
+        while True:
+            inflight = sum(
+                1
+                for s in self._active
+                if s.req_id is not None and not s.done
+            )
+            if inflight >= self.max_inflight:
+                return
+            now = self._clock()
+            best: Optional[str] = None
+            for tenant in sorted(self._queues):
+                queue = self._queues[tenant]
+                if not queue:
+                    continue
+                level = self._bucket_level(tenant, now)
+                if level is not None and level < queue[0].cost:
+                    continue
+                if best is None or self._vtime[tenant] < self._vtime[best]:
+                    best = tenant
+            if best is None:
+                return
+            queue = self._queues[best]
+            pending = queue[0]
+            try:
+                req_id = self._backend.submit(
+                    pending.prompt,
+                    pending.params,
+                    pending.metadata,
+                    tenant_id=best,
+                    mods=pending.mods,
+                )
+            except (QueueFull, EngineDraining):
+                return
+            except AdmissionError as exc:
+                # Structurally inadmissible (e.g. RequestTooLong): this
+                # request can never run — reject its stream and move on.
+                queue.popleft()
+                pending.stream._override = "rejected"
+                pending.stream._reject_reason = str(exc)
+                self.rejected += 1
+                continue
+            queue.popleft()
+            pending.stream.req_id = req_id
+            self._by_req[req_id] = pending.stream
+            self.admitted += 1
+            if best in self._bucket:
+                level, last = self._bucket[best]
+                self._bucket[best] = (level - pending.cost, last)
+            self._vtime[best] += pending.cost / self.tenants[best].weight
+            self._global_v = max(self._global_v, self._vtime[best])
+
+    def _observe(self) -> None:
+        """Record client-visible latencies and retire terminal streams
+        from the watch list (they remain drainable)."""
+        now = self._clock()
+        still: List[TokenStream] = []
+        for stream in self._active:
+            if stream._override is not None:
+                continue
+            if stream.req_id is None:
+                still.append(stream)
+                continue
+            n = len(self._backend.generated(stream.req_id))
+            if n > stream.seen:
+                if stream.first_token_t is None:
+                    stream.first_token_t = now
+                    self._ttft.record(
+                        stream.tenant, now - stream.submit_t
+                    )
+                stream.last_token_t = now
+                stream.seen = n
+            if stream.done:
+                self._finalize(stream)
+            else:
+                still.append(stream)
+        self._active = still
+
+    def _finalize(self, stream: TokenStream) -> None:
+        if stream._finalized:
+            return
+        stream._finalized = True
+        self.finished += 1
+        if (
+            stream.first_token_t is not None
+            and stream.last_token_t is not None
+            and stream.seen > 1
+        ):
+            self._tpot.record(
+                stream.tenant,
+                (stream.last_token_t - stream.first_token_t)
+                / (stream.seen - 1),
+            )
+
+
+# ------------------------------------------------------------- backends
+
+
+class _EngineBackend:
+    """Duck-type adapter over a single :class:`~.engine.InferenceEngine`.
+    ``generated`` returns the live committed-token list (PENDING never
+    appears there), so streams read engine truth with no copies."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def slots_hint(self) -> int:
+        return self.engine.max_slots
+
+    def submit(self, prompt, params, metadata, *, tenant_id, mods) -> int:
+        return self.engine.submit(
+            prompt, params, metadata, tenant_id=tenant_id, mods=mods
+        )
+
+    def step(self) -> List[int]:
+        return self.engine.step()
+
+    def generated(self, req_id: int) -> List[int]:
+        return self.engine.requests[req_id].generated
+
+    def state(self, req_id: int) -> str:
+        return self.engine.requests[req_id].state.value
+
+    def done(self, req_id: int) -> bool:
+        return self.engine.requests[req_id].done
+
+    def cancel(self, req_id: int) -> None:
+        self.engine.cancel(req_id)
+
+    def note_delivered(self, req_id: int, n: int) -> None:
+        req = self.engine.requests.get(req_id)
+        if req is not None:
+            req.delivered = n
+
+    def live_requests(self):
+        for req_id, req in sorted(self.engine.requests.items()):
+            if not req.done:
+                yield req_id, req.tenant_id, req.delivered
+
+
+class _RouterBackend:
+    """Adapter over a :class:`~.fleet.FleetRouter`: streams ride FLEET
+    ids, so they survive failover and hedging untouched. ``generated``
+    is the router's committed shadow view — exactly what failover would
+    preserve, so a stream can never deliver a token a recovery would
+    later contradict."""
+
+    def __init__(self, router):
+        self.router = router
+
+    def slots_hint(self) -> int:
+        return max(
+            1,
+            sum(
+                r.engine.max_slots
+                for r in self.router.replicas()
+                if r.state == "live"
+            ),
+        )
+
+    def submit(self, prompt, params, metadata, *, tenant_id, mods) -> int:
+        return self.router.submit(
+            prompt, params, metadata, tenant_id=tenant_id, mods=mods
+        )
+
+    def step(self) -> List[int]:
+        return self.router.step()
+
+    def generated(self, fid: int) -> List[int]:
+        return self.router.poll(fid).generated
+
+    def state(self, fid: int) -> str:
+        return self.router.poll(fid).state
+
+    def done(self, fid: int) -> bool:
+        return self.router.poll(fid).state in (
+            "finished", "cancelled", "expired",
+        )
+
+    def cancel(self, fid: int) -> None:
+        self.router.cancel(fid)
+
+    def note_delivered(self, fid: int, n: int) -> None:
+        # Best-effort: propagate the high-water mark to the owning
+        # engine request so a drain snapshot taken on that replica
+        # carries it. The shadow's committed view already bounds what a
+        # failover can lose.
+        shadow = self.router._shadows.get(fid)
+        if shadow is None or shadow.finished:
+            return
+        replica = self.router._by_name.get(shadow.replica)
+        if replica is None or replica.state in ("dead", "removed"):
+            return
+        req = replica.engine.requests.get(shadow.req_id)
+        if req is not None:
+            req.delivered = min(n, len(req.generated))
+
+    def live_requests(self):
+        for fid, shadow in sorted(self.router._shadows.items()):
+            if not shadow.finished:
+                yield fid, shadow.tenant_id, 0
+
+
+def _make_backend(obj):
+    if isinstance(obj, (_EngineBackend, _RouterBackend)):
+        return obj
+    if hasattr(obj, "fleet_snapshot"):
+        return _RouterBackend(obj)
+    if hasattr(obj, "requests") and hasattr(obj, "step"):
+        return _EngineBackend(obj)
+    raise TypeError(
+        f"FrontDoor needs an InferenceEngine or FleetRouter, got "
+        f"{type(obj).__name__}"
+    )

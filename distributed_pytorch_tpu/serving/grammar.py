@@ -1,0 +1,335 @@
+"""Grammar-constrained decoding: token-level regex -> DFA -> logit masks.
+
+The front door accepts a *token regex* — a regular expression whose
+alphabet is token ids, not characters (this codebase is tokenizer-free,
+so constraints are expressed directly over the vocabulary). The pattern
+compiles once per request into a DFA; at each decode step the DFA's
+current state yields a fixed-shape ``float32[vocab]`` additive mask row
+(0.0 on allowed tokens, :data:`NEG_MASK` elsewhere) that the engine
+stages into the one compiled decode program. Masking is therefore pure
+data — the program never recompiles, and an all-zeros row is the exact
+bitwise no-op the mods-off parity tests pin.
+
+Pattern syntax (whitespace separates atoms; concatenation is implicit):
+
+    atom     := INT | '.' | '[' (INT | INT '-' INT)+ ']' | '(' expr ')'
+    postfix  := atom ('*' | '+' | '?')?
+    expr     := seq ('|' seq)*
+
+Examples over a 48-token vocab::
+
+    "7 (1 2)* 9"        # 7, then any number of 1,2 pairs, then 9
+    "[10-19]+ 3"        # one or more tokens in [10, 19], then 3
+    "(5 | 6 | 7) .*"    # starts with 5, 6 or 7, anything after
+
+Semantics chosen for serving:
+
+* **Forced end**: a request finishes when the DFA reaches a state with
+  no outgoing transitions (the grammar cannot continue). Accepting
+  states *with* continuations do not stop generation — ``max_new_tokens``
+  or stop sequences handle early exit, composably.
+* **No dead ends by construction**: subset construction only creates
+  reachable states, and a state whose mask would be empty simply has no
+  outgoing transitions — it is a forced end, finished host-side before
+  any dispatch, so the device never sees an all-``NEG_MASK`` row.
+* Patterns that match only the empty sequence (or nothing) are refused
+  at compile time: a grammar that is already over cannot constrain
+  generation.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+import numpy as np
+
+# Additive logit penalty for disallowed tokens. Large enough that a
+# masked token never wins argmax and its softmax weight underflows to
+# zero, small enough to stay comfortably finite in float32 arithmetic.
+NEG_MASK = -1.0e9
+
+
+# --------------------------------------------------------------- pattern
+
+
+def _lex(pattern: str) -> List[Tuple[str, Optional[int]]]:
+    toks: List[Tuple[str, Optional[int]]] = []
+    i, n = 0, len(pattern)
+    while i < n:
+        c = pattern[i]
+        if c.isspace():
+            i += 1
+        elif c in "()[]|*+?.-":
+            toks.append((c, None))
+            i += 1
+        elif c.isdigit():
+            j = i
+            while j < n and pattern[j].isdigit():
+                j += 1
+            toks.append(("INT", int(pattern[i:j])))
+            i = j
+        else:
+            raise ValueError(
+                f"grammar: unexpected character {c!r} at {i} in "
+                f"{pattern!r}"
+            )
+    return toks
+
+
+class _Nfa:
+    """Thompson-construction NFA: per-state epsilon edges plus
+    symbol-set edges (each labelled with a frozenset of token ids)."""
+
+    def __init__(self) -> None:
+        self.eps: List[List[int]] = []
+        self.sym: List[List[Tuple[FrozenSet[int], int]]] = []
+
+    def state(self) -> int:
+        self.eps.append([])
+        self.sym.append([])
+        return len(self.eps) - 1
+
+
+class _Parser:
+    """Recursive-descent token-regex parser producing NFA fragments
+    ``(start, accept)`` with a single accept state each."""
+
+    def __init__(self, pattern: str, vocab_size: int) -> None:
+        self.toks = _lex(pattern)
+        self.pos = 0
+        self.vocab = vocab_size
+        self.nfa = _Nfa()
+        self.pattern = pattern
+
+    def _peek(self) -> Optional[str]:
+        return self.toks[self.pos][0] if self.pos < len(self.toks) else None
+
+    def _take(self, kind: str) -> Optional[int]:
+        if self._peek() != kind:
+            raise ValueError(
+                f"grammar: expected {kind!r}, got {self._peek()!r} in "
+                f"{self.pattern!r}"
+            )
+        _, val = self.toks[self.pos]
+        self.pos += 1
+        return val
+
+    def parse(self) -> Tuple[int, int]:
+        frag = self._expr()
+        if self.pos != len(self.toks):
+            raise ValueError(
+                f"grammar: trailing tokens from position {self.pos} in "
+                f"{self.pattern!r}"
+            )
+        return frag
+
+    def _expr(self) -> Tuple[int, int]:
+        frags = [self._seq()]
+        while self._peek() == "|":
+            self._take("|")
+            frags.append(self._seq())
+        if len(frags) == 1:
+            return frags[0]
+        s, a = self.nfa.state(), self.nfa.state()
+        for fs, fa in frags:
+            self.nfa.eps[s].append(fs)
+            self.nfa.eps[fa].append(a)
+        return s, a
+
+    def _seq(self) -> Tuple[int, int]:
+        frags = []
+        while self._peek() in ("INT", "(", "[", "."):
+            frags.append(self._postfix())
+        if not frags:
+            # An empty branch ("a |" or "()") would admit the empty
+            # sequence — refused below anyway, but fail early and clearly.
+            raise ValueError(
+                f"grammar: empty sequence branch in {self.pattern!r}"
+            )
+        s, a = frags[0]
+        for fs, fa in frags[1:]:
+            self.nfa.eps[a].append(fs)
+            a = fa
+        return s, a
+
+    def _postfix(self) -> Tuple[int, int]:
+        s, a = self._atom()
+        op = self._peek()
+        if op in ("*", "+", "?"):
+            self._take(op)
+            ns, na = self.nfa.state(), self.nfa.state()
+            self.nfa.eps[ns].append(s)
+            self.nfa.eps[a].append(na)
+            if op in ("*", "?"):
+                self.nfa.eps[ns].append(na)
+            if op in ("*", "+"):
+                self.nfa.eps[a].append(s)
+            return ns, na
+        return s, a
+
+    def _atom(self) -> Tuple[int, int]:
+        kind = self._peek()
+        if kind == "(":
+            self._take("(")
+            frag = self._expr()
+            self._take(")")
+            return frag
+        if kind == "[":
+            return self._edge(self._cls())
+        if kind == ".":
+            self._take(".")
+            return self._edge(frozenset(range(self.vocab)))
+        tok = self._take("INT")
+        return self._edge(frozenset((self._check(tok),)))
+
+    def _cls(self) -> FrozenSet[int]:
+        self._take("[")
+        ids: set = set()
+        while self._peek() == "INT":
+            lo = self._take("INT")
+            if self._peek() == "-":
+                self._take("-")
+                hi = self._take("INT")
+                if hi < lo:
+                    raise ValueError(
+                        f"grammar: empty range {lo}-{hi} in "
+                        f"{self.pattern!r}"
+                    )
+                ids.update(range(self._check(lo), self._check(hi) + 1))
+            else:
+                ids.add(self._check(lo))
+        self._take("]")
+        if not ids:
+            raise ValueError(
+                f"grammar: empty token class in {self.pattern!r}"
+            )
+        return frozenset(ids)
+
+    def _check(self, tok: int) -> int:
+        if not 0 <= tok < self.vocab:
+            raise ValueError(
+                f"grammar: token {tok} outside vocab [0, {self.vocab}) "
+                f"in {self.pattern!r}"
+            )
+        return tok
+
+    def _edge(self, syms: FrozenSet[int]) -> Tuple[int, int]:
+        s, a = self.nfa.state(), self.nfa.state()
+        self.nfa.sym[s].append((syms, a))
+        return s, a
+
+
+# ------------------------------------------------------------------- DFA
+
+
+class TokenDFA:
+    """Deterministic automaton over token ids with per-state cached
+    float32 mask rows. States are dense ints; 0 is the start state."""
+
+    def __init__(
+        self,
+        vocab_size: int,
+        transitions: List[Dict[int, int]],
+        accepting: FrozenSet[int],
+        pattern: str,
+    ) -> None:
+        self.vocab_size = vocab_size
+        self.pattern = pattern
+        self._trans = transitions
+        self.accepting = accepting
+        self._masks: Dict[int, np.ndarray] = {}
+
+    @property
+    def start(self) -> int:
+        return 0
+
+    @property
+    def n_states(self) -> int:
+        return len(self._trans)
+
+    def allowed(self, state: int) -> FrozenSet[int]:
+        return frozenset(self._trans[state])
+
+    def is_end(self, state: int) -> bool:
+        """No outgoing transitions: generation under this grammar is
+        forced to stop here."""
+        return not self._trans[state]
+
+    def mask_row(self, state: int) -> np.ndarray:
+        """Additive logit mask for ``state``: 0.0 on allowed token ids,
+        :data:`NEG_MASK` elsewhere. Cached per state; callers must not
+        mutate the returned row (it is staged as-is every step)."""
+        row = self._masks.get(state)
+        if row is None:
+            row = np.full((self.vocab_size,), NEG_MASK, dtype=np.float32)
+            ids = list(self._trans[state])
+            if ids:
+                row[ids] = 0.0
+            row.setflags(write=False)
+            self._masks[state] = row
+        return row
+
+    def advance(self, state: int, token: int) -> int:
+        try:
+            return self._trans[state][int(token)]
+        except KeyError:
+            raise ValueError(
+                f"grammar {self.pattern!r}: token {token} not allowed "
+                f"in state {state}"
+            ) from None
+
+
+def compile_grammar(pattern: str, vocab_size: int) -> TokenDFA:
+    """Compile a token regex into a :class:`TokenDFA` via Thompson NFA
+    construction and subset construction. Refuses patterns whose
+    language is empty or contains only the empty sequence."""
+    if vocab_size <= 0:
+        raise ValueError("grammar: vocab_size must be positive")
+    parser = _Parser(pattern, vocab_size)
+    start, accept = parser.parse()
+    nfa = parser.nfa
+
+    def eclose(states: FrozenSet[int]) -> FrozenSet[int]:
+        stack, seen = list(states), set(states)
+        while stack:
+            s = stack.pop()
+            for t in nfa.eps[s]:
+                if t not in seen:
+                    seen.add(t)
+                    stack.append(t)
+        return frozenset(seen)
+
+    start_set = eclose(frozenset((start,)))
+    index: Dict[FrozenSet[int], int] = {start_set: 0}
+    order: List[FrozenSet[int]] = [start_set]
+    transitions: List[Dict[int, int]] = [{}]
+    accepting: set = set()
+    todo = [start_set]
+    while todo:
+        cur = todo.pop()
+        ci = index[cur]
+        if accept in cur:
+            accepting.add(ci)
+        # Group reachable NFA targets by token id across the member
+        # states' symbol edges, then close and intern each target set.
+        by_token: Dict[int, set] = {}
+        for s in cur:
+            for syms, dst in nfa.sym[s]:
+                for tok in syms:
+                    by_token.setdefault(tok, set()).add(dst)
+        for tok, dsts in by_token.items():
+            nxt = eclose(frozenset(dsts))
+            ni = index.get(nxt)
+            if ni is None:
+                ni = len(order)
+                index[nxt] = ni
+                order.append(nxt)
+                transitions.append({})
+                todo.append(nxt)
+            transitions[ci][tok] = ni
+    if not transitions[0]:
+        raise ValueError(
+            f"grammar {pattern!r}: matches at most the empty sequence — "
+            "cannot constrain generation"
+        )
+    return TokenDFA(vocab_size, transitions, frozenset(accepting), pattern)

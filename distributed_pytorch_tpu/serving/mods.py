@@ -1,0 +1,237 @@
+"""Per-request model mods: logit bias, grammar masks, LoRA adapters.
+
+Three layers, one per lifetime:
+
+* :class:`Mods` — the immutable, JSON-serializable *spec* a client
+  attaches to a request (and the form that rides inside an elastic
+  snapshot so mods survive drain/restore and fleet failover).
+* :class:`ModState` — the live engine-side state the spec binds to:
+  the compiled grammar DFA plus its current state, and the request's
+  combined additive bias row. The scheduler advances it via
+  ``note_token``; the engine reads ``bias_row()`` at every dispatch.
+* :class:`AdapterStore` — named LoRA adapters (low-rank deltas from
+  ``training/lora.py``) merged over the shared base weights on demand
+  and LRU-evicted like KV pages. Merged trees have *identical* pytree
+  structure and shapes to the base params, so swapping them into the
+  one compiled decode program is a jit cache hit — never a recompile.
+
+Recompile-safety contract (the sentinel must stay zero): every mask /
+bias is a fixed-shape ``float32[max_slots, vocab]`` operand staged as
+data; adapters must be registered (and therefore merged — merging jits
+once per rank) BEFORE ``arm_recompile_sentinel()``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+from typing import Dict, Mapping, Optional, Tuple
+
+import jax
+import numpy as np
+
+from distributed_pytorch_tpu.serving.grammar import TokenDFA, compile_grammar
+from distributed_pytorch_tpu.training.lora import merge_lora
+
+
+@dataclasses.dataclass(frozen=True)
+class Mods:
+    """Per-request model-mod spec. All fields optional and composable:
+
+    * ``logit_bias`` — additive per-token logit offsets (token id ->
+      float), applied before temperature, truncation, and sampling.
+    * ``grammar`` — a token regex (see :mod:`.grammar`); decoding is
+      masked to the DFA's allowed set each step and finishes when the
+      grammar reaches a forced end.
+    * ``adapter`` — name of a LoRA adapter previously registered with
+      the engine; the request decodes under base-plus-delta weights.
+
+    ``stop_sequences`` deliberately live in ``SamplingParams`` (next to
+    ``stop_token``), not here: they are pure host-side finish detection
+    with no device-side footprint, and they work on speculative engines
+    where device mods are refused."""
+
+    logit_bias: Optional[Mapping[int, float]] = None
+    grammar: Optional[str] = None
+    adapter: Optional[str] = None
+
+    def __post_init__(self):
+        if self.logit_bias is not None:
+            frozen = tuple(
+                sorted((int(t), float(b)) for t, b in dict(self.logit_bias).items())
+            )
+            object.__setattr__(self, "logit_bias", frozen)
+
+    @property
+    def device_mods(self) -> bool:
+        """True when any mod touches the device program's operands (vs
+        stop sequences, which are host-only)."""
+        return bool(self.logit_bias) or self.grammar is not None or (
+            self.adapter is not None
+        )
+
+    def to_spec(self) -> dict:
+        doc: dict = {}
+        if self.logit_bias:
+            doc["logit_bias"] = {str(t): b for t, b in self.logit_bias}
+        if self.grammar is not None:
+            doc["grammar"] = self.grammar
+        if self.adapter is not None:
+            doc["adapter"] = self.adapter
+        return doc
+
+    @classmethod
+    def from_spec(cls, doc: Mapping) -> "Mods":
+        bias = doc.get("logit_bias")
+        return cls(
+            logit_bias=(
+                {int(t): float(b) for t, b in bias.items()}
+                if bias
+                else None
+            ),
+            grammar=doc.get("grammar"),
+            adapter=doc.get("adapter"),
+        )
+
+
+class ModState:
+    """Live per-request mod state bound to one engine's vocabulary.
+
+    The scheduler calls :meth:`note_token` on every committed token
+    (grammar state advance; True = forced end, finish the request).
+    The engine calls :meth:`bias_row` at dispatch to stage this row of
+    the fixed-shape bias operand."""
+
+    def __init__(self, mods: Mods, vocab_size: int) -> None:
+        self.mods = mods
+        self.vocab_size = vocab_size
+        self._static_bias: Optional[np.ndarray] = None
+        if mods.logit_bias:
+            row = np.zeros((vocab_size,), dtype=np.float32)
+            for tok, bias in mods.logit_bias:
+                if not 0 <= tok < vocab_size:
+                    raise ValueError(
+                        f"logit_bias token {tok} outside vocab "
+                        f"[0, {vocab_size})"
+                    )
+                row[tok] = bias
+            row.setflags(write=False)
+            self._static_bias = row
+        self.dfa: Optional[TokenDFA] = (
+            compile_grammar(mods.grammar, vocab_size)
+            if mods.grammar is not None
+            else None
+        )
+        self.gstate: Optional[int] = self.dfa.start if self.dfa else None
+
+    @property
+    def adapter(self) -> Optional[str]:
+        return self.mods.adapter
+
+    @property
+    def needs_sync(self) -> bool:
+        """Grammar rows need the committed token before the next mask
+        can be staged; adapter rows dispatch in their own per-adapter
+        group. Both resolve in-step (forfeiting dispatch/readback
+        overlap for that row only). Bias-only rows stay async — their
+        row is request-constant."""
+        return self.dfa is not None or self.mods.adapter is not None
+
+    def bias_row(self) -> Optional[np.ndarray]:
+        """The request's additive logit row for the NEXT dispatch:
+        static bias plus the grammar mask of the current DFA state.
+        None = all-zeros (caller may skip staging entirely)."""
+        if self.dfa is None:
+            return self._static_bias
+        mask = self.dfa.mask_row(self.gstate)
+        if self._static_bias is None:
+            return mask
+        return mask + self._static_bias
+
+    def note_token(self, token: int) -> bool:
+        if self.dfa is None:
+            return False
+        self.gstate = self.dfa.advance(self.gstate, int(token))
+        return self.dfa.is_end(self.gstate)
+
+    def replay(self, tokens) -> None:
+        """Rebuild grammar state deterministically from committed tokens
+        (elastic restore: the DFA is pure, so replay == the original
+        walk)."""
+        for tok in tokens:
+            self.note_token(tok)
+
+
+@functools.lru_cache(maxsize=None)
+def _merge_fn(rank: int, alpha: Optional[float]):
+    def merge(params, adapters):
+        return merge_lora(params, adapters, rank=rank, alpha=alpha)
+
+    return jax.jit(merge)
+
+
+class AdapterStore:
+    """Named LoRA adapters with an LRU device cache of merged weights.
+
+    ``register`` keeps the (small) low-rank host trees; ``params_for``
+    returns base-plus-delta full weights, merging on miss via a jitted
+    ``merge_lora`` (one compile per distinct rank/alpha — do it before
+    arming the recompile sentinel; ``register`` warms by default) and
+    evicting the least-recently-used merged tree beyond ``max_live``
+    (each merged tree is a full model copy — the KV-page economics,
+    applied to weights)."""
+
+    def __init__(self, base_params, max_live: int = 4) -> None:
+        self._base = base_params
+        self._specs: Dict[str, Tuple[object, int, Optional[float]]] = {}
+        self._merged: "collections.OrderedDict[str, object]" = (
+            collections.OrderedDict()
+        )
+        self.max_live = max(1, int(max_live))
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def register(
+        self,
+        name: str,
+        adapters,
+        *,
+        rank: int,
+        alpha: Optional[float] = None,
+        warm: bool = True,
+    ) -> None:
+        if name in self._specs:
+            raise ValueError(f"adapter {name!r} already registered")
+        self._specs[name] = (adapters, int(rank), alpha)
+        if warm:
+            self.params_for(name)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._specs
+
+    def names(self) -> Tuple[str, ...]:
+        return tuple(self._specs)
+
+    @property
+    def live(self) -> Tuple[str, ...]:
+        return tuple(self._merged)
+
+    def params_for(self, name: str):
+        spec = self._specs.get(name)
+        if spec is None:
+            raise KeyError(f"unknown adapter {name!r}")
+        tree = self._merged.get(name)
+        if tree is not None:
+            self.hits += 1
+            self._merged.move_to_end(name)
+            return tree
+        self.misses += 1
+        adapters, rank, alpha = spec
+        while len(self._merged) >= self.max_live:
+            self._merged.popitem(last=False)
+            self.evictions += 1
+        tree = _merge_fn(rank, alpha)(self._base, adapters)
+        self._merged[name] = tree
+        return tree

@@ -92,13 +92,19 @@ class SamplingParams:
     compiled step), not per-request. ``deadline_s`` is a wall-clock budget
     from submission: a request still unfinished after that many seconds is
     retired with the EXPIRED terminal state at the next schedule pass and
-    its pages freed (partial output stays pollable)."""
+    its pages freed (partial output stays pollable). ``stop_sequences``
+    generalizes ``stop_token`` to multi-token suffixes: the request
+    finishes when its generated tail matches any sequence (the matching
+    tokens stay in the output, same as a stop token). Detection is
+    host-side at resolve time, so it composes with every engine mode
+    including speculative decoding."""
 
     max_new_tokens: int = 16
     temperature: float = 0.0
     seed: int = 0
     stop_token: Optional[int] = None
     deadline_s: Optional[float] = None
+    stop_sequences: Tuple[Tuple[int, ...], ...] = ()
 
 
 class RequestState(enum.Enum):
@@ -119,6 +125,26 @@ _TERMINAL = (
     RequestState.EXPIRED,
     RequestState.CANCELLED,
 )
+
+
+def _adapter_bound(req: "Request") -> bool:
+    """True when ``req`` decodes under LoRA-merged weights. Its K/V is
+    computed under DIFFERENT params than base-model requests', so it must
+    neither read from nor publish to the token-keyed prefix trie — a
+    token-identical prefix under other weights is not the same cache
+    entry."""
+    mods = req.mods
+    return mods is not None and getattr(mods, "adapter", None) is not None
+
+
+def _stops_on_sequence(req: "Request") -> bool:
+    """True when ``req.generated`` ends with any of its stop sequences."""
+    gen = req.generated
+    for seq in req.params.stop_sequences:
+        n = len(seq)
+        if n and len(gen) >= n and tuple(gen[-n:]) == tuple(seq):
+            return True
+    return False
 
 
 @dataclasses.dataclass
@@ -158,6 +184,19 @@ class Request:
     # through the elastic snapshot/restore codec, so routing/billing context
     # survives an engine migration. Must be JSON-serializable to snapshot.
     metadata: Optional[dict] = None
+    # Typed tenant identity (the front door's fair-share / quota / SLO
+    # key). Promoted out of ``metadata`` so drain/restore and fleet
+    # failover preserve tenancy without convention.
+    tenant_id: str = "anon"
+    # Streaming high-water mark: how many of ``generated`` have been
+    # handed to the client. A drain snapshot records it so a restored
+    # stream resumes exactly here — no replayed or skipped tokens.
+    delivered: int = 0
+    # Live per-request model mods (duck-typed: the engine binds a
+    # ``serving.mods.ModState`` here). The scheduler only calls
+    # ``note_token(token) -> bool`` on committed tokens; True finishes
+    # the request (e.g. a grammar reached a forced end).
+    mods: Optional[object] = None
     # Goodput accounting: prefill positions below this mark re-compute K/V
     # the engine already had (lost to preemption or a snapshot/restore);
     # ``rework_kind`` names the waste bucket they charge to.
@@ -324,7 +363,7 @@ class Scheduler:
         req.len_cached = 0
         req.trie_node = PrefixCache.ROOT
         req.trie_pages = 0
-        if self.prefix_cache is not None:
+        if self.prefix_cache is not None and not _adapter_bound(req):
             assert not req.table.pages, "admitting a request holding pages"
             pages, matched, node = self.prefix_cache.lookup(req.tokens)
             req.table.pages = pages
@@ -392,7 +431,11 @@ class Scheduler:
         ref and the slot. Registered pages idle on the LRU — demoted, not
         freed — so the next request with this prefix hits them; eviction
         happens lazily under OutOfPages pressure."""
-        if self.prefix_cache is not None and req.slot is not None:
+        if (
+            self.prefix_cache is not None
+            and req.slot is not None
+            and not _adapter_bound(req)
+        ):
             self._register_filled(req)
             start = req.trie_pages * self.page_size
             valid = req.len_cached
@@ -660,7 +703,11 @@ class Scheduler:
         prefix trie (dedup: an existing node for the same prefix wins and
         the private page is simply not cached). Pages whose tokens are
         still PENDING readback are skipped until resolved."""
-        if self.prefix_cache is None or req.slot is None:
+        if (
+            self.prefix_cache is None
+            or req.slot is None
+            or _adapter_bound(req)
+        ):
             return
         page = self.page_size
         valid = req.len_cached
@@ -737,9 +784,17 @@ class Scheduler:
             )
         self._register_filled(req)
         stop = req.params.stop_token
+        # Advance per-request mods (grammar state machines) on EVERY
+        # committed token, before the finish check — the state must stay
+        # consistent even when this token does not finish the request.
+        mods_done = (
+            req.mods.note_token(token) if req.mods is not None else False
+        )
         if (
             req.n_generated >= req.params.max_new_tokens
             or (stop is not None and token == stop)
+            or _stops_on_sequence(req)
+            or mods_done
         ):
             # Roll back anything issued speculatively past the finish: the
             # extra KV write is garbage beyond the sequence (masked, and
@@ -791,8 +846,16 @@ class Scheduler:
                 req.first_token_time = (
                     time.perf_counter() if now is None else now
                 )
-            if req.n_generated >= req.params.max_new_tokens or (
-                stop is not None and token == stop
+            mods_done = (
+                req.mods.note_token(token)
+                if req.mods is not None
+                else False
+            )
+            if (
+                req.n_generated >= req.params.max_new_tokens
+                or (stop is not None and token == stop)
+                or _stops_on_sequence(req)
+                or mods_done
             ):
                 finished = True
                 break

@@ -119,6 +119,24 @@ def extract_row(bench: dict) -> dict:
             )
             if key in fleet
         }
+    frontdoor = bench.get("frontdoor")
+    if frontdoor:
+        # Un-gated like the fleet section (open-loop streaming wall time
+        # is too arrival-jitter-noisy for the +/-10% gate) but recorded:
+        # the streaming-overhead and per-tenant SLO-compliance trajectory
+        # is what the row is for.
+        out["frontdoor"] = {
+            key: frontdoor.get(key)
+            for key in (
+                "tokens_per_sec",
+                "polled_tokens_per_sec",
+                "streaming_overhead_x",
+                "streamed_tokens_bitwise_identical_polled",
+                "backpressure_stalls",
+                "tenants",
+            )
+            if key in frontdoor
+        }
     return out
 
 

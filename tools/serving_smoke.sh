@@ -22,14 +22,22 @@
 # armed recompile sentinel reading zero at steady state;
 # traces/statusz_snapshot.json is uploaded as a CI artifact.
 #
-#   bash tools/serving_smoke.sh          # the six default scenarios
-#   bash tools/serving_smoke.sh mesh     # mesh-sharded scenario only
+#   bash tools/serving_smoke.sh            # the six default scenarios
+#   bash tools/serving_smoke.sh mesh       # mesh-sharded scenario only
+#   bash tools/serving_smoke.sh frontdoor  # front-door scenario only
 #
 # The ``mesh`` scenario boots the engine on a (2,4) ("data","model") mesh
 # over 8 virtual CPU devices, replays a shared-prefix workload, and
 # asserts greedy-token parity against a (1,1) mesh AND the unsharded
 # engine, a nonzero prefix hit rate, the mesh gauges, and zero page
 # leaks.
+#
+# The ``frontdoor`` scenario drives the streaming gateway: six streams
+# across two declared tenants, one cancelled mid-stream (partial output
+# a strict prefix of the polled reference, pages freed), one
+# grammar-constrained request replayed through its compiled DFA, every
+# surviving stream bitwise-identical to a polled bare-engine run, and
+# the armed recompile sentinel reading zero across the whole mix.
 #
 # This is the CI end-to-end drill for the serving subsystem: engine +
 # scheduler + paged cache + prefix cache + admission metrics in one pass,
@@ -113,6 +121,123 @@ print(
     f"1x1 == 2x4 over {len(base)} requests, "
     f"hit_rate={s2['prefix_hit_rate']:.2f} "
     f"sharded_programs={int(g2['serving_sharded_program_count'])}"
+)
+EOF
+  exit 0
+fi
+
+if [ "$scenario" = "frontdoor" ]; then
+  env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python - <<'EOF'
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_pytorch_tpu.models.transformer import TransformerLM
+from distributed_pytorch_tpu.serving import (
+    FrontDoor,
+    InferenceEngine,
+    Mods,
+    SamplingParams,
+    TenantConfig,
+    compile_grammar,
+)
+
+VOCAB = 128
+model = TransformerLM(
+    vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+    dtype=jnp.float32,
+)
+params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+ENGINE_KW = dict(
+    max_slots=4, max_seq_len=32, page_size=4, token_budget=16,
+    max_prefill_chunk=8, debug=True,
+)
+rng = np.random.default_rng(7)
+prompts = [
+    rng.integers(0, VOCAB, int(n)).tolist() for n in rng.integers(3, 9, 6)
+]
+sp = SamplingParams(max_new_tokens=6)
+
+# Polled reference: same prompts on a bare engine, no door in the path.
+ref_eng = InferenceEngine(model, params, **ENGINE_KW)
+rids = [ref_eng.submit(p, sp) for p in prompts]
+ref_eng.run()
+ref = [list(ref_eng.requests[r].generated) for r in rids]
+ref_eng.close()
+
+eng = InferenceEngine(model, params, xla_ledger=True, **ENGINE_KW)
+door = FrontDoor(
+    eng,
+    tenants={
+        "gold": TenantConfig(weight=3.0, ttft_slo_s=30.0),
+        "bronze": TenantConfig(weight=1.0),
+    },
+)
+
+GRAMMAR = "[10-40] [10-40]+"
+# Warm both group shapes (clean async + grammar sync) before arming: the
+# sentinel gates steady state, not first-touch compilation.
+warm = [
+    door.open_stream(prompts[0], "gold", params=sp),
+    door.open_stream(prompts[1], "bronze", params=sp,
+                     mods=Mods(grammar=GRAMMAR)),
+]
+door.drive()
+assert warm[0].drain() == ref[0], "warmup stream diverged from reference"
+sentinel = eng.arm_recompile_sentinel()
+
+streams = [
+    door.open_stream(p, "gold" if i % 2 == 0 else "bronze", params=sp)
+    for i, p in enumerate(prompts)
+]
+gstream = door.open_stream(prompts[0], "gold", params=sp,
+                           mods=Mods(grammar=GRAMMAR))
+
+# Cancel one stream mid-flight: deliver two tokens, then kill it.
+victim = streams[3]
+pumps = 0
+while victim.backlog() < 2 and not victim.done:
+    door.pump()
+    pumps += 1
+    assert pumps < 10_000, "victim never produced two tokens"
+partial = [next(victim), next(victim)]
+victim.cancel()
+partial += victim.drain()
+assert partial == ref[3][: len(partial)], (
+    f"cancelled partial {partial} is not a prefix of {ref[3]}"
+)
+assert len(partial) < len(ref[3]), "cancel landed after completion"
+assert door.registry.read_counter("cancelled_by_client_total") == 1
+
+# Survivors drain to completion, bitwise-identical to the polled run.
+for i, s in enumerate(streams):
+    if s is victim:
+        continue
+    assert s.drain() == ref[i], f"stream {i} diverged from polled engine"
+
+# Grammar stream: every token must walk the compiled DFA.
+gtoks = gstream.drain()
+dfa = compile_grammar(GRAMMAR, VOCAB)
+state = dfa.start
+for tok in gtoks:
+    state = dfa.advance(state, tok)  # raises on any violation
+assert len(gtoks) >= 2, f"grammar needs >=2 tokens, got {gtoks}"
+
+door.drive()
+assert sentinel.count == 0, sentinel.trips
+assert eng.registry.read_counter("engine_recompiles_total") == 0
+stats = eng.stats()
+assert stats["pages_allocated"] == 0, "pages leaked after cancel + drain"
+eng.close()
+eng.allocator.check_invariants()
+
+print(
+    "[serving_smoke] PASS: front door, "
+    f"{len(streams) - 1} streams bitwise == polled across 2 tenants, "
+    f"cancel mid-stream after {len(partial)} tokens, "
+    f"grammar {GRAMMAR!r} validated over {len(gtoks)} tokens, "
+    f"recompile sentinel == 0"
 )
 EOF
   exit 0

@@ -26,9 +26,23 @@ disabled:
 * :class:`~.xla.ProgramLedger` / :class:`~.xla.RecompileSentinel` —
   device-truth accounting (compile time, HBM breakdown, FLOPs,
   host<->device transfer bytes, live-buffer watermark) and post-warmup
-  recompile detection.
+  recompile detection;
+* :mod:`~.disttrace` — fleet-wide distributed tracing: one ``trace_id``
+  per request across door/router/replicas, :func:`~.disttrace
+  .merge_traces` clock-aligned assembly, :func:`~.disttrace
+  .request_waterfall` exact-partition latency decomposition, and
+  :class:`~.disttrace.TraceSampler` head+tail sampling.
 """
 
+from distributed_pytorch_tpu.obs.disttrace import (
+    WATERFALL_COMPONENTS,
+    TraceSampler,
+    format_waterfall,
+    merge_traces,
+    prune_trace,
+    request_waterfall,
+    trace_ids,
+)
 from distributed_pytorch_tpu.obs.flight import (
     NULL_FLIGHT_RECORDER,
     FlightRecorder,
@@ -58,7 +72,12 @@ from distributed_pytorch_tpu.obs.slo import (
     SLOMonitor,
     default_serving_objectives,
 )
-from distributed_pytorch_tpu.obs.tracer import NULL_TRACER, NullTracer, Tracer
+from distributed_pytorch_tpu.obs.tracer import (
+    NULL_TRACER,
+    NullTracer,
+    Tracer,
+    flow_id,
+)
 from distributed_pytorch_tpu.obs.xla import ProgramLedger, RecompileSentinel
 
 __all__ = [
@@ -77,13 +96,21 @@ __all__ = [
     "RecompileSentinel",
     "SLObjective",
     "SLOMonitor",
+    "TraceSampler",
     "Tracer",
+    "WATERFALL_COMPONENTS",
     "causal_attention_flops",
     "default_serving_objectives",
+    "flow_id",
+    "format_waterfall",
+    "merge_traces",
     "peak_flops_per_chip",
+    "prune_trace",
     "replay_to_tracer",
+    "request_waterfall",
     "resnet50_train_flops",
     "scrape",
+    "trace_ids",
     "transformer_decode_flops_per_token",
     "transformer_train_flops",
     "validate_exposition",

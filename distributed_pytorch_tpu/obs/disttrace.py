@@ -1,0 +1,481 @@
+"""Fleet-wide distributed tracing: merge, waterfalls, sampling.
+
+The per-component tracers (:mod:`.tracer`) each record on their own
+monotonic clock, in their own process lanes; this module assembles them
+into ONE story per request:
+
+* :func:`merge_traces` — align any number of tracers (live objects or
+  exported/scraped ``/trace`` documents) onto a single timeline using the
+  wall-clock epoch each tracer records at construction, remapping process
+  ids so door / router / replica lanes stack top-down in causal order.
+* :func:`request_waterfall` — walk every span carrying one ``trace_id``
+  through an exact-partition state machine: each interval between
+  consecutive trace events is assigned to exactly one of
+  ``queue_wait / pacing / route / prefill / decode_active /
+  backpressure_stall / preempt_rework / failover_gap``, so the components
+  sum to the end-to-end latency *by construction* (float rounding is the
+  only slack).
+* :class:`TraceSampler` — head sampling by rate plus tail-based "always
+  keep" for requests that failed, failed over, or violated their tenant
+  SLO; bounded memory via a flight-recorder-style kept ring.
+* :func:`prune_trace` — apply a sampler's drop decisions to a live tracer
+  (remove ended, not-kept request/door/router spans and their flow
+  arrows; the engine step timeline is global and always stays).
+
+Identity model: span ids are fleet-unique by construction (engine req_ids
+are strided per replica, router fleet ids and door stream ids live in
+their own categories), so merged async events never collide. The one
+identity that crosses layers is the string ``trace_id``: every ``b`` span
+opened for a request carries it in ``args``, and every layer hashes it to
+the same 48-bit Perfetto flow id (:func:`~.tracer.flow_id`).
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from distributed_pytorch_tpu.obs.tracer import (
+    _PID_DOOR,
+    _PID_ROUTER,
+    flow_id,
+)
+
+# The waterfall partition. Every microsecond of a request's end-to-end
+# latency lands in exactly one bucket.
+WATERFALL_COMPONENTS = (
+    "queue_wait",
+    "pacing",
+    "route",
+    "prefill",
+    "decode_active",
+    "backpressure_stall",
+    "preempt_rework",
+    "failover_gap",
+)
+
+_SPAN_CATS = ("request", "door", "router")
+# pids stay < 10 at the source, so stride-10 remapping keeps every
+# (source, lane) pair distinct in the merged document.
+_PID_STRIDE = 10
+
+
+# ------------------------------------------------------------------- merge
+
+
+def _as_doc(source) -> Dict[str, object]:
+    """Accept a live Tracer or an exported/scraped Perfetto document."""
+    to_perfetto = getattr(source, "to_perfetto", None)
+    if callable(to_perfetto):
+        return to_perfetto()
+    if isinstance(source, dict) and "traceEvents" in source:
+        return source
+    raise TypeError(
+        f"not a tracer or Chrome trace document: {type(source).__name__}"
+    )
+
+
+def _default_label(doc: Dict[str, object], index: int) -> str:
+    events = doc.get("traceEvents", [])
+    pids = {e.get("pid") for e in events if e.get("ph") != "M"}
+    if _PID_DOOR in pids:
+        return "door"
+    if _PID_ROUTER in pids:
+        return "router"
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            name = e.get("args", {}).get("name", "")
+            if name.startswith("engine"):
+                return name if name != "engine" else f"engine{index}"
+    return f"src{index}"
+
+
+def merge_traces(
+    *sources, labels: Optional[Sequence[str]] = None
+) -> Dict[str, object]:
+    """Assemble door / router / replica traces into one Perfetto document.
+
+    ``sources`` are live :class:`~.tracer.Tracer` objects or Chrome trace
+    dicts (including documents scraped from remote ``/trace`` endpoints).
+    Each source's timestamps are shifted by the delta between its
+    ``wall_epoch_s`` metadata and the earliest epoch across all sources —
+    documents predating the epoch field align at offset 0. Process ids
+    are remapped to ``source_index * 10 + pid`` and the process-name
+    metadata is prefixed with a per-source label, so lanes from different
+    replicas remain tellable apart; span/flow *ids* are left untouched
+    (they are fleet-unique / shared on purpose — flow arrows only connect
+    because every layer hashed the same ``trace_id``)."""
+    docs = [_as_doc(s) for s in sources]
+    if not docs:
+        return {"traceEvents": [], "displayTimeUnit": "ms",
+                "metadata": {"wall_epoch_s": 0.0, "sources": []}}
+    epochs = [
+        float(d.get("metadata", {}).get("wall_epoch_s", 0.0)) for d in docs
+    ]
+    base = min(epochs)
+    names: List[str] = []
+    merged: List[dict] = []
+    for i, (doc, epoch) in enumerate(zip(docs, epochs)):
+        label = (
+            labels[i] if labels is not None and i < len(labels)
+            else _default_label(doc, i)
+        )
+        names.append(label)
+        shift_us = (epoch - base) * 1e6
+        events = doc.get("traceEvents", [])
+        used_pids = {e.get("pid") for e in events if e.get("ph") != "M"}
+        for e in events:
+            e = dict(e)
+            pid = e.get("pid")
+            if e.get("ph") == "M":
+                # Keep only metadata for lanes this source actually used,
+                # so empty placeholder lanes don't clutter the merge.
+                if pid not in used_pids:
+                    continue
+                if e.get("name") == "process_name":
+                    args = dict(e.get("args", {}))
+                    args["name"] = f"{label}: {args.get('name', '')}"
+                    e["args"] = args
+            else:
+                e["ts"] = float(e.get("ts", 0.0)) + shift_us
+            if pid is not None:
+                e["pid"] = i * _PID_STRIDE + int(pid)
+            merged.append(e)
+    return {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "metadata": {"wall_epoch_s": base, "sources": names},
+    }
+
+
+# --------------------------------------------------------------- waterfall
+
+
+def trace_ids(doc: Dict[str, object]) -> List[str]:
+    """Every distinct ``trace_id`` opened in ``doc``, in first-seen order."""
+    seen: Dict[str, None] = {}
+    for e in doc.get("traceEvents", []):
+        if e.get("ph") == "b":
+            tid = e.get("args", {}).get("trace_id")
+            if tid is not None:
+                seen.setdefault(str(tid), None)
+    return list(seen)
+
+
+def _stall_windows(events: Iterable[dict]) -> List[Tuple[float, float]]:
+    wins = []
+    for e in events:
+        if e.get("name") == "backpressure_stall" and e.get("ph") == "i":
+            dur_us = float(e.get("args", {}).get("dur_s", 0.0)) * 1e6
+            ts = float(e.get("ts", 0.0))
+            if dur_us > 0:
+                wins.append((ts - dur_us, ts))
+    return wins
+
+
+def request_waterfall(
+    doc: Dict[str, object], trace_id: str
+) -> Dict[str, object]:
+    """Per-request latency waterfall from a (merged) trace document.
+
+    Selects every span whose ``b`` event carries ``trace_id`` — the door
+    stream, the router decision, and each engine incarnation (original
+    replica, hedge twin, failover survivor) — sorts their events onto one
+    timeline, and assigns each inter-event interval to exactly one
+    :data:`WATERFALL_COMPONENTS` bucket:
+
+    * door open → admission is ``queue_wait``, with the token-bucket
+      ``pacing_s`` the admit event reports carved out into ``pacing``;
+    * door admission → engine span open is ``route``;
+    * engine open → slot admission is engine-side ``queue_wait``;
+    * admission → first token is ``prefill``; token → token is
+      ``decode_active``;
+    * a ``preempt`` mark flips the state to ``preempt_rework`` until
+      decoding resumes; a router ``failover`` mark retro-assigns the
+      silent interval since the victim's last sign of life — and
+      everything until the survivor decodes again — to ``failover_gap``;
+    * door-level backpressure windows are subtracted from overlapping
+      ``decode_active`` time into ``backpressure_stall``.
+
+    The buckets sum to ``e2e_s`` (last event minus first event) by
+    construction; callers assert a small tolerance for float rounding.
+    """
+    events = doc.get("traceEvents", [])
+    keys: Set[Tuple[str, int]] = set()
+    for e in events:
+        if (
+            e.get("ph") == "b"
+            and e.get("args", {}).get("trace_id") == trace_id
+        ):
+            keys.add((e.get("cat"), e.get("id")))
+    if not keys:
+        raise KeyError(f"trace_id {trace_id!r} not found in trace")
+    sel = [
+        e
+        for e in events
+        if e.get("ph") in ("b", "n", "e")
+        and e.get("cat") in _SPAN_CATS
+        and (e.get("cat"), e.get("id")) in keys
+    ]
+    sel.sort(key=lambda e: float(e.get("ts", 0.0)))
+    comp: Dict[str, float] = {name: 0.0 for name in WATERFALL_COMPONENTS}
+    decode_windows: List[Tuple[float, float]] = []
+    prev_ts: Optional[float] = None
+    cur = "queue_wait"
+    in_failover = False
+    in_rework = False
+    for e in sel:
+        ts = float(e.get("ts", 0.0))
+        name = e.get("name")
+        cat = e.get("cat")
+        ph = e.get("ph")
+        if prev_ts is not None and ts > prev_ts:
+            # The failover mark reattributes the preceding silence: the
+            # victim produced nothing between its last event and the
+            # router noticing the death.
+            label = (
+                "failover_gap"
+                if (cat == "router" and name == "failover")
+                else cur
+            )
+            span_s = (ts - prev_ts) / 1e6
+            comp[label] += span_s
+            if label == "decode_active":
+                decode_windows.append((prev_ts, ts))
+        prev_ts = ts
+        if cat == "door":
+            if ph == "b":
+                cur = "queue_wait"
+            elif name == "admitted":
+                pacing = float(e.get("args", {}).get("pacing_s", 0.0))
+                take = min(max(pacing, 0.0), comp["queue_wait"])
+                comp["queue_wait"] -= take
+                comp["pacing"] += take
+                cur = "route"
+        elif cat == "router":
+            if ph == "b":
+                cur = "route"
+            elif name == "failover":
+                cur = "failover_gap"
+                in_failover = True
+                in_rework = False
+        elif cat == "request":
+            if ph == "b":
+                if in_failover:
+                    pass  # survivor re-admission: still the failover gap
+                elif cur == "decode_active":
+                    pass  # hedge twin opened while the primary decodes
+                else:
+                    cur = "queue_wait"
+            elif name == "admit":
+                if in_failover:
+                    cur = "failover_gap"
+                elif in_rework:
+                    cur = "preempt_rework"
+                elif cur == "decode_active":
+                    pass  # hedge twin admission under a decoding primary
+                else:
+                    cur = "prefill"
+            elif name in ("decode_token", "verify_round"):
+                cur = "decode_active"
+                in_failover = False
+                in_rework = False
+            elif name == "preempt":
+                cur = "preempt_rework"
+                in_rework = True
+    # Door-level backpressure windows: time the pump refused to step the
+    # backend because a consumer lagged. Re-bucket the overlap out of
+    # decode_active — a stalled engine is not decoding this request.
+    for w0, w1 in _stall_windows(events):
+        moved = 0.0
+        for d0, d1 in decode_windows:
+            moved += max(0.0, min(w1, d1) - max(w0, d0))
+        moved_s = min(moved / 1e6, comp["decode_active"])
+        comp["decode_active"] -= moved_s
+        comp["backpressure_stall"] += moved_s
+    first_ts = float(sel[0].get("ts", 0.0))
+    last_ts = float(sel[-1].get("ts", 0.0))
+    return {
+        "trace_id": trace_id,
+        "e2e_s": (last_ts - first_ts) / 1e6,
+        "components": comp,
+        "n_events": len(sel),
+        "spans": sorted(
+            {(cat, sid) for cat, sid in keys},
+            key=lambda k: (k[0], k[1]),
+        ),
+    }
+
+
+def format_waterfall(wf: Dict[str, object]) -> str:
+    """Render one waterfall as an aligned text table (CLI / smoke output)."""
+    e2e = float(wf["e2e_s"]) or 1.0
+    lines = [f"trace {wf['trace_id']}  e2e {float(wf['e2e_s']) * 1e3:.2f} ms"]
+    for name in WATERFALL_COMPONENTS:
+        val = float(wf["components"].get(name, 0.0))
+        frac = val / e2e
+        bar = "#" * int(round(frac * 40))
+        lines.append(
+            f"  {name:<18} {val * 1e3:9.2f} ms  {frac * 100:5.1f}%  {bar}"
+        )
+    total = sum(float(v) for v in wf["components"].values())
+    lines.append(f"  {'sum':<18} {total * 1e3:9.2f} ms")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------- sampling
+
+
+class TraceSampler:
+    """Head + tail sampling policy over fleet trace ids.
+
+    Head decision: a deterministic hash of ``(seed, trace_id)`` against
+    ``head_rate`` — every layer would reach the same verdict
+    independently, though in practice only the door consults it. Tail
+    decision at request end: always keep requests that failed (rejected /
+    cancelled / expired), failed over between replicas, or violated their
+    tenant's SLO, regardless of the head draw.
+
+    Memory is bounded flight-recorder-style: at most ``max_kept`` kept
+    trace ids are remembered; keeping one more evicts (and schedules the
+    pruning of) the oldest. Ended traces that are not kept go onto a
+    pending-drop set the owner drains with :meth:`drain_drops` and applies
+    via :func:`prune_trace`."""
+
+    def __init__(
+        self,
+        head_rate: float = 1.0,
+        *,
+        keep_failed: bool = True,
+        keep_failed_over: bool = True,
+        keep_slo_violations: bool = True,
+        max_kept: int = 256,
+        seed: int = 0,
+    ):
+        if not 0.0 <= head_rate <= 1.0:
+            raise ValueError(f"head_rate must be in [0, 1], got {head_rate}")
+        if max_kept < 1:
+            raise ValueError(f"max_kept must be >= 1, got {max_kept}")
+        self.head_rate = float(head_rate)
+        self.keep_failed = keep_failed
+        self.keep_failed_over = keep_failed_over
+        self.keep_slo_violations = keep_slo_violations
+        self.max_kept = int(max_kept)
+        self.seed = int(seed)
+        self._kept_ring: "collections.deque[str]" = collections.deque()
+        self._kept: Set[str] = set()
+        self._pending_drop: Set[str] = set()
+        self.ended = 0
+        self.kept_head = 0
+        self.kept_tail = 0
+        self.dropped = 0
+        self.evicted = 0
+
+    def head_keep(self, trace_id: str) -> bool:
+        """Deterministic per-trace head draw (same verdict in any process)."""
+        digest = hashlib.sha1(
+            f"{self.seed}:{trace_id}".encode("utf-8")
+        ).digest()
+        draw = int.from_bytes(digest[:8], "big") / float(2 ** 64)
+        return draw < self.head_rate
+
+    def note_end(
+        self,
+        trace_id: str,
+        *,
+        failed: bool = False,
+        failed_over: bool = False,
+        slo_violated: bool = False,
+    ) -> bool:
+        """Record one finished request; returns True iff its trace is kept."""
+        self.ended += 1
+        tail = (
+            (failed and self.keep_failed)
+            or (failed_over and self.keep_failed_over)
+            or (slo_violated and self.keep_slo_violations)
+        )
+        head = self.head_keep(trace_id)
+        if tail or head:
+            if tail and not head:
+                self.kept_tail += 1
+            else:
+                self.kept_head += 1
+            self._kept.add(trace_id)
+            self._kept_ring.append(trace_id)
+            if len(self._kept_ring) > self.max_kept:
+                oldest = self._kept_ring.popleft()
+                self._kept.discard(oldest)
+                self._pending_drop.add(oldest)
+                self.evicted += 1
+            return True
+        self.dropped += 1
+        self._pending_drop.add(trace_id)
+        return False
+
+    def kept_ids(self) -> List[str]:
+        return list(self._kept_ring)
+
+    def drain_drops(self) -> Set[str]:
+        """Hand the pending drop set to the owner (clears it)."""
+        drops, self._pending_drop = self._pending_drop, set()
+        return drops
+
+    def counters(self) -> Dict[str, int]:
+        return {
+            "traces_ended": self.ended,
+            "traces_kept_head": self.kept_head,
+            "traces_kept_tail": self.kept_tail,
+            "traces_dropped": self.dropped,
+            "traces_evicted": self.evicted,
+        }
+
+
+def prune_trace(tracer, drop_ids: Iterable[str]) -> int:
+    """Remove every span (door/router/request) and flow arrow belonging to
+    a dropped ``trace_id`` from a live tracer, in place. Engine step
+    slices, phase slices, and counter tracks are global context and always
+    survive. Returns the number of events removed. Callers hold the
+    tracer's owning lock (the registry lock) around this."""
+    drop = {str(t) for t in drop_ids}
+    if not drop:
+        return 0
+    keys: Set[Tuple[str, int]] = set()
+    for e in tracer.events:
+        if (
+            e.get("ph") == "b"
+            and e.get("args", {}).get("trace_id") in drop
+        ):
+            keys.add((e.get("cat"), e.get("id")))
+    flow_drop = {flow_id(t) for t in drop}
+    kept_events: List[dict] = []
+    removed = 0
+    opened = closed = 0
+    for e in tracer.events:
+        cat = e.get("cat")
+        if cat in _SPAN_CATS and (cat, e.get("id")) in keys:
+            removed += 1
+            if e.get("ph") == "b":
+                opened += 1
+            elif e.get("ph") == "e":
+                closed += 1
+            continue
+        if cat == "flow" and e.get("id") in flow_drop:
+            removed += 1
+            continue
+        kept_events.append(e)
+    tracer.events = kept_events
+    tracer.spans_opened -= opened
+    tracer.spans_closed -= closed
+    return removed
+
+
+__all__ = [
+    "WATERFALL_COMPONENTS",
+    "TraceSampler",
+    "format_waterfall",
+    "merge_traces",
+    "prune_trace",
+    "request_waterfall",
+    "trace_ids",
+]

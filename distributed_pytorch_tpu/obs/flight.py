@@ -69,6 +69,7 @@ class FlightRecorder:
         *,
         path: Optional[str] = None,
         clock: Callable[[], float] = time.perf_counter,
+        wall_clock: Callable[[], float] = time.time,
     ):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
@@ -76,6 +77,11 @@ class FlightRecorder:
         self.path = path
         self._clock = clock
         self._epoch = clock()
+        # Wall-clock anchor for event ``t`` zero, mirroring
+        # ``Tracer.wall_epoch_s``: a replayed postmortem inherits it so
+        # `merge_traces` can time-align the dead replica's last moments
+        # with the door / router / survivor traces.
+        self.wall_epoch_s: float = wall_clock()
         self._ring: "collections.deque[dict]" = collections.deque(
             maxlen=self.capacity
         )
@@ -110,6 +116,7 @@ class FlightRecorder:
             "version": DUMP_VERSION,
             "reason": reason,
             "dumped_at_s": self._clock() - self._epoch,
+            "wall_epoch_s": self.wall_epoch_s,
             "recorded": self.recorded,
             "dropped": self.dropped,
             "capacity": self.capacity,
@@ -153,6 +160,11 @@ def replay_to_tracer(dump: Union[dict, str], tracer=None):
         raise ValueError("not a flight-recorder dump: missing 'events'")
     if tracer is None:
         tracer = Tracer()
+    # Inherit the recorder's wall-clock anchor (old dumps predate the
+    # field): the replayed trace then merges time-aligned with the rest
+    # of the fleet, and trace_id-stamped events land where they happened.
+    if "wall_epoch_s" in dump:
+        tracer.wall_epoch_s = float(dump["wall_epoch_s"])
     for event in dump["events"]:
         kind = event.get("kind", "event")
         t_us = float(event.get("t", 0.0)) * 1e6

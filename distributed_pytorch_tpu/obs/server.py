@@ -20,6 +20,9 @@ endpoint        body
                 aggregates across a fleet
 ``/trace``      the Perfetto trace dump, rendered on demand (404 untraced)
 ``/postmortem`` a fresh flight-recorder dump (404 without a recorder)
+``/requestz``   distributed-trace index; ``?id=<trace_id>`` returns that
+                request's latency waterfall computed over the merged
+                door/router/replica trace (404 untraced or unknown id)
 ==============  ============================================================
 
 Thread safety: every handler goes through the engine's registry lock —
@@ -41,9 +44,16 @@ import json
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Union
+
+from distributed_pytorch_tpu.obs.disttrace import (
+    merge_traces,
+    request_waterfall,
+    trace_ids,
+)
 
 _JSON = "application/json"
 _PROM = "text/plain; version=0.0.4; charset=utf-8"
@@ -111,7 +121,8 @@ class IntrospectionServer:
     # ------------------------------------------------------------ handlers
 
     def _route(self, handler: BaseHTTPRequestHandler) -> None:
-        path = handler.path.split("?", 1)[0].rstrip("/") or "/"
+        raw_path, _, query = handler.path.partition("?")
+        path = raw_path.rstrip("/") or "/"
         eng = self.engine
         if path == "/metrics":
             self._send(handler, 200, eng.registry.prometheus_text(), _PROM)
@@ -135,6 +146,8 @@ class IntrospectionServer:
                 with eng.registry.lock:
                     doc = tracer.to_perfetto()
                 self._send_json(handler, 200, doc)
+        elif path == "/requestz":
+            self._requestz(handler, query)
         elif path == "/postmortem":
             flight = getattr(eng, "flight", None)
             if flight is None or not getattr(flight, "enabled", False):
@@ -151,12 +164,49 @@ class IntrospectionServer:
                 {
                     "endpoints": [
                         "/metrics", "/healthz", "/statusz", "/snapshot",
-                        "/trace", "/postmortem",
+                        "/trace", "/postmortem", "/requestz",
                     ]
                 },
             )
         else:
             self._send_json(handler, 404, {"error": f"unknown path {path}"})
+
+    def _requestz(self, handler: BaseHTTPRequestHandler, query: str) -> None:
+        """Distributed-trace view. Without ``?id=``, lists every trace_id
+        visible across the attached component's trace documents; with one,
+        merges door / router / replica lanes onto one timeline and returns
+        the request's exact-partition latency waterfall."""
+        eng = self.engine
+        docs_fn = getattr(eng, "trace_documents", None)
+        if callable(docs_fn):
+            docs = docs_fn()
+        else:
+            tracer = getattr(eng, "tracer", None)
+            if tracer is None or not getattr(tracer, "enabled", False):
+                docs = []
+            else:
+                with eng.registry.lock:
+                    docs = [tracer.to_perfetto()]
+        if not docs:
+            self._send_json(
+                handler, 404, {"error": "component has no tracer"}
+            )
+            return
+        merged = merge_traces(*docs)
+        wanted = urllib.parse.parse_qs(query).get("id", [None])[0]
+        if wanted is None:
+            self._send_json(
+                handler, 200, {"trace_ids": trace_ids(merged)}
+            )
+            return
+        try:
+            waterfall = request_waterfall(merged, wanted)
+        except KeyError:
+            self._send_json(
+                handler, 404, {"error": f"unknown trace_id {wanted!r}"}
+            )
+            return
+        self._send_json(handler, 200, waterfall)
 
     def _health(self) -> str:
         eng = self.engine

@@ -33,15 +33,35 @@ bitwise-identical either way (pinned by tests).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import time
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional
 
 # Perfetto process lanes: engine steps/phases under pid 1, request spans
-# under pid 2 — two top-level tracks that scroll together.
+# under pid 2 — two top-level tracks that scroll together. The serving
+# layers above the engine get lanes of their own: front-door streams under
+# pid 3, router decisions under pid 4, so a merged fleet trace reads
+# top-down in causal order (door → router → engine).
 _PID_ENGINE = 1
 _PID_REQUESTS = 2
+_PID_DOOR = 3
+_PID_ROUTER = 4
+
+# Span category per lane — async events are matched by (cat, id), so the
+# door's stream #7 and the engine's request #7 never collide.
+_SPAN_CAT = {_PID_REQUESTS: "request", _PID_DOOR: "door", _PID_ROUTER: "router"}
+
+
+def flow_id(trace_id: str) -> int:
+    """Stable integer id for Perfetto flow arrows carrying one fleet-wide
+    ``trace_id``. Flow events (``ph: s/t/f``) are matched by
+    (name, cat, id); hashing the string identically in every process lets
+    door, router, and replicas emit linked arrows without coordination.
+    48 bits keeps the id an exact JSON double."""
+    digest = hashlib.sha1(trace_id.encode("utf-8")).digest()
+    return int.from_bytes(digest[:6], "big")
 
 
 class _NullContext:
@@ -87,6 +107,18 @@ class NullTracer:
         pass
 
     def set_engine_label(self, label: str) -> None:
+        pass
+
+    def span_begin(self, pid: int, sid: int, name: str, **attrs) -> None:
+        pass
+
+    def span_event(self, pid: int, sid: int, name: str, **attrs) -> None:
+        pass
+
+    def span_end(self, pid: int, sid: int, name: str, **attrs) -> None:
+        pass
+
+    def flow(self, phase: str, trace_id: str, pid: int, tid: int = 0) -> None:
         pass
 
 
@@ -138,9 +170,20 @@ class Tracer:
 
     enabled = True
 
-    def __init__(self, clock: Callable[[], float] = time.perf_counter):
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.perf_counter,
+        wall_clock: Callable[[], float] = time.time,
+    ):
         self._clock = clock
         self._epoch = clock()
+        # Wall-clock anchor for the monotonic epoch: ``ts`` microseconds
+        # are relative to construction, so two independently-created
+        # tracers (door, router, each replica) can only be merged onto one
+        # timeline if each records WHEN its zero was. Exported in
+        # :meth:`to_perfetto` metadata; `merge_traces` shifts by the epoch
+        # deltas. Old saved traces without the field align at 0.0.
+        self.wall_epoch_s: float = wall_clock()
         self.events: List[dict] = []
         self.step_index = -1
         self._step_t0 = 0.0
@@ -241,8 +284,9 @@ class Tracer:
             }
         )
 
-    def instant(self, name: str, **attrs) -> None:
-        """Global instant event (page evictions, chaos marks)."""
+    def instant(self, name: str, pid: int = _PID_ENGINE, **attrs) -> None:
+        """Global instant event (page evictions, chaos marks, door
+        backpressure windows — ``pid`` picks the lane)."""
         self.events.append(
             {
                 "name": name,
@@ -250,11 +294,86 @@ class Tracer:
                 "ph": "i",
                 "s": "g",
                 "ts": self._now_us(),
-                "pid": _PID_ENGINE,
+                "pid": pid,
                 "tid": 0,
                 "args": attrs,
             }
         )
+
+    # ------------------------------------------- door / router span lanes
+
+    def span_begin(self, pid: int, sid: int, name: str, **attrs) -> None:
+        """Open an async span on a serving-layer lane (``_PID_DOOR`` /
+        ``_PID_ROUTER``). ``sid`` keys the span within its lane's category
+        — door stream sequence numbers, router fleet ids — so it can never
+        collide with engine req_ids (different ``cat``)."""
+        self.spans_opened += 1
+        self.events.append(
+            {
+                "name": name,
+                "cat": _SPAN_CAT.get(pid, "request"),
+                "ph": "b",
+                "id": int(sid),
+                "ts": self._now_us(),
+                "pid": pid,
+                "tid": 0,
+                "args": attrs,
+            }
+        )
+
+    def span_event(self, pid: int, sid: int, name: str, **attrs) -> None:
+        self.events.append(
+            {
+                "name": name,
+                "cat": _SPAN_CAT.get(pid, "request"),
+                "ph": "n",
+                "id": int(sid),
+                "ts": self._now_us(),
+                "pid": pid,
+                "tid": 0,
+                "args": attrs,
+            }
+        )
+
+    def span_end(self, pid: int, sid: int, name: str, **attrs) -> None:
+        self.spans_closed += 1
+        self.events.append(
+            {
+                "name": name,
+                "cat": _SPAN_CAT.get(pid, "request"),
+                "ph": "e",
+                "id": int(sid),
+                "ts": self._now_us(),
+                "pid": pid,
+                "tid": 0,
+                "args": attrs,
+            }
+        )
+
+    def flow(self, phase: str, trace_id: str, pid: int, tid: int = 0) -> None:
+        """One hop of the fleet-wide flow arrow for ``trace_id``.
+
+        ``phase`` is ``"s"`` where the id is MINTED (door admission, or a
+        bare router submit), ``"t"`` at every downstream hop (router route,
+        engine admission, failover re-admission on the survivor), ``"f"``
+        to terminate. All emitters hash the same string to the same 48-bit
+        flow id, so the merged trace draws door → router → replica arrows
+        without any cross-process coordination."""
+        event = {
+            "name": "trace",
+            "cat": "flow",
+            "ph": phase,
+            "id": flow_id(trace_id),
+            "ts": self._now_us(),
+            "pid": pid,
+            "tid": tid,
+            "args": {"trace_id": trace_id},
+        }
+        if phase == "t":
+            # Bind incoming arrows at the enclosing slice's start so the
+            # arrowhead lands on the span, not after it.
+            event["bp"] = "e"
+        self.events.append(event)
 
     # -------------------------------------------------------------- export
 
@@ -293,9 +412,25 @@ class Tracer:
                 "args": {"name": "requests"},
             },
         ]
+        # Serving-layer lanes are labeled only when populated, so an
+        # engine-only trace keeps its historical two-process shape.
+        used_pids = {e.get("pid") for e in self.events}
+        for pid, label in ((_PID_DOOR, "front door"), (_PID_ROUTER, "router")):
+            if pid in used_pids:
+                meta.append(
+                    {
+                        "name": "process_name",
+                        "ph": "M",
+                        "pid": pid,
+                        "args": {"name": label},
+                    }
+                )
         return {
             "traceEvents": meta + self.events,
             "displayTimeUnit": "ms",
+            # Clock anchor for multi-tracer assembly (see `merge_traces`):
+            # seconds-since-Unix-epoch at which this tracer's ts=0 was.
+            "metadata": {"wall_epoch_s": self.wall_epoch_s},
         }
 
     def save(self, path: str) -> str:

@@ -24,6 +24,7 @@ The engine's front door. Two jobs:
 
 from __future__ import annotations
 
+import collections
 import time
 from typing import Dict, Optional
 
@@ -76,6 +77,13 @@ class AdmissionController:
         # actually getting through the gate.
         self.accepted_by_tenant: Dict[str, int] = {}
         self.draining = False
+        # Last few rejections, keyed by the fleet-wide trace_id when the
+        # caller supplied one: a request that never got past this gate has
+        # no spans anywhere, so this ring is the only place ``/requestz``
+        # can point at to explain a missing trace.
+        self.recent_rejections: "collections.deque[dict]" = (
+            collections.deque(maxlen=32)
+        )
 
     def close(self) -> None:
         """Stop admitting — first act of the drain protocol (and of engine
@@ -94,6 +102,7 @@ class AdmissionController:
         cached_tokens: int = 0,
         queued_uncached_tokens: int = 0,
         tenant_id: str = "anon",
+        trace_id: Optional[str] = None,
     ) -> None:
         """Raise an :class:`AdmissionError` subclass iff the request must be
         rejected; otherwise count it accepted. ``cached_tokens`` is the
@@ -101,40 +110,59 @@ class AdmissionController:
         ``queued_uncached_tokens`` the uncached prefill work already
         waiting — both feed the optional queue-token budget.
         ``tenant_id`` keys the per-tenant accepted counter (fair-share
-        policy itself lives a layer up, in the front door)."""
+        policy itself lives a layer up, in the front door); ``trace_id``
+        stamps rejections into :attr:`recent_rejections` so a trace that
+        never produced a span is still explainable."""
         if self.draining:
             self.rejected_draining += 1
-            raise EngineDraining(
-                "engine is draining; no new requests accepted"
+            raise self._reject(
+                EngineDraining(
+                    "engine is draining; no new requests accepted"
+                ),
+                "draining", tenant_id, trace_id,
             )
         if prompt_len < 1:
             self.rejected_too_long += 1
-            raise RequestTooLong(
-                "empty prompt: generation is conditioned on at least one "
-                "token (offline generate() has the same contract — a "
-                "zero-length row's position 0 is never decided)"
+            raise self._reject(
+                RequestTooLong(
+                    "empty prompt: generation is conditioned on at least "
+                    "one token (offline generate() has the same contract "
+                    "— a zero-length row's position 0 is never decided)"
+                ),
+                "too_long", tenant_id, trace_id,
             )
         total = prompt_len + params.max_new_tokens
         if total > self.max_request_tokens:
             self.rejected_too_long += 1
-            raise RequestTooLong(
-                f"prompt ({prompt_len}) + max_new_tokens "
-                f"({params.max_new_tokens}) = {total} exceeds the "
-                f"per-sequence cache capacity {self.max_request_tokens}"
+            raise self._reject(
+                RequestTooLong(
+                    f"prompt ({prompt_len}) + max_new_tokens "
+                    f"({params.max_new_tokens}) = {total} exceeds the "
+                    f"per-sequence cache capacity {self.max_request_tokens}"
+                ),
+                "too_long", tenant_id, trace_id,
             )
         if queue_len >= self.max_queue:
             self.rejected_queue_full += 1
-            raise QueueFull(
-                f"waiting queue at capacity ({self.max_queue}); retry later"
+            raise self._reject(
+                QueueFull(
+                    f"waiting queue at capacity ({self.max_queue}); "
+                    "retry later"
+                ),
+                "queue_full", tenant_id, trace_id,
             )
         if self.max_queue_tokens is not None:
             incoming = max(0, prompt_len - 1 - cached_tokens)
             if queued_uncached_tokens + incoming > self.max_queue_tokens:
                 self.rejected_queue_full += 1
-                raise QueueFull(
-                    f"queued uncached prefill work "
-                    f"({queued_uncached_tokens} + {incoming} tokens) exceeds "
-                    f"budget {self.max_queue_tokens}; retry later"
+                raise self._reject(
+                    QueueFull(
+                        f"queued uncached prefill work "
+                        f"({queued_uncached_tokens} + {incoming} tokens) "
+                        f"exceeds budget {self.max_queue_tokens}; retry "
+                        "later"
+                    ),
+                    "queue_full", tenant_id, trace_id,
                 )
         self.accepted += 1
         self.cached_tokens_admitted += cached_tokens
@@ -142,12 +170,31 @@ class AdmissionController:
             self.accepted_by_tenant.get(tenant_id, 0) + 1
         )
 
+    def _reject(
+        self,
+        exc: AdmissionError,
+        reason: str,
+        tenant_id: str,
+        trace_id: Optional[str],
+    ) -> AdmissionError:
+        self.recent_rejections.append(
+            {
+                "reason": reason,
+                "tenant_id": tenant_id,
+                "trace_id": trace_id,
+                "detail": str(exc),
+            }
+        )
+        return exc
+
     def status(self) -> Dict[str, object]:
         """The ``/statusz`` admission block: every rejection counter plus
         the live draining flag (``/healthz`` derives its verdict from the
-        same flag)."""
+        same flag) and the recent-rejection ring (trace_id-stamped, so a
+        trace that died at the gate is still accounted for)."""
         out: Dict[str, object] = dict(self.counters())
         out["draining"] = self.draining
+        out["recent_rejections"] = list(self.recent_rejections)
         return out
 
     def counters(self) -> Dict[str, int]:

@@ -49,6 +49,7 @@ from typing import Dict, List, Optional, Tuple
 import jax
 
 from distributed_pytorch_tpu import chaos
+from distributed_pytorch_tpu.obs.tracer import _PID_REQUESTS
 from distributed_pytorch_tpu.serving.mods import Mods, ModState
 from distributed_pytorch_tpu.serving.scheduler import (
     Request,
@@ -99,6 +100,10 @@ class RequestSnapshot:
     delivered: int = 0
     stop_sequences: Tuple[Tuple[int, ...], ...] = ()
     mods: Optional[dict] = None
+    # Fleet-wide trace identity: survives drain hand-off and failover
+    # id-rebasing (req_ids are engine-local; this string is not).
+    # Defaulted so snapshots written before distributed tracing decode.
+    trace_id: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -240,6 +245,7 @@ def snapshot_engine(engine) -> EngineSnapshot:
                     req.mods.mods.to_spec() if req.mods is not None
                     else None
                 ),
+                trace_id=req.trace_id,
             )
         )
     return EngineSnapshot(
@@ -379,6 +385,7 @@ def restore_engine(
                 tenant_id=rec.tenant_id,
                 delivered=rec.delivered,
                 mods=mod_state,
+                trace_id=rec.trace_id,
             )
             if rec.ttft_s is not None:
                 req.first_token_time = req.submit_time + rec.ttft_s
@@ -391,13 +398,23 @@ def restore_engine(
             engine._keys[req_id] = jax.random.PRNGKey(params.seed)
             engine.scheduler.add(req)
             if tr.enabled:
+                extra = (
+                    {"trace_id": rec.trace_id}
+                    if rec.trace_id is not None else {}
+                )
                 tr.request_begin(
                     req_id,
                     prompt_len=len(rec.prompt),
                     max_new_tokens=rec.max_new_tokens,
                     restored=True,
                     recovered_tokens=len(rec.generated),
+                    **extra,
                 )
+                if rec.trace_id is not None:
+                    # The survivor picks up the fleet flow arrow: the
+                    # restored span joins the victim's trace_id even
+                    # though its req_id was rebased.
+                    tr.flow("t", rec.trace_id, _PID_REQUESTS)
             restored.append(req_id)
     if not rebase_ids:
         # Preserving mode keeps the id space: the target must not mint an

@@ -98,7 +98,7 @@ from distributed_pytorch_tpu.obs.goodput import (
     transformer_decode_flops_per_token,
 )
 from distributed_pytorch_tpu.obs.slo import SLOMonitor, SLObjective
-from distributed_pytorch_tpu.obs.tracer import NULL_TRACER
+from distributed_pytorch_tpu.obs.tracer import NULL_TRACER, _PID_REQUESTS
 from distributed_pytorch_tpu.obs.xla import ProgramLedger, RecompileSentinel
 from distributed_pytorch_tpu.serving.admission import (
     AdmissionController,
@@ -939,6 +939,7 @@ class InferenceEngine:
         *,
         tenant_id: str = "anon",
         mods: Optional[Mods] = None,
+        trace_id: Optional[str] = None,
     ) -> int:
         """Queue one request; returns its id. Raises
         :class:`~.admission.QueueFull` (backpressure),
@@ -954,14 +955,17 @@ class InferenceEngine:
         optional :class:`~.mods.Mods` spec (logit bias / grammar /
         adapter); device mods are refused on speculative engines (the
         fused verify program has no bias operand) and adapter mods on
-        meshed engines (merged trees are placed unsharded)."""
+        meshed engines (merged trees are placed unsharded). ``trace_id``
+        is the fleet-wide trace identity a layer above minted (front door
+        / router) — stamped into the request span and flight events so
+        the engine's slice of work joins the merged fleet trace."""
         if self._server is None:
             return self._submit_impl(
-                prompt, params, metadata, tenant_id, mods
+                prompt, params, metadata, tenant_id, mods, trace_id
             )
         with self.registry.lock:
             return self._submit_impl(
-                prompt, params, metadata, tenant_id, mods
+                prompt, params, metadata, tenant_id, mods, trace_id
             )
 
     def _submit_impl(
@@ -971,6 +975,7 @@ class InferenceEngine:
         metadata: Optional[dict],
         tenant_id: str = "anon",
         mods: Optional[Mods] = None,
+        trace_id: Optional[str] = None,
     ) -> int:
         params = params or SamplingParams()
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
@@ -1002,6 +1007,7 @@ class InferenceEngine:
                 r.est_uncached for r in self.scheduler.waiting
             ),
             tenant_id=tenant_id,
+            trace_id=trace_id,
         )
         req = Request(
             req_id=self._next_id,
@@ -1012,17 +1018,23 @@ class InferenceEngine:
             metadata=metadata,
             tenant_id=tenant_id,
             mods=mod_state,
+            trace_id=trace_id,
         )
         self._next_id += 1
         self.requests[req.req_id] = req
         self._keys[req.req_id] = jax.random.PRNGKey(params.seed)
         if self.tracer.enabled:
+            extra = {"trace_id": trace_id} if trace_id is not None else {}
             self.tracer.request_begin(
                 req.req_id,
                 prompt_len=len(prompt),
                 max_new_tokens=params.max_new_tokens,
                 cached_tokens_at_submit=cached,
+                **extra,
             )
+            if trace_id is not None:
+                # Receive the fleet flow arrow on the engine's request lane.
+                self.tracer.flow("t", trace_id, _PID_REQUESTS)
         self.scheduler.add(req)
         return req.req_id
 
@@ -1575,6 +1587,17 @@ class InferenceEngine:
         if self.admission.draining:
             return "draining"
         return "live"
+
+    def trace_documents(self) -> List[dict]:
+        """Every Perfetto trace document this component can vouch for —
+        for a bare engine, its own tracer's. The ``/requestz`` handler
+        merges these (via ``obs.disttrace.merge_traces``) to build
+        per-request waterfalls; the front door overrides the same hook to
+        add its own and its backend's lanes. Empty when untraced."""
+        if not self.tracer.enabled:
+            return []
+        with self.registry.lock:
+            return [self.tracer.to_perfetto()]
 
     def status(self) -> dict:
         """The ``/statusz`` document: one JSON-serializable dict of engine

@@ -83,6 +83,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from distributed_pytorch_tpu import chaos
 from distributed_pytorch_tpu.metrics import ReservoirHistogram
 from distributed_pytorch_tpu.obs.registry import MetricsRegistry
+from distributed_pytorch_tpu.obs.tracer import NULL_TRACER, _PID_ROUTER
 from distributed_pytorch_tpu.serving.admission import (
     AdmissionError,
     EngineDraining,
@@ -199,6 +200,10 @@ class ShadowRequest:
     tenant_id: str = "anon"
     mods: Optional["Mods"] = None
     cancelled: bool = False
+    # Fleet-wide trace identity: one string across the original replica,
+    # hedge twins, and every failover re-admission. Minted by the front
+    # door when present, else by the router at submit.
+    trace_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -246,8 +251,13 @@ class FleetRouter:
         autoscale_every: int = 8,
         id_stride: int = ID_STRIDE,
         clock: Callable[[], float] = time.perf_counter,
+        tracer=None,
     ):
         self.engine_factory = engine_factory
+        # Router-level span lane (Perfetto pid 4): routing decisions,
+        # hedge twin links, failover marks. NULL by default — the hot
+        # path costs one attribute load when untraced.
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         self.affinity_pages = int(affinity_pages)
         self.spill_queue_depth = spill_queue_depth
         self.probe_every = max(1, int(probe_every))
@@ -437,6 +447,7 @@ class FleetRouter:
         *,
         tenant_id: str = "anon",
         mods=None,
+        trace_id: Optional[str] = None,
     ) -> int:
         """Route one request; returns its FLEET id (stable across
         failover and hedging — engine-level ids are an implementation
@@ -445,9 +456,16 @@ class FleetRouter:
         retries :class:`~.admission.QueueFull` with backoff and
         :class:`~.admission.EngineDraining` immediately-elsewhere, up to
         ``max_retries`` extra attempts; then re-raises the last error
-        (or :class:`NoLiveReplica`)."""
+        (or :class:`NoLiveReplica`). ``trace_id`` is the fleet trace
+        identity — passed through from the front door, or minted here
+        (``r%06x`` from the fleet-id counter) for bare router traffic —
+        and propagated to the owning engine, any hedge twin, and every
+        failover re-admission."""
         params = params or SamplingParams()
         prompt = [int(t) for t in prompt]
+        minted_here = trace_id is None
+        if minted_here:
+            trace_id = f"r{self._next_fid:06x}"
         key = prefix_affinity_key(
             prompt, self.page_size, self.affinity_pages
         )
@@ -463,7 +481,7 @@ class FleetRouter:
             try:
                 req_id = replica.engine.submit(
                     prompt, params, metadata,
-                    tenant_id=tenant_id, mods=mods,
+                    tenant_id=tenant_id, mods=mods, trace_id=trace_id,
                 )
             except EngineDraining as exc:
                 # "Retry ELSEWHERE, now": the draining flag beat our last
@@ -494,16 +512,35 @@ class FleetRouter:
                 req_id=req_id,
                 tenant_id=tenant_id,
                 mods=mods,
+                trace_id=trace_id,
             )
             self._shadows[fid] = shadow
             self._by_owner[(replica.name, req_id)] = fid
             self._c["submitted_total"].inc()
-            if pos == 0 and routed_by == "affinity":
+            routed_via = (
+                "affinity" if pos == 0 and routed_by == "affinity"
+                else routed_by if routed_by == "spill"
+                else "least_loaded"
+            )
+            if routed_via == "affinity":
                 self._c["routed_affinity_total"].inc()
-            elif routed_by == "spill":
+            elif routed_via == "spill":
                 self._c["routed_spill_total"].inc()
             else:
                 self._c["routed_least_loaded_total"].inc()
+            if self.tracer.enabled:
+                self.tracer.span_begin(
+                    _PID_ROUTER, fid, "route",
+                    trace_id=trace_id,
+                    replica=replica.name,
+                    routed_by=routed_via,
+                    tenant=tenant_id,
+                )
+                # The flow arrow: minted here starts it; handed down from
+                # the door, this is the first downstream hop.
+                self.tracer.flow(
+                    "s" if minted_here else "t", trace_id, _PID_ROUTER
+                )
             return fid
         self._c["submit_rejected_total"].inc()
         raise last_exc if last_exc is not None else NoLiveReplica(
@@ -668,6 +705,14 @@ class FleetRouter:
             other = self._by_name.get(twin[0])
             if other is not None and other.state not in ("dead", "removed"):
                 other.engine.cancel(twin[1])
+        if self.tracer.enabled:
+            self.tracer.span_end(
+                _PID_ROUTER, fid, "route",
+                trace_id=shadow.trace_id,
+                tokens=len(shadow.generated),
+                failovers=shadow.failovers,
+                won_by_hedge=won_by_hedge,
+            )
         return fid
 
     def _update_shadows(self, replica: Replica) -> None:
@@ -844,6 +889,18 @@ class FleetRouter:
             groups.setdefault(order[0].name, []).append(shadow)
         for name, shadows in groups.items():
             target = self._by_name[name]
+            if self.tracer.enabled:
+                # Mark the failover BEFORE the restore lands: the
+                # waterfall retro-assigns the silence since the victim's
+                # last sign of life to ``failover_gap`` at this event.
+                for shadow in shadows:
+                    self.tracer.span_event(
+                        _PID_ROUTER, shadow.fid, "failover",
+                        trace_id=shadow.trace_id,
+                        from_replica=dead.name,
+                        to_replica=name,
+                        committed_tokens=len(shadow.generated),
+                    )
             restore_engine(target.engine, self._snapshot_for(shadows, now))
             for shadow in shadows:
                 shadow.replica = name
@@ -897,6 +954,7 @@ class FleetRouter:
                         if shadow.mods is not None
                         else None
                     ),
+                    trace_id=shadow.trace_id,
                 )
             )
         return EngineSnapshot(
@@ -939,6 +997,7 @@ class FleetRouter:
                 req_id = target.engine.submit(
                     list(shadow.prompt), shadow.params, shadow.metadata,
                     tenant_id=shadow.tenant_id, mods=shadow.mods,
+                    trace_id=shadow.trace_id,
                 )
             except AdmissionError:
                 continue
@@ -946,6 +1005,16 @@ class FleetRouter:
             shadow.hedge_req_id = req_id
             self._by_owner[(target.name, req_id)] = shadow.fid
             self._c["hedges_total"].inc()
+            if self.tracer.enabled:
+                # The twin shares the trace_id: its engine span joins the
+                # same waterfall, linked by this mark and the flow arrow
+                # the twin's submit emitted on the target engine.
+                self.tracer.span_event(
+                    _PID_ROUTER, shadow.fid, "hedge",
+                    trace_id=shadow.trace_id,
+                    twin_replica=target.name,
+                    twin_req_id=req_id,
+                )
 
     # ------------------------------------------------- drain / autoscaling
 
@@ -1111,6 +1180,26 @@ class FleetRouter:
                 replica.engine.registry.snapshot(include_state=True)
             )
         return MetricsRegistry.merge(snaps)
+
+    def trace_documents(self) -> List[dict]:
+        """Every Perfetto document the fleet can produce: the router's own
+        lane plus each attached replica's — INCLUDING dead replicas (the
+        in-process tracer object survives the simulated SIGKILL; a real
+        deployment would substitute the scraped ``/trace`` or the
+        postmortem replay). This is what ``merge_traces`` assembles into
+        the one fleet trace where a failed-over request reads as a single
+        ``trace_id`` across door, router, victim, and survivor."""
+        docs: List[dict] = []
+        if self.tracer.enabled:
+            docs.append(self.tracer.to_perfetto())
+        for replica in self._replicas:
+            if replica.state == "removed":
+                continue
+            tracer = getattr(replica.engine, "tracer", None)
+            if tracer is not None and getattr(tracer, "enabled", False):
+                with replica.engine.registry.lock:
+                    docs.append(tracer.to_perfetto())
+        return docs
 
     def describe(self) -> dict:
         """The fleet ``/statusz`` block: route table + shadow census."""

@@ -58,7 +58,9 @@ import dataclasses
 
 from distributed_pytorch_tpu.metrics import ReservoirGroup
 from distributed_pytorch_tpu.obs import MetricsRegistry
+from distributed_pytorch_tpu.obs.disttrace import prune_trace
 from distributed_pytorch_tpu.obs.slo import SLObjective, SLOMonitor
+from distributed_pytorch_tpu.obs.tracer import NULL_TRACER, _PID_DOOR
 from distributed_pytorch_tpu.serving.admission import (
     AdmissionError,
     EngineDraining,
@@ -103,7 +105,10 @@ class _Pending:
     """A stream waiting in its tenant's door queue for fair-share
     admission."""
 
-    __slots__ = ("stream", "prompt", "params", "mods", "metadata")
+    __slots__ = (
+        "stream", "prompt", "params", "mods", "metadata",
+        "pace_t0", "paced_s",
+    )
 
     def __init__(self, stream, prompt, params, mods, metadata):
         self.stream = stream
@@ -111,6 +116,13 @@ class _Pending:
         self.params = params
         self.mods = mods
         self.metadata = metadata
+        # Token-bucket pacing accounting: ``pace_t0`` is set while this
+        # pending sits at the head of its tenant queue with an empty
+        # bucket; the accumulated ``paced_s`` is reported on the door's
+        # "admitted" trace event so the waterfall can carve pacing delay
+        # out of generic queue wait.
+        self.pace_t0: Optional[float] = None
+        self.paced_s = 0.0
 
     @property
     def cost(self) -> int:
@@ -144,6 +156,13 @@ class TokenStream:
         self.first_token_t: Optional[float] = None
         self.last_token_t: Optional[float] = None
         self.seen = 0
+        # Fleet-wide trace identity: minted by the door at open_stream
+        # (or supplied by the caller), carried down through router and
+        # engine so one id names the request in every layer's trace.
+        self.trace_id: Optional[str] = None
+        self.sid: int = -1  # door span id (stream sequence number)
+        self._minted_trace = True
+        self._trace_closed = False
 
     # ------------------------------------------------------------- status
 
@@ -233,9 +252,18 @@ class FrontDoor:
         clock=time.perf_counter,
         slo: bool = True,
         max_pumps_per_token: int = 10_000,
+        tracer=None,
+        sampler=None,
     ):
         self._backend = _make_backend(backend)
         self._clock = clock
+        # Door-lane tracer (pid 3 in the merged fleet trace) and the
+        # optional head+tail trace sampler that decides, at stream end,
+        # whether a trace_id's spans stay in every layer's tracer.
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.sampler = sampler
+        self._next_sid = 0
+        self._stall_t0: Optional[float] = None
         self.max_stream_buffer = int(max_stream_buffer)
         self.max_pumps_per_token = int(max_pumps_per_token)
         self.tenants: Dict[str, TenantConfig] = dict(tenants or {})
@@ -290,6 +318,17 @@ class FrontDoor:
         self._tpot = ReservoirGroup(
             labels, capacity=reservoir_capacity, seed=13
         )
+        # Per-tenant waterfall components, recorded door-side as requests
+        # pass each stage (`tools/obs_top.py --tenant` renders these).
+        self._wf_queue_wait = ReservoirGroup(
+            labels, capacity=reservoir_capacity, seed=17
+        )
+        self._wf_pacing = ReservoirGroup(
+            labels, capacity=reservoir_capacity, seed=19
+        )
+        self._wf_decode = ReservoirGroup(
+            labels, capacity=reservoir_capacity, seed=23
+        )
         self.registry = self._build_registry()
         objectives = self.slo_objectives()
         self.slo = (
@@ -333,6 +372,24 @@ class FrontDoor:
             label="tenant",
             help="Client-visible per-token latency, per tenant",
         )
+        reg.reservoir(
+            "waterfall_queue_wait_by_tenant",
+            lambda: self._wf_queue_wait,
+            label="tenant",
+            help="Door queue wait to admission (pacing excluded), per tenant",
+        )
+        reg.reservoir(
+            "waterfall_pacing_by_tenant",
+            lambda: self._wf_pacing,
+            label="tenant",
+            help="Token-bucket pacing delay at the door, per tenant",
+        )
+        reg.reservoir(
+            "waterfall_decode_by_tenant",
+            lambda: self._wf_decode,
+            label="tenant",
+            help="First-to-last token decode window, per tenant",
+        )
         return reg
 
     def slo_objectives(self) -> List[SLObjective]:
@@ -373,6 +430,7 @@ class FrontDoor:
         params: Optional[SamplingParams] = None,
         mods: Optional[Mods] = None,
         metadata: Optional[dict] = None,
+        trace_id: Optional[str] = None,
     ) -> TokenStream:
         """Enqueue one request under ``tenant`` and return its stream.
 
@@ -380,7 +438,11 @@ class FrontDoor:
         stream pumps as you iterate — callers never wait on admission
         explicitly). Raises :class:`TenantQuotaExceeded` when the
         tenant's own queue quota is full and ``KeyError`` for an
-        undeclared tenant."""
+        undeclared tenant.
+
+        ``trace_id`` (normally minted here) is the fleet-wide identity
+        this request keeps through routing, hedging, preemption, and
+        failover — pass one only to join an externally-initiated trace."""
         tenant = tenant if tenant is not None else self.default_tenant
         cfg = self.tenants.get(tenant)
         if cfg is None:
@@ -397,6 +459,22 @@ class FrontDoor:
         params = params or SamplingParams()
         stream = TokenStream(self, tenant)
         stream.submit_t = self._clock()
+        stream._minted_trace = trace_id is None
+        if trace_id is None:
+            trace_id = f"d{self._next_sid:06x}"
+        stream.trace_id = trace_id
+        stream.sid = self._next_sid
+        self._next_sid += 1
+        if self.tracer.enabled:
+            self.tracer.span_begin(
+                _PID_DOOR,
+                stream.sid,
+                "stream",
+                trace_id=trace_id,
+                tenant=tenant,
+                prompt_len=len(prompt),
+                max_new_tokens=params.max_new_tokens,
+            )
         if not queue:
             # Idle -> backlogged: rejoin the stride race at the current
             # global virtual time (no banked credit from idling).
@@ -424,6 +502,9 @@ class FrontDoor:
             except StopIteration:
                 pass
             stream._override = "cancelled"
+            # Never reached the engine: the door span is this stream's
+            # whole trace — close it (and let the sampler judge it) now.
+            self._close_trace(stream, "cancelled")
         else:
             self._backend.cancel(stream.req_id)
         self.cancelled_by_client += 1
@@ -439,8 +520,20 @@ class FrontDoor:
         )
         if blocked:
             self.backpressure_stalls += 1
+            if self._stall_t0 is None:
+                self._stall_t0 = self._clock()
             finished: List[int] = []
         else:
+            if self._stall_t0 is not None:
+                # Stall window just closed: one instant on the door lane
+                # whose ``dur_s`` reaches back over the stalled interval
+                # (the waterfall re-buckets overlapping decode time).
+                dur_s = self._clock() - self._stall_t0
+                self._stall_t0 = None
+                if self.tracer.enabled and dur_s > 0:
+                    self.tracer.instant(
+                        "backpressure_stall", pid=_PID_DOOR, dur_s=dur_s
+                    )
             finished = self._backend.step()
         self._observe()
         if self.slo is not None:
@@ -521,7 +614,14 @@ class FrontDoor:
                     continue
                 level = self._bucket_level(tenant, now)
                 if level is not None and level < queue[0].cost:
+                    # Head-of-line blocked on the token bucket: this is
+                    # PACING, not generic queue wait — clock it.
+                    if queue[0].pace_t0 is None:
+                        queue[0].pace_t0 = now
                     continue
+                if queue[0].pace_t0 is not None:
+                    queue[0].paced_s += now - queue[0].pace_t0
+                    queue[0].pace_t0 = None
                 if best is None or self._vtime[tenant] < self._vtime[best]:
                     best = tenant
             if best is None:
@@ -535,6 +635,7 @@ class FrontDoor:
                     pending.metadata,
                     tenant_id=best,
                     mods=pending.mods,
+                    trace_id=pending.stream.trace_id,
                 )
             except (QueueFull, EngineDraining):
                 return
@@ -545,11 +646,37 @@ class FrontDoor:
                 pending.stream._override = "rejected"
                 pending.stream._reject_reason = str(exc)
                 self.rejected += 1
+                self._close_trace(
+                    pending.stream, "rejected", reason=str(exc)
+                )
                 continue
             queue.popleft()
-            pending.stream.req_id = req_id
-            self._by_req[req_id] = pending.stream
+            stream = pending.stream
+            stream.req_id = req_id
+            self._by_req[req_id] = stream
             self.admitted += 1
+            queue_wait_s = max(
+                0.0, now - stream.submit_t - pending.paced_s
+            )
+            self._wf_queue_wait.record(best, queue_wait_s)
+            self._wf_pacing.record(best, pending.paced_s)
+            if self.tracer.enabled and stream.trace_id is not None:
+                self.tracer.span_event(
+                    _PID_DOOR,
+                    stream.sid,
+                    "admitted",
+                    trace_id=stream.trace_id,
+                    req_id=req_id,
+                    queue_wait_s=queue_wait_s,
+                    pacing_s=pending.paced_s,
+                )
+                # The flow arrow's origin: "s" where the id was minted,
+                # "t" when the caller brought its own trace context.
+                self.tracer.flow(
+                    "s" if stream._minted_trace else "t",
+                    stream.trace_id,
+                    _PID_DOOR,
+                )
             if best in self._bucket:
                 level, last = self._bucket[best]
                 self._bucket[best] = (level - pending.cost, last)
@@ -587,16 +714,152 @@ class FrontDoor:
             return
         stream._finalized = True
         self.finished += 1
+        tpot: Optional[float] = None
         if (
             stream.first_token_t is not None
             and stream.last_token_t is not None
             and stream.seen > 1
         ):
-            self._tpot.record(
+            tpot = (
+                stream.last_token_t - stream.first_token_t
+            ) / (stream.seen - 1)
+            self._tpot.record(stream.tenant, tpot)
+            self._wf_decode.record(
                 stream.tenant,
-                (stream.last_token_t - stream.first_token_t)
-                / (stream.seen - 1),
+                stream.last_token_t - stream.first_token_t,
             )
+        status = stream.status
+        cfg = self.tenants[stream.tenant]
+        slo_violated = False
+        if (
+            cfg.ttft_slo_s is not None
+            and stream.first_token_t is not None
+            and stream.first_token_t - stream.submit_t > cfg.ttft_slo_s
+        ):
+            slo_violated = True
+        if cfg.tpot_slo_s is not None and tpot is not None:
+            slo_violated = slo_violated or tpot > cfg.tpot_slo_s
+        failed_over = (
+            stream.req_id is not None
+            and self._backend.failovers(stream.req_id) > 0
+        )
+        self._close_trace(
+            stream,
+            status,
+            failed_over=failed_over,
+            slo_violated=slo_violated,
+        )
+
+    def _close_trace(
+        self,
+        stream: TokenStream,
+        status: str,
+        *,
+        failed_over: bool = False,
+        slo_violated: bool = False,
+        reason: Optional[str] = None,
+    ) -> None:
+        """Close the stream's door span and hand its trace_id to the
+        sampler; apply any resulting drop decisions to every tracer in
+        the stack (door + backend layers). Idempotent per stream."""
+        if stream._trace_closed or stream.trace_id is None:
+            return
+        stream._trace_closed = True
+        if self.tracer.enabled:
+            attrs = {"trace_id": stream.trace_id, "status": status,
+                     "tokens": stream.seen}
+            if reason is not None:
+                attrs["reason"] = reason
+            self.tracer.span_end(_PID_DOOR, stream.sid, "stream", **attrs)
+        if self.sampler is None:
+            return
+        self.sampler.note_end(
+            stream.trace_id,
+            failed=status in ("cancelled", "rejected", "expired"),
+            failed_over=failed_over,
+            slo_violated=slo_violated,
+        )
+        drops = self.sampler.drain_drops()
+        if drops:
+            self._prune(drops)
+
+    def _prune(self, drops) -> None:
+        if self.tracer.enabled:
+            prune_trace(self.tracer, drops)
+        for tracer, lock in self._backend.tracers():
+            if lock is not None:
+                with lock:
+                    prune_trace(tracer, drops)
+            else:
+                prune_trace(tracer, drops)
+
+    # ------------------------------------------------------- introspection
+
+    def trace_documents(self) -> List[dict]:
+        """Every layer's Perfetto document, door first — feed straight to
+        :func:`~distributed_pytorch_tpu.obs.disttrace.merge_traces` (the
+        ``/requestz`` endpoint does exactly that)."""
+        docs: List[dict] = []
+        if self.tracer.enabled:
+            docs.append(self.tracer.to_perfetto())
+        docs.extend(self._backend.trace_documents())
+        return docs
+
+    def health(self) -> str:
+        return "live"
+
+    def status(self) -> dict:
+        """Door live-state for ``/statusz`` — headline counters plus a
+        per-tenant block (queue depth, SLO latencies, and the waterfall
+        component quantiles ``tools/obs_top.py --tenant`` renders)."""
+        with self.registry.lock:
+            doc: Dict[str, object] = {
+                "streams_opened": self.streams_opened,
+                "admitted": self.admitted,
+                "finished": self.finished,
+                "rejected": self.rejected + self.rejected_quota,
+                "backpressure_stalls": self.backpressure_stalls,
+                "queued_streams": sum(
+                    len(q) for q in self._queues.values()
+                ),
+                "active_streams": len(self._active),
+            }
+            tenants: Dict[str, dict] = {}
+            for tenant in sorted(self.tenants):
+                tenants[tenant] = {
+                    "queued": len(self._queues[tenant]),
+                    "weight": self.tenants[tenant].weight,
+                    "ttft_p95_s": self.registry.read_quantile(
+                        "ttft_by_tenant", 0.95, tenant
+                    ),
+                    "tpot_p95_s": self.registry.read_quantile(
+                        "tpot_by_tenant", 0.95, tenant
+                    ),
+                    "queue_wait_p95_s": self.registry.read_quantile(
+                        "waterfall_queue_wait_by_tenant", 0.95, tenant
+                    ),
+                    "pacing_p95_s": self.registry.read_quantile(
+                        "waterfall_pacing_by_tenant", 0.95, tenant
+                    ),
+                    "decode_p95_s": self.registry.read_quantile(
+                        "waterfall_decode_by_tenant", 0.95, tenant
+                    ),
+                }
+            doc["tenants"] = tenants
+            if self.sampler is not None:
+                sampler_doc = dict(self.sampler.counters())
+                sampler_doc["kept"] = len(self.sampler.kept_ids())
+                doc["trace_sampler"] = sampler_doc
+            return doc
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0):
+        """Attach an :class:`~distributed_pytorch_tpu.obs.server.
+        IntrospectionServer` to the door itself: ``/metrics`` and
+        ``/statusz`` read the door registry, ``/requestz`` merges door +
+        backend traces into per-request waterfalls."""
+        from distributed_pytorch_tpu.obs.server import IntrospectionServer
+
+        return IntrospectionServer(self, host=host, port=port).start()
 
 
 # ------------------------------------------------------------- backends
@@ -613,9 +876,12 @@ class _EngineBackend:
     def slots_hint(self) -> int:
         return self.engine.max_slots
 
-    def submit(self, prompt, params, metadata, *, tenant_id, mods) -> int:
+    def submit(
+        self, prompt, params, metadata, *, tenant_id, mods, trace_id=None
+    ) -> int:
         return self.engine.submit(
-            prompt, params, metadata, tenant_id=tenant_id, mods=mods
+            prompt, params, metadata, tenant_id=tenant_id, mods=mods,
+            trace_id=trace_id,
         )
 
     def step(self) -> List[int]:
@@ -643,6 +909,17 @@ class _EngineBackend:
             if not req.done:
                 yield req_id, req.tenant_id, req.delivered
 
+    def failovers(self, req_id: int) -> int:
+        return 0  # a single engine has nowhere to fail over to
+
+    def tracers(self):
+        tracer = getattr(self.engine, "tracer", None)
+        if tracer is not None and getattr(tracer, "enabled", False):
+            yield tracer, self.engine.registry.lock
+
+    def trace_documents(self) -> List[dict]:
+        return self.engine.trace_documents()
+
 
 class _RouterBackend:
     """Adapter over a :class:`~.fleet.FleetRouter`: streams ride FLEET
@@ -664,9 +941,12 @@ class _RouterBackend:
             ),
         )
 
-    def submit(self, prompt, params, metadata, *, tenant_id, mods) -> int:
+    def submit(
+        self, prompt, params, metadata, *, tenant_id, mods, trace_id=None
+    ) -> int:
         return self.router.submit(
-            prompt, params, metadata, tenant_id=tenant_id, mods=mods
+            prompt, params, metadata, tenant_id=tenant_id, mods=mods,
+            trace_id=trace_id,
         )
 
     def step(self) -> List[int]:
@@ -705,6 +985,25 @@ class _RouterBackend:
         for fid, shadow in sorted(self.router._shadows.items()):
             if not shadow.finished:
                 yield fid, shadow.tenant_id, 0
+
+    def failovers(self, fid: int) -> int:
+        shadow = self.router._shadows.get(fid)
+        return shadow.failovers if shadow is not None else 0
+
+    def tracers(self):
+        if getattr(self.router.tracer, "enabled", False):
+            # The router tracer shares the door's single-threaded pump —
+            # no lock to take.
+            yield self.router.tracer, None
+        for replica in self.router.replicas():
+            if replica.state == "removed":
+                continue
+            tracer = getattr(replica.engine, "tracer", None)
+            if tracer is not None and getattr(tracer, "enabled", False):
+                yield tracer, replica.engine.registry.lock
+
+    def trace_documents(self) -> List[dict]:
+        return self.router.trace_documents()
 
 
 def _make_backend(obj):

@@ -137,6 +137,15 @@ def _adapter_bound(req: "Request") -> bool:
     return mods is not None and getattr(mods, "adapter", None) is not None
 
 
+def _flight_trace(req: "Request") -> dict:
+    """Flight-recorder stamp for the fleet trace identity: ``{}`` for
+    untraced requests (dump shape unchanged), ``{"trace_id": ...}`` when
+    the request carries one — so ``replay_to_tracer()`` output merges into
+    the fleet trace and a dead replica's last moments land on the victim
+    request's waterfall."""
+    return {"trace_id": req.trace_id} if req.trace_id is not None else {}
+
+
 def _stops_on_sequence(req: "Request") -> bool:
     """True when ``req.generated`` ends with any of its stop sequences."""
     gen = req.generated
@@ -202,6 +211,11 @@ class Request:
     # ``rework_kind`` names the waste bucket they charge to.
     rework_until: int = 0
     rework_kind: str = "preempt_rework"
+    # Fleet-wide trace identity, minted a layer up (front door / router)
+    # and carried unchanged across preemption, drain hand-off, hedge
+    # twins, and failover id-rebasing — req_ids are engine-local and
+    # rebased on adoption; this string is the one name a request keeps.
+    trace_id: Optional[str] = None
 
     def __post_init__(self):
         if not self.tokens:
@@ -335,7 +349,7 @@ class Scheduler:
             now = time.perf_counter()
 
         def describe(req: Request) -> dict:
-            return {
+            doc = {
                 "req_id": req.req_id,
                 "phase": req.state.value,
                 "slot": req.slot,
@@ -346,6 +360,9 @@ class Scheduler:
                 "max_new_tokens": req.params.max_new_tokens,
                 "preempt_count": req.preempt_count,
             }
+            if req.trace_id is not None:
+                doc["trace_id"] = req.trace_id
+            return doc
 
         out = [describe(r) for r in self.waiting]
         out.extend(describe(r) for r in self.slots if r is not None)
@@ -394,6 +411,7 @@ class Scheduler:
                 slot=slot,
                 cached_tokens=req.len_cached,
                 readmission=req.preempt_count > 0,
+                **_flight_trace(req),
             )
 
     def _preempt(self, req: Request) -> None:
@@ -417,6 +435,7 @@ class Scheduler:
                 req_id=req.req_id,
                 n_generated=req.n_generated,
                 pages_released=len(req.table.pages),
+                **_flight_trace(req),
             )
         req.table.release(self.allocator)
         self.slots[req.slot] = None
@@ -468,6 +487,7 @@ class Scheduler:
                 req_id=req.req_id,
                 n_generated=req.n_generated,
                 preempt_count=req.preempt_count,
+                **_flight_trace(req),
             )
 
     def cancel(
@@ -514,6 +534,7 @@ class Scheduler:
                 req_id=req.req_id,
                 terminal=state.value,
                 n_generated=req.n_generated,
+                **_flight_trace(req),
             )
         return True
 

@@ -1,0 +1,449 @@
+"""Fleet-wide distributed tracing: merge alignment, waterfall partition,
+head+tail sampling, pruning, and the snapshot carry of ``trace_id``.
+
+Two layers of coverage. The pure tests drive ``obs.disttrace`` with
+synthetic trace documents — epoch alignment, pid remapping, the exact
+waterfall partition, sampler determinism and bounded memory — without
+touching an engine. The integration tests push seeded Poisson-ish load
+through a traced ``FrontDoor`` over a real engine and assert the property
+the module is built around: every trace's waterfall components sum to its
+end-to-end latency (the partition is exact by construction; 5% is float
+slack). All on CPU (conftest pins JAX_PLATFORMS=cpu).
+"""
+
+import dataclasses
+import json
+import random
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_pytorch_tpu.obs import (
+    WATERFALL_COMPONENTS,
+    TraceSampler,
+    Tracer,
+    flow_id,
+    format_waterfall,
+    merge_traces,
+    prune_trace,
+    request_waterfall,
+    trace_ids,
+)
+from distributed_pytorch_tpu.obs.tracer import _PID_DOOR, _PID_ROUTER
+from distributed_pytorch_tpu.models.transformer import TransformerLM
+from distributed_pytorch_tpu.serving import (
+    FrontDoor,
+    InferenceEngine,
+    SamplingParams,
+    TenantConfig,
+)
+from distributed_pytorch_tpu.serving.elastic import RequestSnapshot
+
+
+# ----------------------------------------------------------- fixtures
+
+
+def tiny_lm():
+    return TransformerLM(
+        vocab_size=48, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+        dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = tiny_lm()
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+ENGINE_KW = dict(
+    max_slots=4, max_seq_len=32, page_size=4, token_budget=16,
+    max_prefill_chunk=8, debug=True,
+)
+SP = SamplingParams(max_new_tokens=6)
+
+
+def traced_door(model, params, sampler=None, **door_kw):
+    eng = InferenceEngine(model, params, tracer=Tracer(), **ENGINE_KW)
+    door = FrontDoor(
+        eng,
+        tenants={"anon": TenantConfig()},
+        tracer=Tracer(),
+        sampler=sampler,
+        **door_kw,
+    )
+    return eng, door
+
+
+# ------------------------------------------------------------- sampler
+
+
+def test_sampler_head_draw_is_deterministic():
+    """The head verdict is a pure function of (seed, trace_id): two
+    sampler instances agree on every id, so any layer could consult its
+    own copy and reach the door's decision."""
+    a = TraceSampler(head_rate=0.5, seed=7)
+    b = TraceSampler(head_rate=0.5, seed=7)
+    ids = [f"d{i:06x}" for i in range(500)]
+    assert [a.head_keep(t) for t in ids] == [b.head_keep(t) for t in ids]
+    kept = sum(a.head_keep(t) for t in ids)
+    assert 0.35 * len(ids) < kept < 0.65 * len(ids)
+    assert not any(TraceSampler(head_rate=0.0).head_keep(t) for t in ids)
+    assert all(TraceSampler(head_rate=1.0).head_keep(t) for t in ids)
+
+
+def test_sampler_tail_keeps_override_head_drop():
+    s = TraceSampler(head_rate=0.0)
+    assert s.note_end("t-ok") is False
+    assert s.note_end("t-failed", failed=True) is True
+    assert s.note_end("t-moved", failed_over=True) is True
+    assert s.note_end("t-slow", slo_violated=True) is True
+    assert s.counters() == {
+        "traces_ended": 4,
+        "traces_kept_head": 0,
+        "traces_kept_tail": 3,
+        "traces_dropped": 1,
+        "traces_evicted": 0,
+    }
+    assert s.kept_ids() == ["t-failed", "t-moved", "t-slow"]
+    assert s.drain_drops() == {"t-ok"}
+    assert s.drain_drops() == set()  # drained means drained
+
+
+def test_sampler_kept_ring_is_bounded():
+    s = TraceSampler(head_rate=0.0, max_kept=2)
+    for i in range(4):
+        s.note_end(f"t{i}", failed=True)
+    assert s.kept_ids() == ["t2", "t3"]
+    assert s.counters()["traces_evicted"] == 2
+    # Evicted ids become pending drops — bounded memory means the spans
+    # go too, not just the bookkeeping.
+    assert s.drain_drops() == {"t0", "t1"}
+
+
+def test_sampler_rejects_bad_config():
+    with pytest.raises(ValueError):
+        TraceSampler(head_rate=1.5)
+    with pytest.raises(ValueError):
+        TraceSampler(max_kept=0)
+
+
+# --------------------------------------------------------------- merge
+
+
+def _doc(epoch, events, pid_names=None):
+    tev = []
+    for pid, name in (pid_names or {}).items():
+        tev.append({
+            "ph": "M", "name": "process_name", "pid": pid,
+            "args": {"name": name},
+        })
+    tev.extend(events)
+    return {
+        "traceEvents": tev,
+        "displayTimeUnit": "ms",
+        "metadata": {"wall_epoch_s": epoch},
+    }
+
+
+def test_merge_aligns_epochs_and_remaps_pids():
+    """Each source's monotonic timeline is shifted by its wall-clock epoch
+    delta; pids stride by source index so replica lanes never collide."""
+    door = _doc(
+        100.0,
+        [{"ph": "b", "cat": "door", "id": 1, "ts": 0.0, "pid": _PID_DOOR,
+          "name": "stream", "args": {"trace_id": "d000000"}}],
+        pid_names={_PID_DOOR: "frontdoor"},
+    )
+    eng = _doc(
+        100.5,  # booted half a second later
+        [{"ph": "b", "cat": "request", "id": 7, "ts": 250.0, "pid": 2,
+          "name": "req 7", "args": {"trace_id": "d000000"}}],
+        pid_names={2: "requests", 5: "unused-lane"},
+    )
+    merged = merge_traces(door, eng, labels=["door", "r0"])
+    assert merged["metadata"] == {
+        "wall_epoch_s": 100.0, "sources": ["door", "r0"],
+    }
+    by_ph = {e["ph"]: e for e in merged["traceEvents"] if e["ph"] != "M"}
+    assert by_ph["b"] is not None
+    spans = [e for e in merged["traceEvents"] if e["ph"] == "b"]
+    door_ev = next(e for e in spans if e["cat"] == "door")
+    eng_ev = next(e for e in spans if e["cat"] == "request")
+    assert door_ev["ts"] == 0.0 and door_ev["pid"] == _PID_DOOR
+    # 0.5s epoch delta (500_000us) + its own 250us monotonic ts.
+    assert eng_ev["ts"] == pytest.approx(500_250.0)
+    assert eng_ev["pid"] == 10 + 2
+    metas = [e for e in merged["traceEvents"] if e["ph"] == "M"]
+    names = {e["pid"]: e["args"]["name"] for e in metas}
+    assert names[_PID_DOOR] == "door: frontdoor"
+    assert names[12] == "r0: requests"
+    assert 15 not in names  # metadata for unused lanes is dropped
+    json.loads(json.dumps(merged))  # still plain Chrome JSON
+
+
+def test_merge_accepts_live_tracers_and_labels_them():
+    a, b = Tracer(), Tracer()
+    a.span_begin(_PID_DOOR, 0, "stream", trace_id="d000000")
+    b.span_begin(_PID_ROUTER, 0, "route", trace_id="d000000")
+    merged = merge_traces(a, b)
+    assert merged["metadata"]["sources"] == ["door", "router"]
+    assert trace_ids(merged) == ["d000000"]
+
+
+def test_merge_empty_is_valid():
+    merged = merge_traces()
+    assert merged["traceEvents"] == []
+    assert trace_ids(merged) == []
+
+
+# ----------------------------------------------------------- waterfall
+
+
+def test_waterfall_is_an_exact_partition_synthetic():
+    """Handcrafted timeline with every transition: the components must
+    sum to e2e exactly, and each interval must land in the right
+    bucket."""
+    us = 1e6
+    events = [
+        # Door: open at 0, admitted at 3s (1s of it token-bucket pacing).
+        {"ph": "b", "cat": "door", "id": 0, "ts": 0.0, "pid": 3,
+         "name": "stream", "args": {"trace_id": "T"}},
+        {"ph": "n", "cat": "door", "id": 0, "ts": 3 * us, "pid": 3,
+         "name": "admitted", "args": {"trace_id": "T", "pacing_s": 1.0}},
+        # Engine: span opens at 4s (1s of route), slot admit 5s, first
+        # token 6s, second token 7s.
+        {"ph": "b", "cat": "request", "id": 7, "ts": 4 * us, "pid": 2,
+         "name": "req 7", "args": {"trace_id": "T"}},
+        {"ph": "n", "cat": "request", "id": 7, "ts": 5 * us, "pid": 2,
+         "name": "admit", "args": {}},
+        {"ph": "n", "cat": "request", "id": 7, "ts": 6 * us, "pid": 2,
+         "name": "decode_token", "args": {}},
+        {"ph": "n", "cat": "request", "id": 7, "ts": 7 * us, "pid": 2,
+         "name": "decode_token", "args": {}},
+        # Preempted at 7s, re-admitted and decoding again at 9s.
+        {"ph": "n", "cat": "request", "id": 7, "ts": 7 * us, "pid": 2,
+         "name": "preempt", "args": {}},
+        {"ph": "n", "cat": "request", "id": 7, "ts": 9 * us, "pid": 2,
+         "name": "decode_token", "args": {}},
+        {"ph": "e", "cat": "request", "id": 7, "ts": 10 * us, "pid": 2,
+         "name": "req 7", "args": {}},
+        {"ph": "e", "cat": "door", "id": 0, "ts": 10 * us, "pid": 3,
+         "name": "stream", "args": {"trace_id": "T"}},
+    ]
+    doc = {"traceEvents": events, "metadata": {"wall_epoch_s": 0.0}}
+    wf = request_waterfall(doc, "T")
+    comp = wf["components"]
+    assert wf["e2e_s"] == pytest.approx(10.0)
+    assert sum(comp.values()) == pytest.approx(wf["e2e_s"])
+    assert comp["queue_wait"] == pytest.approx(3.0)  # 2 door + 1 engine
+    assert comp["pacing"] == pytest.approx(1.0)
+    assert comp["route"] == pytest.approx(1.0)
+    assert comp["prefill"] == pytest.approx(1.0)
+    assert comp["decode_active"] == pytest.approx(2.0)
+    assert comp["preempt_rework"] == pytest.approx(2.0)
+    assert set(comp) == set(WATERFALL_COMPONENTS)
+    table = format_waterfall(wf)
+    assert "trace T" in table and "preempt_rework" in table
+
+
+def test_waterfall_unknown_trace_id_raises():
+    with pytest.raises(KeyError):
+        request_waterfall({"traceEvents": []}, "nope")
+
+
+# ------------------------------------------------------------- pruning
+
+
+def test_prune_trace_removes_spans_and_flows_keeps_context():
+    tr = Tracer()
+    tr.span_begin(_PID_DOOR, 0, "stream", trace_id="keep")
+    tr.flow("s", "keep", _PID_DOOR)
+    tr.span_end(_PID_DOOR, 0, "stream", trace_id="keep")
+    tr.span_begin(_PID_DOOR, 1, "stream", trace_id="drop")
+    tr.flow("s", "drop", _PID_DOOR)
+    tr.span_end(_PID_DOOR, 1, "stream", trace_id="drop")
+    tr.instant("backpressure_stall", pid=_PID_DOOR, dur_s=0.1)
+    opened, closed = tr.spans_opened, tr.spans_closed
+    removed = prune_trace(tr, ["drop"])
+    assert removed == 3  # b + e + flow arrow
+    assert tr.spans_opened == opened - 1
+    assert tr.spans_closed == closed - 1
+    doc = tr.to_perfetto()
+    assert trace_ids(doc) == ["keep"]
+    assert not any(
+        e.get("cat") == "flow" and e.get("id") == flow_id("drop")
+        for e in doc["traceEvents"]
+    )
+    # Global context (the stall instant) survives pruning.
+    assert any(
+        e.get("name") == "backpressure_stall"
+        for e in doc["traceEvents"]
+    )
+    assert prune_trace(tr, []) == 0
+
+
+# ------------------------------------------- integration: door + engine
+
+
+def drive_poisson(door, prompts, seed=1234):
+    """Submit prompts on seeded geometric pump-round gaps (Poisson-ish,
+    deterministic — no wall clock), pump to completion, return delivered
+    token lists."""
+    rng = random.Random(seed)
+    schedule = {}
+    rnd = 0
+    for idx in range(len(prompts)):
+        schedule.setdefault(rnd, []).append(idx)
+        while rng.random() < 0.5:
+            rnd += 1
+    streams = [None] * len(prompts)
+    rounds = 0
+    while True:
+        for idx in schedule.pop(rounds, []):
+            streams[idx] = door.open_stream(prompts[idx], params=SP)
+        if not schedule and all(
+            s is not None and s.done for s in streams
+        ):
+            break
+        door.pump()
+        rounds += 1
+        assert rounds < 2000, "poisson drive did not converge"
+    return streams, [s.drain() for s in streams]
+
+
+POISSON_PROMPTS = [
+    [5, 7, 11, 2, t, t + 1] for t in (1, 9, 17, 25)
+] + [[2, 2, 3], [6, 1, 9, 4, 4, 4, 4]]
+
+
+def test_waterfall_sums_to_e2e_under_poisson_load(model_and_params):
+    """The property the partition is built for, on real spans: every
+    request admitted under staggered load decomposes into components that
+    sum to its end-to-end latency within 5% (exact minus float slack)."""
+    model, params = model_and_params
+    eng, door = traced_door(
+        model, params, sampler=TraceSampler(head_rate=1.0, max_kept=64)
+    )
+    try:
+        streams, outs = drive_poisson(door, POISSON_PROMPTS)
+        assert all(len(o) == SP.max_new_tokens for o in outs)
+        merged = merge_traces(*door.trace_documents())
+        ids = trace_ids(merged)
+        assert len(ids) == len(POISSON_PROMPTS)
+        assert ids == [s.trace_id for s in streams]  # minted in order
+        for tid in ids:
+            wf = request_waterfall(merged, tid)
+            assert wf["e2e_s"] > 0
+            total = sum(wf["components"].values())
+            assert total == pytest.approx(wf["e2e_s"], rel=0.05), (
+                f"{tid}: components {wf['components']} sum {total} "
+                f"!= e2e {wf['e2e_s']}"
+            )
+            assert all(v >= 0 for v in wf["components"].values())
+            # A completed request spent time computing somewhere.
+            assert (
+                wf["components"]["prefill"]
+                + wf["components"]["decode_active"]
+            ) > 0
+        assert door.sampler.counters()["traces_ended"] == len(ids)
+        assert door.sampler.kept_ids() == ids  # head_rate=1.0 keeps all
+    finally:
+        eng.close()
+
+
+def test_flow_arrows_cross_door_to_engine(model_and_params):
+    """The door mints the trace (flow 's'); the engine's request lane
+    binds to it (flow 't') — that pair is what draws the arrow between
+    process lanes in Perfetto."""
+    model, params = model_and_params
+    eng, door = traced_door(model, params)
+    try:
+        stream = door.open_stream(POISSON_PROMPTS[0], params=SP)
+        door.drive()
+        stream.drain()
+        assert stream.trace_id == "d000000"  # door-minted, stable format
+        merged = merge_traces(*door.trace_documents())
+        flows = [
+            (e["ph"], e["pid"])
+            for e in merged["traceEvents"]
+            if e.get("cat") == "flow"
+            and e.get("args", {}).get("trace_id") == stream.trace_id
+        ]
+        phases = {ph for ph, _pid in flows}
+        assert phases == {"s", "t"}, flows
+        assert {pid for _ph, pid in flows if _ph == "s"} == {_PID_DOOR}
+    finally:
+        eng.close()
+
+
+def test_head_drop_prunes_every_layer(model_and_params):
+    """head_rate=0 with nothing failing: every trace is dropped at end,
+    and the prune reaches both the door's tracer and the engine's —
+    request/door spans vanish while the engine step timeline stays."""
+    model, params = model_and_params
+    eng, door = traced_door(
+        model, params, sampler=TraceSampler(head_rate=0.0, max_kept=8)
+    )
+    try:
+        _streams, outs = drive_poisson(door, POISSON_PROMPTS[:3])
+        assert all(len(o) == SP.max_new_tokens for o in outs)
+        counters = door.sampler.counters()
+        assert counters["traces_dropped"] == 3
+        assert counters["traces_kept_head"] == 0
+        merged = merge_traces(*door.trace_documents())
+        assert trace_ids(merged) == []
+        # Dropping traces never drops the engine's own step timeline.
+        assert any(
+            e.get("ph") == "X" for e in merged["traceEvents"]
+        ), "engine step slices should survive sampling"
+    finally:
+        eng.close()
+
+
+# ------------------------------------------------- snapshot round-trip
+
+
+def _snapshot(**over):
+    base = dict(
+        req_id=3, prompt=(5, 7, 11), generated=(1, 2), max_new_tokens=6,
+        temperature=0.0, seed=0, stop_token=None, deadline_s=None,
+        metadata=None, preempt_count=0, age_s=0.5, ttft_s=0.1,
+        kv_committed=4, trie_keys=("abc",),
+    )
+    base.update(over)
+    return RequestSnapshot(**base)
+
+
+def test_request_snapshot_json_carries_trace_id():
+    snap = _snapshot(trace_id="d00002a")
+    entry = json.loads(json.dumps(dataclasses.asdict(snap)))
+    entry["prompt"] = tuple(entry["prompt"])
+    entry["generated"] = tuple(entry["generated"])
+    entry["trie_keys"] = tuple(entry["trie_keys"])
+    entry["stop_sequences"] = tuple(
+        tuple(s) for s in entry["stop_sequences"]
+    )
+    assert RequestSnapshot(**entry) == snap
+    assert RequestSnapshot(**entry).trace_id == "d00002a"
+
+
+def test_request_snapshot_json_backcompat_without_trace_id():
+    """Snapshots written before distributed tracing have no trace_id key
+    and must still decode (the field is defaulted-last on purpose)."""
+    snap = _snapshot()
+    entry = json.loads(json.dumps(dataclasses.asdict(snap)))
+    entry.pop("trace_id")
+    entry["prompt"] = tuple(entry["prompt"])
+    entry["generated"] = tuple(entry["generated"])
+    entry["trie_keys"] = tuple(entry["trie_keys"])
+    entry["stop_sequences"] = tuple(
+        tuple(s) for s in entry["stop_sequences"]
+    )
+    restored = RequestSnapshot(**entry)
+    assert restored.trace_id is None
+    assert restored.prompt == snap.prompt

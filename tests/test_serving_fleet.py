@@ -288,6 +288,89 @@ def test_fleet_kill_drill_token_parity(target_and_params, ref_outputs):
         router.close()  # closes survivors; close() leak-checks them
 
 
+def test_fleet_kill_drill_one_trace_id_spans_failover(
+    target_and_params, ref_outputs
+):
+    """Distributed-tracing face of the kill drill: a request that fails
+    over keeps ONE trace_id across the door, the router, the original
+    replica, and the survivor — and its merged waterfall attributes a
+    nonzero ``failover_gap`` while still summing to the e2e latency."""
+    from distributed_pytorch_tpu.obs import (
+        TraceSampler,
+        Tracer,
+        merge_traces,
+        request_waterfall,
+        trace_ids,
+    )
+    from distributed_pytorch_tpu.serving import FrontDoor, TenantConfig
+
+    model, params = target_and_params
+    engines = [
+        make_engine(model, params, tracer=Tracer()) for _ in range(3)
+    ]
+    router = FleetRouter(engines, tracer=Tracer(), probe_every=2)
+    door = FrontDoor(
+        router,
+        tenants={"anon": TenantConfig()},
+        tracer=Tracer(),
+        sampler=TraceSampler(head_rate=1.0, max_kept=64),
+    )
+    try:
+        streams = [
+            door.open_stream(p, params=params_for(i))
+            for i, p in enumerate(AFFINITY_PROMPTS)
+        ]
+        # Admit + route first, then aim the kill at whichever replica the
+        # affinity group actually landed on — the fault must hit a
+        # replica that is provably decoding these requests.
+        door.pump()
+        victim_name = router._shadows[streams[0].req_id].replica
+        victim_idx = next(
+            i for i, rep in enumerate(router.replicas())
+            if rep.name == victim_name
+        )
+        arm({
+            "seed": 1234,
+            "faults": [
+                {"kind": "kill_replica", "replica": victim_idx,
+                 "at_step": 2}
+            ],
+        })
+        door.drive()
+        outs = [s.drain() for s in streams]
+
+        dead = [r.name for r in router.replicas() if r.state == "dead"]
+        assert dead == [victim_name]
+        for i, out in enumerate(outs):
+            assert out == ref_outputs[i], f"stream {i} diverged"
+        moved = [
+            s for s in streams
+            if router._shadows[s.req_id].failovers > 0
+        ]
+        assert moved, "kill landed but no stream failed over"
+
+        merged = merge_traces(*door.trace_documents())
+        assert len(trace_ids(merged)) == len(streams)
+        victim = moved[0]
+        # ONE trace_id opens spans on door, router, AND both engine
+        # incarnations — four distinct process lanes minimum.
+        opened_pids = {
+            e["pid"]
+            for e in merged["traceEvents"]
+            if e.get("ph") == "b"
+            and e.get("args", {}).get("trace_id") == victim.trace_id
+        }
+        assert len(opened_pids) >= 4, (
+            f"victim {victim.trace_id} only on lanes {sorted(opened_pids)}"
+        )
+        wf = request_waterfall(merged, victim.trace_id)
+        assert wf["components"]["failover_gap"] > 0
+        total = sum(wf["components"].values())
+        assert abs(total - wf["e2e_s"]) <= 0.05 * wf["e2e_s"]
+    finally:
+        router.close()
+
+
 def test_partition_death_and_blip(target_and_params, ref_outputs):
     """A partitioned replica that stays unreachable past the probe
     threshold is declared dead and its work fails over; one that heals

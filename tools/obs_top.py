@@ -12,9 +12,15 @@ Usage:
     python tools/obs_top.py http://127.0.0.1:8321 [more urls...]
     python tools/obs_top.py --once URL        # one frame, no screen clear
     python tools/obs_top.py --interval 0.5 URL
+    python tools/obs_top.py --tenant URL      # per-tenant waterfall view
 
-``render_frame`` is a pure function of the polled documents, so tests
-drive it with canned statusz payloads and never open a socket.
+``--tenant`` switches to the front door's per-tenant table (the door's
+``/statusz`` carries a ``tenants`` block with queue-wait / pacing /
+decode p95s straight from its waterfall reservoirs).
+
+``render_frame`` / ``render_tenant_frame`` are pure functions of the
+polled documents, so tests drive them with canned statusz payloads and
+never open a socket.
 """
 
 from __future__ import annotations
@@ -131,6 +137,53 @@ def render_frame(
     return "\n".join(lines) + "\n"
 
 
+def render_tenant_frame(
+    polled: List[Tuple[str, Optional[dict]]], color: bool = True
+) -> str:
+    """Per-tenant dashboard frame from front-door ``/statusz`` documents:
+    one row per (door, tenant) with the waterfall-component p95s the
+    door's per-tenant reservoirs record at admission and finalize."""
+    bold = BOLD if color else ""
+    reset = RESET if color else ""
+    lines = [
+        f"{bold}{'DOOR':<24} {'TENANT':<12} {'W':>4} {'Q':>4} "
+        f"{'QWAIT p95':>10} {'PACE p95':>10} {'DECODE p95':>11} "
+        f"{'TTFT p95':>9} {'TPOT p95':>9}{reset}"
+    ]
+    for url, doc in polled:
+        name = url.replace("http://", "")[:24]
+        if doc is None:
+            down = f"{RED}down{RESET}" if color else "down"
+            lines.append(f"{name:<24} {down}")
+            continue
+        tenants = doc.get("tenants") or {}
+        if not tenants:
+            lines.append(f"{name:<24} (no tenants block — not a door?)")
+            continue
+        for tenant in sorted(tenants):
+            t = tenants[tenant]
+            lines.append(
+                f"{name:<24} {tenant:<12} "
+                f"{t.get('weight', 0):>4.1f} "
+                f"{t.get('queued', 0):>4} "
+                f"{_ms(t.get('queue_wait_p95_s')):>10} "
+                f"{_ms(t.get('pacing_p95_s')):>10} "
+                f"{_ms(t.get('decode_p95_s')):>11} "
+                f"{_ms(t.get('ttft_p95_s')):>9} "
+                f"{_ms(t.get('tpot_p95_s')):>9}"
+            )
+        sampler = doc.get("trace_sampler")
+        if sampler:
+            lines.append(
+                f"{'':<24} traces kept={sampler.get('kept', 0)} "
+                f"head={sampler.get('traces_kept_head', 0)} "
+                f"tail={sampler.get('traces_kept_tail', 0)} "
+                f"dropped={sampler.get('traces_dropped', 0)} "
+                f"evicted={sampler.get('traces_evicted', 0)}"
+            )
+    return "\n".join(lines) + "\n"
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("urls", nargs="+", help="engine base URLs")
@@ -143,11 +196,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--no-color", action="store_true", help="plain-text output"
     )
+    parser.add_argument(
+        "--tenant",
+        action="store_true",
+        help="per-tenant waterfall view (front-door /statusz)",
+    )
     args = parser.parse_args(argv)
     color = not args.no_color and sys.stdout.isatty()
+    render = render_tenant_frame if args.tenant else render_frame
     try:
         while True:
-            frame = render_frame(
+            frame = render(
                 [(url, poll(url)) for url in args.urls], color=color
             )
             if args.once:

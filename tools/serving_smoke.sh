@@ -25,6 +25,7 @@
 #   bash tools/serving_smoke.sh            # the six default scenarios
 #   bash tools/serving_smoke.sh mesh       # mesh-sharded scenario only
 #   bash tools/serving_smoke.sh frontdoor  # front-door scenario only
+#   bash tools/serving_smoke.sh disttrace  # fleet-wide tracing scenario
 #
 # The ``mesh`` scenario boots the engine on a (2,4) ("data","model") mesh
 # over 8 virtual CPU devices, replays a shared-prefix workload, and
@@ -238,6 +239,184 @@ print(
     f"cancel mid-stream after {len(partial)} tokens, "
     f"grammar {GRAMMAR!r} validated over {len(gtoks)} tokens, "
     f"recompile sentinel == 0"
+)
+EOF
+  exit 0
+fi
+
+# ``disttrace``: the fleet-wide distributed-tracing drill — door over a
+# 3-replica router, every layer tracing, seeded kill of the affinity
+# replica. Asserts token parity with an untraced bare engine, ONE
+# trace_id for the victim across original + survivor replicas with a
+# nonzero failover_gap, flow arrows spanning door -> router -> replica
+# lanes, the /requestz waterfall summing to e2e within 5%, and writes the
+# merged trace to traces/fleet_trace.json (uploaded as a CI artifact).
+if [ "$scenario" = "disttrace" ]; then
+  env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python - <<'EOF'
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_pytorch_tpu import chaos
+from distributed_pytorch_tpu.models.transformer import TransformerLM
+from distributed_pytorch_tpu.obs import (
+    TraceSampler,
+    Tracer,
+    format_waterfall,
+    merge_traces,
+    request_waterfall,
+    scrape,
+    trace_ids,
+)
+from distributed_pytorch_tpu.serving import (
+    FleetRouter,
+    FrontDoor,
+    InferenceEngine,
+    SamplingParams,
+    TenantConfig,
+)
+
+VOCAB = 128
+model = TransformerLM(
+    vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+    dtype=jnp.float32,
+)
+params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+ENGINE_KW = dict(
+    max_slots=4, max_seq_len=32, page_size=4, token_budget=16,
+    max_prefill_chunk=8, debug=True,
+)
+sp = SamplingParams(max_new_tokens=6)
+# One page-aligned shared prefix -> the whole batch routes by affinity to
+# one replica, which is the one the chaos plan will kill.
+PREFIX = [5, 7, 11, 2]
+prompts = [PREFIX + [t, t + 1] for t in (1, 9, 17, 25, 33, 41)]
+
+# Untraced single-engine reference (token streams are batch/slot/engine
+# invariant, so one bare engine covers every fleet outcome).
+ref_eng = InferenceEngine(model, params, **ENGINE_KW)
+rids = [ref_eng.submit(p, sp) for p in prompts]
+ref_eng.run()
+ref = [list(ref_eng.requests[r].generated) for r in rids]
+ref_eng.close()
+
+engines = [
+    InferenceEngine(model, params, tracer=Tracer(), **ENGINE_KW)
+    for _ in range(3)
+]
+router = FleetRouter(engines, tracer=Tracer())
+sampler = TraceSampler(head_rate=1.0, max_kept=64)
+door = FrontDoor(
+    router,
+    tenants={"anon": TenantConfig()},
+    tracer=Tracer(),
+    sampler=sampler,
+)
+streams = [door.open_stream(p, params=sp) for p in prompts]
+door.pump()  # admit + route, so the affinity target is knowable
+target = router._shadows[streams[0].req_id].replica
+target_idx = [
+    i for i, r in enumerate(router.replicas()) if r.name == target
+][0]
+os.environ[chaos.ENV_VAR] = json.dumps(
+    {
+        "seed": 0,
+        "faults": [
+            {"kind": "kill_replica", "replica": target_idx, "at_step": 2}
+        ],
+    }
+)
+chaos._reset()
+door.drive()
+outs = [s.drain() for s in streams]
+os.environ.pop(chaos.ENV_VAR, None)
+chaos._reset()
+
+dead = [r.name for r in router.replicas() if r.state == "dead"]
+assert dead == [target], f"expected {target} dead, got {dead}"
+for i, (s, out) in enumerate(zip(streams, outs)):
+    assert out == ref[i], (
+        f"stream {i} diverged across failover: {out} != {ref[i]}"
+    )
+
+victims = [
+    s for s in streams if router._shadows[s.req_id].failovers > 0
+]
+assert victims, "kill landed but no stream failed over"
+
+docs = door.trace_documents()
+assert len(docs) == 5, f"door+router+3 replicas, got {len(docs)} docs"
+merged = merge_traces(*docs)
+json.loads(json.dumps(merged))  # valid, round-trippable Chrome JSON
+assert merged["displayTimeUnit"] == "ms"
+ids = trace_ids(merged)
+assert len(ids) == len(prompts), (len(ids), len(prompts))
+
+victim = victims[0]
+# ONE trace_id, visible in door + router + BOTH engine incarnations.
+opened_pids = sorted(
+    {
+        e["pid"]
+        for e in merged["traceEvents"]
+        if e.get("ph") == "b"
+        and e.get("args", {}).get("trace_id") == victim.trace_id
+    }
+)
+assert len(opened_pids) >= 4, (
+    f"victim {victim.trace_id} spans pids {opened_pids}; expected door, "
+    "router, original replica, and failover survivor"
+)
+flow_phs = {
+    e["ph"]
+    for e in merged["traceEvents"]
+    if e.get("cat") == "flow"
+    and e.get("args", {}).get("trace_id") == victim.trace_id
+}
+assert flow_phs == {"s", "t"}, f"flow arrows incomplete: {flow_phs}"
+
+wf = request_waterfall(merged, victim.trace_id)
+print(format_waterfall(wf))
+total = sum(wf["components"].values())
+assert abs(total - wf["e2e_s"]) <= 0.05 * wf["e2e_s"], (
+    f"waterfall sum {total} vs e2e {wf['e2e_s']} off by more than 5%"
+)
+assert wf["components"]["failover_gap"] > 0, "no failover gap attributed"
+
+# The wire view: /requestz on the door's own introspection server.
+server = door.serve()
+try:
+    listing = scrape(server.url, "/requestz")
+    assert victim.trace_id in listing["trace_ids"], listing
+    remote_wf = scrape(server.url, f"/requestz?id={victim.trace_id}")
+    assert remote_wf["trace_id"] == victim.trace_id
+    remote_total = sum(remote_wf["components"].values())
+    assert abs(remote_total - remote_wf["e2e_s"]) <= 0.05 * remote_wf["e2e_s"]
+finally:
+    server.stop()
+
+assert sampler.counters()["traces_ended"] == len(prompts)
+
+os.makedirs("traces", exist_ok=True)
+with open("traces/fleet_trace.json", "w") as f:
+    json.dump(merged, f)
+
+for eng in engines:
+    try:
+        eng.close()
+    except Exception:
+        pass
+
+print(
+    "[serving_smoke] PASS: disttrace scenario, "
+    f"{len(prompts)} streams token-identical across a seeded kill of "
+    f"{target}, victim {victim.trace_id} is ONE trace across "
+    f"{len(opened_pids)} lanes with failover_gap "
+    f"{wf['components']['failover_gap'] * 1e3:.1f} ms, waterfall sums to "
+    f"e2e within 5%, merged trace -> traces/fleet_trace.json"
 )
 EOF
   exit 0

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Per-request latency waterfalls from fleet trace files or live engines.
+
+Feed it any mix of Perfetto trace JSON files (saved by ``Tracer.save`` or
+the smoke scenarios) and ``--url`` base URLs whose ``/trace`` endpoint is
+scraped live; the sources are clock-aligned and merged
+(``obs.disttrace.merge_traces``), then either listed (all trace_ids seen)
+or decomposed into one request's exact-partition waterfall.
+
+Usage:
+    python tools/trace_waterfall.py traces/fleet_trace.json
+    python tools/trace_waterfall.py door.json router.json r0.json --id d000003
+    python tools/trace_waterfall.py --url http://127.0.0.1:8321 --id r00000a
+    python tools/trace_waterfall.py merged.json --id d000000 --json
+
+With ``--id``, prints the waterfall table (or the raw dict with
+``--json``) and exits 0; without, lists every trace_id. Exits 2 when the
+id is not in the merged trace. ``load_sources`` / ``run`` are pure of
+argv parsing, so tests drive them directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from distributed_pytorch_tpu.obs.disttrace import (
+    format_waterfall,
+    merge_traces,
+    request_waterfall,
+    trace_ids,
+)
+from distributed_pytorch_tpu.obs.server import scrape
+
+
+def load_sources(paths: List[str], urls: List[str]) -> List[dict]:
+    """Trace documents from files and live ``/trace`` endpoints, in the
+    order given (files first — merge labels follow source order)."""
+    docs: List[dict] = []
+    for path in paths:
+        with open(path) as f:
+            docs.append(json.load(f))
+    for url in urls:
+        doc = scrape(url, "/trace")
+        if not isinstance(doc, dict):
+            raise ValueError(f"{url}/trace did not return a trace document")
+        docs.append(doc)
+    return docs
+
+
+def run(
+    paths: List[str],
+    urls: List[str],
+    trace_id: Optional[str],
+    as_json: bool = False,
+    out=sys.stdout,
+) -> int:
+    if not paths and not urls:
+        print("no trace sources given", file=sys.stderr)
+        return 2
+    merged = merge_traces(*load_sources(paths, urls))
+    if trace_id is None:
+        ids = trace_ids(merged)
+        print(
+            f"{len(ids)} trace id(s) across "
+            f"{len(merged['metadata']['sources'])} source(s): "
+            f"{merged['metadata']['sources']}",
+            file=out,
+        )
+        for tid in ids:
+            print(f"  {tid}", file=out)
+        return 0
+    try:
+        waterfall = request_waterfall(merged, trace_id)
+    except KeyError:
+        print(f"trace_id {trace_id!r} not found in merged trace",
+              file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps(waterfall, default=list), file=out)
+    else:
+        print(format_waterfall(waterfall), file=out)
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "traces", nargs="*", help="Perfetto trace JSON files to merge"
+    )
+    parser.add_argument(
+        "--url",
+        action="append",
+        default=[],
+        help="engine/door base URL whose /trace endpoint to scrape "
+        "(repeatable)",
+    )
+    parser.add_argument(
+        "--id", dest="trace_id", help="trace_id to decompose; omit to list"
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the waterfall dict as JSON instead of the table",
+    )
+    args = parser.parse_args(argv)
+    return run(args.traces, args.url, args.trace_id, as_json=args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

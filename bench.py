@@ -1580,6 +1580,322 @@ def bench_disttrace(
     return dt_doc
 
 
+def bench_perfwatch(
+    n_requests: int = 16,
+    arrival_rate_hz: float = 20.0,
+    seed: int = 0,
+    stall_phase: str = "dispatch",
+    stall_s: float = 0.05,
+    stall_after_steps: int = 20,
+    detect_budget_steps: int = 12,
+):
+    """Performance-observatory benchmark: the front-door Poisson workload
+    with the TSDB + roofline + regression detector off vs on, plus a
+    seeded ``slow_program`` chaos drill.
+
+    Three questions, answered into the ``perfwatch`` section of
+    ``BENCH_SERVING.json``:
+
+    * does the observatory COST anything? — bitwise greedy-token parity
+      observed-vs-off, plus TPOT p50 overhead as a median over
+      interleaved passes (same idiom as the ``obs``/``disttrace`` rows);
+    * does the detector WORK? — a chaos ``slow_program`` fault armed
+      mid-run stalls one engine phase persistently; the CUSUM must fire
+      within ``detect_budget_steps`` COMPARABLE samples (pure-decode
+      steps of the firing stratum — budget covers a fresh stratum's
+      median/MAD warm-up plus the CUSUM crossing) AND blame the stalled
+      phase (the stall is also asserted token-invariant — a sleep must
+      never change a greedy token). The drill pass runs CLOSED-LOOP
+      (all arrivals at t=0) so the decode stratum being regressed is
+      warm before injection: a stratum first seen mid-stall anchors its
+      baseline on stalled samples and honestly reports "normal";
+    * is it HONEST at steady state? — the clean observed pass must end
+      with zero alerts (false-positive row), and the TSDB memory bound
+      is recorded so the history shows it never grows.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_pytorch_tpu import chaos
+    from distributed_pytorch_tpu.models.transformer import TransformerLM
+    from distributed_pytorch_tpu.serving import (
+        FrontDoor,
+        InferenceEngine,
+        SamplingParams,
+        TenantConfig,
+    )
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    model = TransformerLM(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=8, d_ff=256,
+        dtype=jnp.float32 if on_cpu else jnp.bfloat16,
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / arrival_rate_hz, n_requests))
+    prompts = [
+        rng.integers(0, 256, int(rng.integers(4, 17))).tolist()
+        for _ in range(n_requests)
+    ]
+    tenant_of = [
+        "gold" if rng.random() < 1 / 3 else "bronze"
+        for _ in range(n_requests)
+    ]
+    sp = SamplingParams(max_new_tokens=16)
+    tenants = {
+        "gold": TenantConfig(weight=3.0, ttft_slo_s=2.0, tpot_slo_s=0.5),
+        "bronze": TenantConfig(weight=1.0, ttft_slo_s=5.0, tpot_slo_s=1.0),
+    }
+
+    def run_pass(observed: bool, drill: bool = False):
+        # A leaked plan from a previous pass would stall the clean
+        # passes; clear BEFORE engine construction, not just after.
+        os.environ.pop(chaos.ENV_VAR, None)
+        chaos._reset()
+        eng = InferenceEngine(
+            model, params, max_slots=8, max_seq_len=64, page_size=8,
+            token_budget=64, max_prefill_chunk=32, max_queue=n_requests,
+            timeseries=observed, xla_ledger=observed,
+        )
+        # Off-the-clock compile warm-up (same ladder as bench_frontdoor).
+        # For the observed pass this doubles as the detector's median/MAD
+        # warm-up: the compile-dominated steps land inside the robust
+        # window, so "normal" anchors at the steady-state level.
+        warm_rng = np.random.default_rng(seed + 1)
+        chunk = 1
+        while chunk <= 32:
+            warm = eng.submit(
+                warm_rng.integers(0, 256, chunk + 1).tolist(),
+                SamplingParams(max_new_tokens=2),
+            )
+            eng.run()
+            assert eng.poll(warm).finished
+            chunk *= 2
+
+        injected = {}
+        observer = None
+        if drill:
+            # Arm the stall AFTER warm-up so `at_step` counts Poisson
+            # steps: the detector gets a steady-state lead-in, then the
+            # level shifts mid-run. The observer pins the injection point
+            # in DETECTOR step coordinates: it fires inside the first
+            # stalled step, before that step's observe(), so the first
+            # regressed sample is regress.steps + 1.
+            os.environ[chaos.ENV_VAR] = json.dumps({
+                "faults": [{
+                    "kind": "slow_program",
+                    "phase": stall_phase,
+                    "duration": stall_s,
+                    "at_step": stall_after_steps,
+                }],
+            })
+            chaos._reset()
+
+            def observer(kind, step, mode):
+                if kind == "slow_program" and "regress_step" not in injected:
+                    injected["regress_step"] = eng.regress.steps + 1
+                    # Per-stratum sample counts at injection: the fire
+                    # event's stratum_samples minus this is detection
+                    # latency in COMPARABLE samples (prefill-mixed steps
+                    # are invisible to the detector by design).
+                    injected["stratum_n"] = {
+                        rows: s.n
+                        for (rows, name), s in eng.regress._watch.items()
+                        if name == "step_wall_seconds"
+                    }
+
+            chaos.add_fault_observer(observer)
+
+        # The overhead passes replay the Poisson tape; the drill runs
+        # CLOSED-LOOP (every request enqueued at t=0). A stratum born
+        # mid-stall anchors its median/MAD warm-up on stalled samples —
+        # it honestly believes the stall is normal and can never fire —
+        # so detection requires the batch shape being regressed to exist
+        # BEFORE injection. Closed-loop arrivals reach the steady-state
+        # decode stratum well before ``stall_after_steps``.
+        sched = np.zeros(n_requests) if drill else arrivals
+        door = FrontDoor(eng, tenants=tenants)
+        try:
+            t0 = time.perf_counter()
+            streams = []
+            delivered = [[] for _ in range(n_requests)]
+            next_i = 0
+            while next_i < n_requests or not all(s.done for s in streams):
+                now = time.perf_counter() - t0
+                while next_i < n_requests and sched[next_i] <= now:
+                    streams.append(
+                        door.open_stream(
+                            prompts[next_i], tenant_of[next_i], params=sp
+                        )
+                    )
+                    next_i += 1
+                door.pump()
+                for i, s in enumerate(streams):
+                    while s.backlog() > 0:
+                        delivered[i].append(next(s))
+            for i, s in enumerate(streams):
+                delivered[i].extend(s.drain())
+            wall = time.perf_counter() - t0
+        finally:
+            if observer is not None:
+                chaos.remove_fault_observer(observer)
+            os.environ.pop(chaos.ENV_VAR, None)
+            chaos._reset()
+
+        tpots = sorted(
+            (s.last_token_t - s.first_token_t) / (s.seen - 1)
+            for s in streams
+            if s.last_token_t is not None and s.seen > 1
+        )
+        row = {
+            "wall_s": round(wall, 4),
+            "tokens_per_sec": round(
+                sum(len(t) for t in delivered) / wall, 2
+            ),
+            "tpot_s_p50": (
+                round(float(np.quantile(tpots, 0.5)), 6) if tpots else None
+            ),
+        }
+        if observed:
+            ts = eng.timeseries.status()
+            row["timeseries_series"] = ts["series"]
+            row["timeseries_memory_bytes"] = ts["memory_bytes"]
+            row["alerts"] = eng.regress.alerts
+            if drill:
+                row["injected_at_regress_step"] = injected.get("regress_step")
+                row["injected_stratum_n"] = injected.get("stratum_n", {})
+                row["events"] = list(eng.regress.events)
+                row["attributed_phase"] = eng.regress.last_attribution
+            if eng.roofline is not None:
+                rep = eng.roofline.report()
+                row["roofline"] = {
+                    "dominant_bound": rep["dominant_bound"],
+                    "achieved_fraction": rep["achieved_fraction"],
+                    "step_floor_s": rep["step_floor_s"],
+                }
+        eng.close()
+        return row, delivered
+
+    row_off, tokens_off = run_pass(False)
+    row_on, tokens_on = run_pass(True)
+    row_drill, tokens_drill = run_pass(True, drill=True)
+
+    # Detection latency two ways. Raw engine steps from injection to fire
+    # tell the operator how long the slowdown ran; but under an open-loop
+    # arrival ramp most of those steps mix prefill (invisible to the
+    # stratified detector by design), so the BUDGET is asserted in
+    # comparable samples: pure-decode steps of the firing stratum between
+    # injection and fire (1 = fired on the very first regressed sample a
+    # fresh stratum could even compare).
+    injected_step = row_drill.get("injected_at_regress_step")
+    events = row_drill.get("events") or []
+    fire_event = next(
+        (e for e in events
+         if injected_step is not None and e["step"] >= injected_step),
+        None,
+    )
+    detection_latency = (
+        fire_event["step"] - injected_step + 1
+        if fire_event is not None else None
+    )
+    detection_latency_samples = None
+    if fire_event is not None and "stratum_samples" in fire_event:
+        pre = row_drill.get("injected_stratum_n", {}).get(
+            fire_event["decode_rows"], 0
+        )
+        detection_latency_samples = fire_event["stratum_samples"] - pre
+
+    # Median-over-interleaved-passes overhead, exactly like the
+    # obs/disttrace rows; parity + drill rows stay pinned to the first
+    # passes above.
+    tpots_off = [row_off["tpot_s_p50"]]
+    tpots_on = [row_on["tpot_s_p50"]]
+    for _ in range(2):
+        r_off_x, _ = run_pass(False)
+        r_on_x, _ = run_pass(True)
+        tpots_off.append(r_off_x["tpot_s_p50"])
+        tpots_on.append(r_on_x["tpot_s_p50"])
+    tpots_off = sorted(t for t in tpots_off if t)
+    tpots_on = sorted(t for t in tpots_on if t)
+    tpot_off = tpots_off[len(tpots_off) // 2] if tpots_off else None
+    tpot_on = tpots_on[len(tpots_on) // 2] if tpots_on else None
+
+    pw_doc = {
+        "n_requests": n_requests,
+        "arrival_rate_hz": arrival_rate_hz,
+        # Acceptance row 1: the observatory must not change a token —
+        # and neither may the injected stall (a sleep is not a sample).
+        "tokens_bitwise_identical": tokens_on == tokens_off,
+        "tokens_bitwise_identical_under_stall": tokens_drill == tokens_off,
+        # Acceptance row 2: the seeded drill.
+        "stall_phase": stall_phase,
+        "stall_s": stall_s,
+        "stall_after_steps": stall_after_steps,
+        "detector_fired": fire_event is not None,
+        "detection_latency_steps": detection_latency,
+        "detection_latency_decode_samples": detection_latency_samples,
+        "detect_budget_steps": detect_budget_steps,
+        # Budget in comparable samples: prefill-mixed ramp steps are
+        # invisible to the stratified detector by design, so they can't
+        # count against it (raw step latency is still reported above).
+        "detection_within_budget": (
+            detection_latency_samples is not None
+            and detection_latency_samples <= detect_budget_steps
+        ),
+        "attributed_phase": (
+            fire_event["attributed_phase"] if fire_event else None
+        ),
+        "attribution_correct": bool(
+            fire_event and fire_event["attributed_phase"] == stall_phase
+        ),
+        # Acceptance row 3: quiet when nothing is wrong, bounded memory.
+        "false_positive_alerts_clean_pass": row_on["alerts"],
+        "timeseries_series": row_on["timeseries_series"],
+        "timeseries_memory_bytes": row_on["timeseries_memory_bytes"],
+        "roofline": row_on.get("roofline"),
+        # Steady-state cost (same caveat as the obs/disttrace rows: an
+        # absolute per-step Python price reads large against a ~1.5ms
+        # CPU TPOT, small against real accelerator steps).
+        "tokens_per_sec_off": row_off["tokens_per_sec"],
+        "tokens_per_sec_on": row_on["tokens_per_sec"],
+        "tpot_s_p50_perfwatch_off": tpot_off,
+        "tpot_s_p50_perfwatch_on": tpot_on,
+        "tpot_p50_perfwatch_overhead": (
+            round(tpot_on / tpot_off - 1.0, 4)
+            if tpot_off and tpot_on else None
+        ),
+        "tpot_perfwatch_overhead_abs_s": (
+            round(tpot_on - tpot_off, 6)
+            if tpot_off and tpot_on else None
+        ),
+        "tpot_p50_perfwatch_passes": len(tpots_on),
+    }
+
+    # Merge next to the obs/fleet/frontdoor/disttrace sections;
+    # bench_history records it un-gated.
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_SERVING.json"
+    )
+    if os.path.exists(path):
+        with open(path) as f:
+            doc = json.load(f)
+    else:
+        doc = {
+            "mode": "serving_perfwatch_only",
+            "platform": jax.devices()[0].platform,
+            "device_kind": jax.devices()[0].device_kind,
+            "rows": [],
+        }
+    doc["perfwatch"] = pw_doc
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    return pw_doc
+
+
 def attach_mfu(result: dict, peak: float) -> dict:
     per_chip = result["flops_per_step"] * result["steps_per_sec"] / result["n_chips"]
     result["model_tflops_per_sec_per_chip"] = round(per_chip / 1e12, 2)
@@ -1742,6 +2058,16 @@ def main():
         ".jsonl row",
     )
     parser.add_argument(
+        "--perfwatch", action="store_true",
+        help="benchmark the performance observatory: the --frontdoor "
+        "Poisson workload with the TSDB + roofline + regression detector "
+        "off vs on (bitwise token parity, TPOT p50 overhead over "
+        "interleaved passes) plus a seeded slow_program chaos drill "
+        "asserting the CUSUM fires within budget and blames the stalled "
+        "phase; merges a 'perfwatch' section into BENCH_SERVING.json and "
+        "appends a BENCH_HISTORY.jsonl row",
+    )
+    parser.add_argument(
         "--shared-prefix-len", type=int, default=24, metavar="L",
         help="length of the system-prompt prefix every --serving request "
         "shares (0 = fully distinct prompts)",
@@ -1785,14 +2111,14 @@ def main():
 
     if sum(
         (args.scaling, args.window_sweep, args.serving, bool(args.fleet),
-         args.frontdoor, args.disttrace)
+         args.frontdoor, args.disttrace, args.perfwatch)
     ) > 1:
         # All are exclusive whole-run modes; silently preferring one would
         # burn a chip window on the wrong measurement (the queue scripts
         # run these as separate precious steps).
         parser.error("--scaling, --window_sweep, --serving, --fleet, "
-                     "--frontdoor and --disttrace are exclusive modes; "
-                     "run them as separate invocations")
+                     "--frontdoor, --disttrace and --perfwatch are "
+                     "exclusive modes; run them as separate invocations")
     scaling_metric = "dp_weak_scaling_efficiency"
     if args.scaling:
         metric, unit = scaling_metric, "ratio_vs_1dev"
@@ -1806,6 +2132,8 @@ def main():
         metric, unit = "frontdoor_tok_per_sec", "tok/s"
     elif args.disttrace:
         metric, unit = "disttrace_tpot_p50_overhead", "ratio"
+    elif args.perfwatch:
+        metric, unit = "perfwatch_tpot_p50_overhead", "ratio"
     else:
         metric, unit = "resnet50_bf16_train_steps_per_sec", "steps/s"
 
@@ -2010,6 +2338,60 @@ def run_benches(args, dev, peak):
         )
         # Same history contract as --frontdoor: record the refreshed
         # BENCH_SERVING.json (new disttrace section) un-gated.
+        import importlib.util
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        spec = importlib.util.spec_from_file_location(
+            "bench_history", os.path.join(here, "tools", "bench_history.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.main([
+            "append",
+            "--bench", os.path.join(here, "BENCH_SERVING.json"),
+            "--history", os.path.join(here, "BENCH_HISTORY.jsonl"),
+        ])
+        return
+
+    if args.perfwatch:
+        # Exclusive mode: the performance observatory off vs on over the
+        # front-door Poisson workload, plus the seeded slow_program
+        # drill. The headline is the TPOT p50 overhead ratio; the
+        # acceptance rows are bitwise token parity (observed AND under
+        # stall), in-budget detection with correct phase blame, and a
+        # zero-alert clean pass.
+        pw = bench_perfwatch()
+        print(
+            json.dumps(
+                {
+                    "metric": "perfwatch_tpot_p50_overhead",
+                    "value": pw["tpot_p50_perfwatch_overhead"],
+                    "unit": "ratio",
+                    "vs_baseline": 1.0,
+                    "tokens_bitwise_identical": pw[
+                        "tokens_bitwise_identical"
+                    ],
+                    "tokens_bitwise_identical_under_stall": pw[
+                        "tokens_bitwise_identical_under_stall"
+                    ],
+                    "detector_fired": pw["detector_fired"],
+                    "detection_latency_steps": pw["detection_latency_steps"],
+                    "detection_latency_decode_samples": pw[
+                        "detection_latency_decode_samples"
+                    ],
+                    "detection_within_budget": pw["detection_within_budget"],
+                    "attributed_phase": pw["attributed_phase"],
+                    "attribution_correct": pw["attribution_correct"],
+                    "false_positive_alerts": pw[
+                        "false_positive_alerts_clean_pass"
+                    ],
+                    "timeseries_memory_bytes": pw["timeseries_memory_bytes"],
+                    "tokens_per_sec_on": pw["tokens_per_sec_on"],
+                }
+            )
+        )
+        # Same history contract as --frontdoor/--disttrace: record the
+        # refreshed BENCH_SERVING.json (new perfwatch section) un-gated.
         import importlib.util
 
         here = os.path.dirname(os.path.abspath(__file__))

@@ -77,6 +77,22 @@ _FLEET_KINDS = (
     "slow_replica",
 )
 
+# Performance fault kinds: unlike every kind above, these do not kill,
+# hang, or disconnect anything — they make the engine SLOWER while it
+# keeps producing correct tokens, which is exactly the failure the
+# perf-regression detector (obs/regress.py) exists to catch. slow_program
+# stalls ONE named engine phase (schedule/cow/prefill/dispatch/readback)
+# by `duration` seconds per step, persistently from `at_step` on: a
+# seeded stand-in for a recompile landing on a worse layout or a DMA
+# path degrading. The engine polls serving_stall(phase) inside each
+# phase span, so the added time attributes to the right phase in traces,
+# the per-phase series, and the detector's blame.
+_PERF_KINDS = ("slow_program",)
+
+# Engine step phases a slow_program fault may target (the spans
+# InferenceEngine brackets with tracer.phase / its _phase helper).
+_ENGINE_PHASES = ("schedule", "cow", "prefill", "dispatch", "readback")
+
 _KINDS = (
     "kill",
     "hang",
@@ -85,7 +101,7 @@ _KINDS = (
     "drain",
     "corrupt_snapshot",
     "store_partition",
-) + _SERVING_KINDS + _FLEET_KINDS
+) + _SERVING_KINDS + _FLEET_KINDS + _PERF_KINDS
 
 
 class InjectedFault(RuntimeError):
@@ -170,6 +186,7 @@ class Fault:
     exit_code: int = 13
     min_queue: Optional[int] = None  # reclaim_under_queue_pressure threshold
     replica: Optional[int] = None  # fleet kinds: router attach-order index
+    phase: Optional[str] = None  # slow_program: engine phase to stall
 
     def __post_init__(self):
         if self.kind == "drain_at_step":
@@ -197,8 +214,26 @@ class Fault:
                     f"serving fault mode must be 'hard' or 'raise', "
                     f"got {self.mode!r}"
                 )
+        elif self.kind in _PERF_KINDS:
+            if self.phase not in _ENGINE_PHASES:
+                raise ValueError(
+                    f"{self.kind} requires 'phase', one of {_ENGINE_PHASES}; "
+                    f"got {self.phase!r}"
+                )
+            if self.duration <= 0.0:
+                raise ValueError(
+                    f"{self.kind} requires 'duration' > 0 (seconds of stall "
+                    "per step)"
+                )
+            # Engine-applied delay; signal/corrupt modes are meaningless.
+            self.mode = "stall"
         elif self.mode not in ("flip", "truncate"):
             raise ValueError(f"unknown corrupt mode {self.mode!r}")
+        if self.phase is not None and self.kind not in _PERF_KINDS:
+            raise ValueError(
+                f"'phase' only applies to perf kinds {_PERF_KINDS}, "
+                f"not {self.kind!r}"
+            )
         if self.min_queue is not None and self.kind != "reclaim_under_queue_pressure":
             raise ValueError(
                 f"min_queue only applies to reclaim_under_queue_pressure, "
@@ -398,6 +433,38 @@ class FaultPlan:
             self._fired.add(i)
             self._fire_serving(fault)
 
+    def serving_stall(self, phase: str) -> float:
+        """Seconds of injected stall due for engine phase ``phase`` on the
+        current serving step. Unlike the one-shot kinds, ``slow_program``
+        is PERSISTENT: it stalls every matching phase from ``at_step``
+        (lower bound, default 1) until the run ends — a perf regression
+        is a level shift, not a blip, and the detector's job is to notice
+        the sustained change. ``_fired`` marks first activation only (one
+        log line + observer notification, not one stall)."""
+        total = 0.0
+        for i, fault in enumerate(self.faults):
+            if fault.kind != "slow_program" or fault.phase != phase:
+                continue
+            due_step = fault.at_step if fault.at_step is not None else 1
+            if self._serving_steps < due_step:
+                continue
+            if not self._identity_matches(fault):
+                continue
+            if i not in self._fired:
+                self._fired.add(i)
+                print(
+                    f"[chaos] slow_program: stalling phase {phase!r} by "
+                    f"{fault.duration * 1e3:.1f}ms/step from serving step "
+                    f"{self._serving_steps}",
+                    flush=True,
+                )
+                _notify_observers(fault.kind, self._serving_steps, fault.mode)
+            total += fault.duration
+        return total
+
+    def has_perf_faults(self) -> bool:
+        return any(f.kind in _PERF_KINDS for f in self.faults)
+
     def on_fleet_step(self) -> List[Fault]:
         """Fleet chaos hook: the FleetRouter calls this once per pump
         round. Advances the fleet-round counter and returns the due fleet
@@ -577,6 +644,14 @@ def on_fleet_step() -> List[Fault]:
     if plan is None:
         return []
     return plan.on_fleet_step()
+
+
+def serving_stall(phase: str) -> float:
+    """Injected stall seconds due for this engine phase, 0.0 with no plan."""
+    plan = get_plan()
+    if plan is None:
+        return 0.0
+    return plan.serving_stall(phase)
 
 
 # ------------------------------------------------------------- FaultProxy

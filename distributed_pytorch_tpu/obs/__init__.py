@@ -31,7 +31,13 @@ disabled:
   per request across door/router/replicas, :func:`~.disttrace
   .merge_traces` clock-aligned assembly, :func:`~.disttrace
   .request_waterfall` exact-partition latency decomposition, and
-  :class:`~.disttrace.TraceSampler` head+tail sampling.
+  :class:`~.disttrace.TraceSampler` head+tail sampling;
+* the performance observatory — :class:`~.timeseries.TimeSeriesDB`
+  (fixed-memory multi-resolution history of every metric),
+  :class:`~.roofline.RooflineModel` (per-program arithmetic intensity,
+  compute- vs bandwidth-bound, achieved-fraction-of-roof), and
+  :class:`~.regress.RegressionDetector` (O(1)/tick CUSUM change-point
+  detection over step time / TPOT with per-phase blame).
 """
 
 from distributed_pytorch_tpu.obs.disttrace import (
@@ -61,16 +67,27 @@ from distributed_pytorch_tpu.obs.promtext import (
     ExpositionError,
     validate_exposition,
 )
+from distributed_pytorch_tpu.obs.regress import RegressionDetector
 from distributed_pytorch_tpu.obs.registry import (
     Counter,
     Gauge,
     MetricsRegistry,
+)
+from distributed_pytorch_tpu.obs.roofline import (
+    HBM_BYTES_PER_SEC,
+    RooflineModel,
+    hbm_bandwidth_per_chip,
+    roofline_point,
 )
 from distributed_pytorch_tpu.obs.server import IntrospectionServer, scrape
 from distributed_pytorch_tpu.obs.slo import (
     SLObjective,
     SLOMonitor,
     default_serving_objectives,
+)
+from distributed_pytorch_tpu.obs.timeseries import (
+    TimeSeriesDB,
+    sparkline,
 )
 from distributed_pytorch_tpu.obs.tracer import (
     NULL_TRACER,
@@ -86,6 +103,7 @@ __all__ = [
     "FlightRecorder",
     "Gauge",
     "GoodputTracker",
+    "HBM_BYTES_PER_SEC",
     "IntrospectionServer",
     "MetricsRegistry",
     "NULL_FLIGHT_RECORDER",
@@ -94,8 +112,11 @@ __all__ = [
     "NullTracer",
     "ProgramLedger",
     "RecompileSentinel",
+    "RegressionDetector",
+    "RooflineModel",
     "SLObjective",
     "SLOMonitor",
+    "TimeSeriesDB",
     "TraceSampler",
     "Tracer",
     "WATERFALL_COMPONENTS",
@@ -103,13 +124,16 @@ __all__ = [
     "default_serving_objectives",
     "flow_id",
     "format_waterfall",
+    "hbm_bandwidth_per_chip",
     "merge_traces",
     "peak_flops_per_chip",
     "prune_trace",
     "replay_to_tracer",
     "request_waterfall",
     "resnet50_train_flops",
+    "roofline_point",
     "scrape",
+    "sparkline",
     "trace_ids",
     "transformer_decode_flops_per_token",
     "transformer_train_flops",

@@ -227,6 +227,24 @@ class MetricsRegistry:
         with self.lock:
             return self._snapshot_locked(include_state)
 
+    def scalars(self) -> Dict[str, Dict[str, float]]:
+        """Counters and gauges only, qualified like :meth:`snapshot` but
+        WITHOUT reservoir summaries — those sort their samples to build
+        percentiles, far too expensive for the TSDB's once-per-engine-step
+        sampling tick (reservoir latencies are already windowed by the
+        reservoir itself; the derived per-step series cover that story)."""
+        with self.lock:
+            return {
+                "counters": {
+                    self._qualified(n): fn()
+                    for n, fn in self._counters.items()
+                },
+                "gauges": {
+                    self._qualified(n): fn()
+                    for n, fn in self._gauges.items()
+                },
+            }
+
     def _snapshot_locked(self, include_state: bool) -> Dict[str, dict]:
         counters = {
             self._qualified(n): fn() for n, fn in self._counters.items()

@@ -23,6 +23,13 @@ endpoint        body
 ``/requestz``   distributed-trace index; ``?id=<trace_id>`` returns that
                 request's latency waterfall computed over the merged
                 door/router/replica trace (404 untraced or unknown id)
+``/timeseries`` the performance observatory's TSDB as JSON:
+                ``?series=a,b`` selects series (default all),
+                ``?step=<seconds>`` picks the downsample resolution (0 =
+                raw samples), ``?window=<seconds>`` the trailing span
+                (404 when the engine has no TSDB)
+``/graphz``     human view of the same data: one unicode sparkline per
+                series, rendered server-side as monospace HTML
 ==============  ============================================================
 
 Thread safety: every handler goes through the engine's registry lock —
@@ -148,6 +155,10 @@ class IntrospectionServer:
                 self._send_json(handler, 200, doc)
         elif path == "/requestz":
             self._requestz(handler, query)
+        elif path == "/timeseries":
+            self._timeseries(handler, query)
+        elif path == "/graphz":
+            self._graphz(handler, query)
         elif path == "/postmortem":
             flight = getattr(eng, "flight", None)
             if flight is None or not getattr(flight, "enabled", False):
@@ -165,6 +176,7 @@ class IntrospectionServer:
                     "endpoints": [
                         "/metrics", "/healthz", "/statusz", "/snapshot",
                         "/trace", "/postmortem", "/requestz",
+                        "/timeseries", "/graphz",
                     ]
                 },
             )
@@ -207,6 +219,77 @@ class IntrospectionServer:
             )
             return
         self._send_json(handler, 200, waterfall)
+
+    def _timeseries_db(self):
+        return getattr(self.engine, "timeseries", None)
+
+    def _timeseries(self, handler: BaseHTTPRequestHandler, query: str) -> None:
+        """TSDB JSON export. ``?series=a,b`` filters (default: all),
+        ``?step=<s>`` picks resolution (0 = raw), ``?window=<s>`` the
+        trailing span (0 = full retention at that resolution)."""
+        db = self._timeseries_db()
+        if db is None:
+            self._send_json(
+                handler, 404, {"error": "engine has no timeseries db"}
+            )
+            return
+        params = urllib.parse.parse_qs(query)
+        wanted = params.get("series", [None])[0]
+        names = (
+            [n for n in wanted.split(",") if n] if wanted else None
+        )
+        try:
+            step = float(params.get("step", ["0"])[0])
+            window = float(params.get("window", ["0"])[0])
+        except ValueError as exc:
+            self._send_json(handler, 400, {"error": repr(exc)})
+            return
+        with self.engine.registry.lock:
+            doc = db.dump(names, step=step, window_s=window)
+        self._send_json(handler, 200, doc)
+
+    def _graphz(self, handler: BaseHTTPRequestHandler, query: str) -> None:
+        """Sparkline dashboard: one row per series (filtered the same way
+        as ``/timeseries``), newest values on the right, rendered as
+        monospace HTML with no javascript — readable over curl too."""
+        from distributed_pytorch_tpu.obs.timeseries import sparkline
+
+        db = self._timeseries_db()
+        if db is None:
+            self._send_json(
+                handler, 404, {"error": "engine has no timeseries db"}
+            )
+            return
+        params = urllib.parse.parse_qs(query)
+        wanted = params.get("series", [None])[0]
+        names = (
+            [n for n in wanted.split(",") if n]
+            if wanted
+            else db.series_names()
+        )
+        rows = []
+        with self.engine.registry.lock:
+            for name in names:
+                pts = db.points(name)
+                values = [v for _t, v in pts]
+                last = values[-1] if values else float("nan")
+                rows.append(
+                    f"<tr><td>{name}</td>"
+                    f"<td class='s'>{sparkline(values, 48)}</td>"
+                    f"<td class='v'>{last:.6g}</td></tr>"
+                )
+        html = (
+            "<!doctype html><html><head><title>graphz</title><style>"
+            "body{font-family:monospace;background:#111;color:#ddd}"
+            "td{padding:2px 8px}td.s{letter-spacing:0}"
+            "td.v{text-align:right;color:#8c8}"
+            "</style></head><body><h3>performance observatory</h3>"
+            "<table>" + "".join(rows) + "</table>"
+            "<p>raw JSON: <a href='/timeseries' style='color:#88c'>"
+            "/timeseries</a>?series=&amp;step=&amp;window=</p>"
+            "</body></html>"
+        )
+        self._send(handler, 200, html, "text/html; charset=utf-8")
 
     def _health(self) -> str:
         eng = self.engine

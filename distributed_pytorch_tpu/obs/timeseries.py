@@ -1,0 +1,586 @@
+"""Fixed-memory in-process time-series telemetry — the fleet's memory.
+
+The registry answers "what is the value NOW"; nothing before this module
+answered "how did it get here". :class:`TimeSeriesDB` gives every engine a
+bounded, multi-resolution history of its own metrics: each engine step,
+every registry counter and gauge plus the derived per-step serving series
+(step wall time, decode rows, tokens/sec, per-phase time, goodput
+fraction) is appended to a raw ring and folded into 10s and 60s
+downsampled rings. All three rings are fixed capacity, so memory is flat
+for the life of the process no matter how long the run — the property the
+long-run test pins.
+
+Memory bound (defaults): per series, ``raw_capacity`` (512) raw
+``(t, v)`` pairs + 360 ten-second buckets (1 hour) + 1440 sixty-second
+buckets (1 day), each bucket eight floats — ~30 KB per series, ~3 MB for
+a fully-instrumented engine's ~100 series. Nothing ever allocates on the
+sample path after the rings warm up.
+
+Downsampling keeps *sufficient statistics*, not lossy averages-of-
+averages: every bucket stores ``(first_t, first_v, last_t, last_v, sum,
+count, min, max)`` over the raw samples that landed in it. That makes the
+bucket-level queries EXACT reconstructions of the raw-level ones:
+
+* ``rate()`` over counters uses first/last cumulative values of the
+  covered span — identical to the raw delta, because counters are
+  cumulative and the bucket endpoints are real samples;
+* ``avg_over_time()`` over gauges uses ``sum(sums)/sum(counts)`` —
+  identical to the mean of the raw samples in those buckets;
+* ``quantile_over_time()`` is exact while the window fits the raw ring
+  and bucket-mean-approximate beyond it (documented, not hidden).
+
+The exactness contract is what the property test in
+``tests/test_timeseries.py`` checks against brute-force recomputation
+across ring-wrap boundaries.
+
+Counter-rate semantics: a "counter" series stores the CUMULATIVE value at
+each sample (registry convention); ``rate`` is ``(v_end - v_start) /
+(t_end - t_start)`` over the retained samples inside the window. There is
+no extrapolation and no reset detection — engines never reset counters
+mid-life (bench warm-up swaps the whole metrics object, which restarts
+the series from a new baseline sample).
+
+Cross-engine merge follows :meth:`MetricsRegistry.merge`:
+:meth:`TimeSeriesDB.merge` aligns ``dump()`` documents on wall-epoch
+bucket boundaries and combines per-bucket statistics (sums add, counts
+add, min/min, max/max, counters sum their cumulative endpoints), so a
+fleet-level tokens/sec series is one call away from per-replica scrapes.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Downsample resolutions (bucket width seconds -> ring capacity). 10s for
+# the last hour, 60s for the last day; both fixed, both tiny.
+DEFAULT_RESOLUTIONS: Tuple[Tuple[float, int], ...] = (
+    (10.0, 360),
+    (60.0, 1440),
+)
+
+
+class _Bucket:
+    """Sufficient statistics of the raw samples in one time bucket."""
+
+    __slots__ = (
+        "start", "first_t", "first_v", "last_t", "last_v",
+        "sum", "count", "min", "max",
+    )
+
+    def __init__(self, start: float, t: float, v: float):
+        self.start = start
+        self.first_t = self.last_t = t
+        self.first_v = self.last_v = v
+        self.sum = v
+        self.count = 1
+        self.min = v
+        self.max = v
+
+    def add(self, t: float, v: float) -> None:
+        self.last_t = t
+        self.last_v = v
+        self.sum += v
+        self.count += 1
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+
+    def to_list(self) -> list:
+        return [
+            self.start, self.first_t, self.first_v, self.last_t,
+            self.last_v, self.sum, self.count, self.min, self.max,
+        ]
+
+    @classmethod
+    def from_list(cls, row: Sequence[float]) -> "_Bucket":
+        b = cls.__new__(cls)
+        (
+            b.start, b.first_t, b.first_v, b.last_t, b.last_v,
+            b.sum, b.count, b.min, b.max,
+        ) = row
+        b.count = int(b.count)
+        return b
+
+
+class _Ring:
+    """Fixed-capacity append-only ring of :class:`_Bucket` at one
+    resolution. Buckets are time-ordered; appending a sample either folds
+    into the open (newest) bucket or seals it and opens the next,
+    evicting the oldest when full."""
+
+    __slots__ = ("step_s", "capacity", "buckets")
+
+    def __init__(self, step_s: float, capacity: int):
+        self.step_s = float(step_s)
+        self.capacity = int(capacity)
+        self.buckets: List[_Bucket] = []
+
+    def add(self, t: float, v: float) -> None:
+        start = math.floor(t / self.step_s) * self.step_s
+        if self.buckets and self.buckets[-1].start == start:
+            self.buckets[-1].add(t, v)
+            return
+        self.buckets.append(_Bucket(start, t, v))
+        if len(self.buckets) > self.capacity:
+            del self.buckets[0]
+
+    def covered(self, since: float) -> List[_Bucket]:
+        """Buckets whose span intersects ``[since, now]``."""
+        starts = [b.start for b in self.buckets]
+        i = bisect.bisect_left(starts, since - self.step_s)
+        return self.buckets[i:]
+
+    def covers(self, since: float) -> bool:
+        """True when the ring's retention reaches back to ``since`` —
+        i.e. no retained data was evicted after that instant (an empty
+        or never-wrapped ring covers everything it ever saw)."""
+        if len(self.buckets) < self.capacity:
+            return True
+        return self.buckets[0].start <= since
+
+
+class _Series:
+    """One named series: a raw ring plus the downsampled rings."""
+
+    __slots__ = ("name", "kind", "raw", "raw_capacity", "rings")
+
+    def __init__(
+        self,
+        name: str,
+        kind: str,
+        raw_capacity: int,
+        resolutions: Sequence[Tuple[float, int]],
+    ):
+        if kind not in ("counter", "gauge"):
+            raise ValueError(f"kind must be counter|gauge, got {kind!r}")
+        self.name = name
+        self.kind = kind
+        self.raw: List[Tuple[float, float]] = []
+        self.raw_capacity = int(raw_capacity)
+        self.rings = [_Ring(step, cap) for step, cap in resolutions]
+
+    def add(self, t: float, v: float) -> None:
+        self.raw.append((t, v))
+        if len(self.raw) > self.raw_capacity:
+            del self.raw[0]
+        for ring in self.rings:
+            ring.add(t, v)
+
+
+class TimeSeriesDB:
+    """Bounded multi-resolution telemetry store for one engine.
+
+    ``clock`` is injectable (tests drive synthetic timelines); it must be
+    the same monotonic clock the engine times steps with
+    (``time.perf_counter``). ``wall_epoch`` anchors that clock to wall
+    time once, at construction, so dumps from different engines can be
+    aligned for :meth:`merge`.
+    """
+
+    def __init__(
+        self,
+        *,
+        raw_capacity: int = 512,
+        resolutions: Sequence[Tuple[float, int]] = DEFAULT_RESOLUTIONS,
+        clock=time.perf_counter,
+    ):
+        if raw_capacity < 2:
+            raise ValueError("raw_capacity must be >= 2 (rate needs a delta)")
+        res = sorted((float(s), int(c)) for s, c in resolutions)
+        if any(s <= 0 or c < 1 for s, c in res):
+            raise ValueError(f"bad resolutions {resolutions!r}")
+        self.raw_capacity = int(raw_capacity)
+        self.resolutions = tuple(res)
+        self.clock = clock
+        # clock-time 0 in wall-epoch seconds: epoch_t = wall_epoch + t.
+        self.wall_epoch = time.time() - clock()
+        self.samples_taken = 0
+        self._series: Dict[str, _Series] = {}
+        self._registries: List = []
+
+    # ----------------------------------------------------------- ingestion
+
+    def track_registry(self, registry) -> None:
+        """Sample every counter and gauge of ``registry`` (by its
+        namespace-qualified snapshot names) on each :meth:`sample` call.
+        Reservoirs are deliberately not tracked — their percentiles are
+        already windowed by the reservoir itself, and the derived series
+        the engine records cover the latency story."""
+        self._registries.append(registry)
+
+    def series(self, name: str, kind: str) -> _Series:
+        s = self._series.get(name)
+        if s is None:
+            s = self._series[name] = _Series(
+                name, kind, self.raw_capacity, self.resolutions
+            )
+        elif s.kind != kind:
+            raise ValueError(
+                f"series {name!r} already registered as {s.kind}"
+            )
+        return s
+
+    def record(
+        self, name: str, value: float, *, kind: str = "gauge",
+        now: Optional[float] = None,
+    ) -> None:
+        """Append one sample. Counters must be CUMULATIVE values."""
+        t = self.clock() if now is None else now
+        self.series(name, kind).add(t, float(value))
+
+    def sample(self, now: Optional[float] = None, **derived: float) -> None:
+        """One sampling tick: snapshot every tracked registry's counters
+        and gauges, then record the ``derived`` keyword gauges. The engine
+        calls this once per accounted step, under the registry lock."""
+        t = self.clock() if now is None else now
+        self.samples_taken += 1
+        for registry in self._registries:
+            # scalars() skips reservoir-percentile sorts — this runs once
+            # per engine step, on the step path.
+            read = getattr(registry, "scalars", registry.snapshot)
+            snap = read()
+            for name, value in snap["counters"].items():
+                self.series(name, "counter").add(t, float(value))
+            for name, value in snap["gauges"].items():
+                if value is None or value != value:  # skip NaN gauges
+                    continue
+                self.series(name, "gauge").add(t, float(value))
+        for name, value in derived.items():
+            if value is None:
+                continue
+            self.series(name, "gauge").add(t, float(value))
+
+    # ------------------------------------------------------------- queries
+
+    def series_names(self) -> List[str]:
+        return sorted(self._series)
+
+    def kind_of(self, name: str) -> Optional[str]:
+        s = self._series.get(name)
+        return s.kind if s else None
+
+    def latest(self, name: str) -> Optional[Tuple[float, float]]:
+        s = self._series.get(name)
+        if s is None or not s.raw:
+            return None
+        return s.raw[-1]
+
+    def _resolve_window(
+        self, s: _Series, window_s: float, *,
+        resolution: Optional[float], now: Optional[float],
+    ):
+        """Pick the finest store covering ``[now-window_s, now]``: the raw
+        ring when its retention reaches back far enough, else the first
+        downsampled ring that does, else the coarsest. An explicit
+        ``resolution`` (0 = raw, else a bucket width) overrides."""
+        t_now = self.clock() if now is None else now
+        since = t_now - float(window_s)
+        if resolution is not None:
+            if resolution == 0:
+                return ("raw", [p for p in s.raw if p[0] >= since])
+            for ring in s.rings:
+                if ring.step_s == resolution:
+                    return ("buckets", ring.covered(since))
+            raise ValueError(
+                f"no ring at resolution {resolution}; have raw + "
+                f"{[r.step_s for r in s.rings]}"
+            )
+        if len(s.raw) < s.raw_capacity or (s.raw and s.raw[0][0] <= since):
+            return ("raw", [p for p in s.raw if p[0] >= since])
+        for ring in s.rings:
+            if ring.covers(since):
+                return ("buckets", ring.covered(since))
+        return ("buckets", s.rings[-1].covered(since))
+
+    def rate(
+        self, name: str, window_s: float, *,
+        resolution: Optional[float] = None, now: Optional[float] = None,
+    ) -> Optional[float]:
+        """Per-second increase of a counter over the trailing window:
+        ``(v_last - v_first) / (t_last - t_first)`` over retained samples
+        in the window. None with fewer than two samples."""
+        s = self._series.get(name)
+        if s is None:
+            return None
+        store, data = self._resolve_window(
+            s, window_s, resolution=resolution, now=now
+        )
+        if store == "raw":
+            if len(data) < 2:
+                return None
+            t0, v0 = data[0]
+            t1, v1 = data[-1]
+        else:
+            if not data:
+                return None
+            t0, v0 = data[0].first_t, data[0].first_v
+            t1, v1 = data[-1].last_t, data[-1].last_v
+        if t1 <= t0:
+            return None
+        return (v1 - v0) / (t1 - t0)
+
+    def avg_over_time(
+        self, name: str, window_s: float, *,
+        resolution: Optional[float] = None, now: Optional[float] = None,
+    ) -> Optional[float]:
+        """Mean of a gauge's samples over the trailing window (sample
+        mean, not time-weighted — steps tick at a near-constant cadence)."""
+        s = self._series.get(name)
+        if s is None:
+            return None
+        store, data = self._resolve_window(
+            s, window_s, resolution=resolution, now=now
+        )
+        if store == "raw":
+            if not data:
+                return None
+            return sum(v for _t, v in data) / len(data)
+        total = sum(b.sum for b in data)
+        count = sum(b.count for b in data)
+        return total / count if count else None
+
+    def quantile_over_time(
+        self, name: str, q: float, window_s: float, *,
+        now: Optional[float] = None,
+    ) -> Optional[float]:
+        """Trailing-window quantile. Exact over the raw ring; once the
+        window outgrows raw retention it falls back to the quantile of
+        the covering ring's bucket MEANS — an approximation, adequate for
+        dashboards, never used by the regression detector (which runs on
+        O(1) streaming statistics instead)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q must be in [0, 1], got {q}")
+        s = self._series.get(name)
+        if s is None:
+            return None
+        store, data = self._resolve_window(
+            s, window_s, resolution=None, now=now
+        )
+        if store == "raw":
+            values = sorted(v for _t, v in data)
+        else:
+            values = sorted(b.sum / b.count for b in data if b.count)
+        if not values:
+            return None
+        idx = min(len(values) - 1, int(q * len(values)))
+        return values[idx]
+
+    def points(
+        self, name: str, *, step: float = 0.0, window_s: float = 0.0,
+        now: Optional[float] = None,
+    ) -> List[Tuple[float, float]]:
+        """Plottable ``(t, value)`` pairs for one series — the payload of
+        ``/timeseries`` and the sparkline feeds. ``step=0`` returns raw
+        samples; ``step=W`` returns one point per W-second bucket. Gauges
+        plot their value (bucket mean when stepped); counters plot their
+        per-second RATE (delta to the previous sample / within-bucket
+        delta), which is the only graphable form of a cumulative series.
+        ``window_s=0`` means everything retained at that resolution."""
+        s = self._series.get(name)
+        if s is None:
+            return []
+        t_now = self.clock() if now is None else now
+        since = (t_now - window_s) if window_s else -math.inf
+        if step == 0:
+            raw = [p for p in s.raw if p[0] >= since]
+            if s.kind == "gauge":
+                return raw
+            out = []
+            for (t0, v0), (t1, v1) in zip(raw, raw[1:]):
+                if t1 > t0:
+                    out.append((t1, (v1 - v0) / (t1 - t0)))
+            return out
+        ring = None
+        for r in s.rings:
+            if r.step_s >= step:
+                ring = r
+                break
+        if ring is None:
+            ring = s.rings[-1]
+        buckets = ring.covered(since) if since > -math.inf else ring.buckets
+        if s.kind == "gauge":
+            return [(b.start, b.sum / b.count) for b in buckets if b.count]
+        out = []
+        prev: Optional[_Bucket] = None
+        for b in buckets:
+            if prev is not None and b.last_t > prev.last_t:
+                out.append(
+                    (b.start,
+                     (b.last_v - prev.last_v) / (b.last_t - prev.last_t))
+                )
+            prev = b
+        return out
+
+    # --------------------------------------------------------- merge / dump
+
+    def memory_bytes(self) -> int:
+        """Upper-bound estimate of retained-sample memory: 2 floats per
+        raw sample + 9 per bucket, 8 bytes each plus interpreter overhead
+        (~4x). Flat once the rings fill — the long-run test's gauge."""
+        floats = 0
+        for s in self._series.values():
+            floats += 2 * len(s.raw)
+            floats += sum(9 * len(r.buckets) for r in s.rings)
+        return floats * 32
+
+    def status(self) -> dict:
+        """The ``/statusz`` observatory block."""
+        return {
+            "series": len(self._series),
+            "samples_taken": self.samples_taken,
+            "raw_capacity": self.raw_capacity,
+            "resolutions": [
+                {"step_s": s, "buckets": c} for s, c in self.resolutions
+            ],
+            "memory_bytes": self.memory_bytes(),
+        }
+
+    def dump(
+        self, names: Optional[Iterable[str]] = None, *,
+        step: float = 0.0, window_s: float = 0.0,
+    ) -> dict:
+        """JSON-able export: per-series kind + plottable points at the
+        requested resolution, timestamps shifted to wall-epoch seconds so
+        documents from different engines share one timeline."""
+        wanted = sorted(names) if names is not None else self.series_names()
+        series = {}
+        for name in wanted:
+            s = self._series.get(name)
+            if s is None:
+                continue
+            pts = self.points(name, step=step, window_s=window_s)
+            series[name] = {
+                "kind": s.kind,
+                "points": [
+                    [self.wall_epoch + t, v] for t, v in pts
+                ],
+            }
+        return {
+            "wall_epoch": self.wall_epoch,
+            "step": step,
+            "window_s": window_s,
+            "series": series,
+        }
+
+    def export_state(self) -> dict:
+        """Full sufficient-statistics export (for :meth:`merge`): every
+        series' buckets at every resolution, timestamps in wall-epoch
+        seconds."""
+        out = {"resolutions": list(self.resolutions), "series": {}}
+        for name, s in self._series.items():
+            rings = {}
+            for ring in s.rings:
+                rows = []
+                for b in ring.buckets:
+                    row = b.to_list()
+                    # start, first_t, last_t are clock times; shift all
+                    # three to the shared wall-epoch timeline.
+                    row[0] += self.wall_epoch
+                    row[1] += self.wall_epoch
+                    row[3] += self.wall_epoch
+                    rows.append(row)
+                rings[repr(ring.step_s)] = rows
+            out["series"][name] = {"kind": s.kind, "rings": rings}
+        return out
+
+    @classmethod
+    def merge(cls, states: Sequence[dict]) -> dict:
+        """Combine :meth:`export_state` documents from several engines into
+        one document of the same shape — the registry-``merge`` analogue.
+        Buckets align on their wall-epoch start; gauge statistics combine
+        exactly (sums add, counts add, min/min, max/max), counter
+        cumulative endpoints SUM across engines (fleet total), with
+        first/last picked per-engine then summed, so a fleet ``rate()``
+        over the merged buckets equals the sum of per-engine rates over
+        aligned, fully-covered spans."""
+        merged: Dict[str, dict] = {}
+        resolutions = None
+        for state in states:
+            if resolutions is None:
+                resolutions = state["resolutions"]
+            for name, sdoc in state["series"].items():
+                slot = merged.setdefault(
+                    name, {"kind": sdoc["kind"], "rings": {}}
+                )
+                for step, rows in sdoc["rings"].items():
+                    ring = slot["rings"].setdefault(step, {})
+                    for row in rows:
+                        b = _Bucket.from_list(row)
+                        have = ring.get(b.start)
+                        if have is None:
+                            ring[b.start] = b
+                            continue
+                        # Same-bucket combine across engines.
+                        have.sum += b.sum
+                        have.count += b.count
+                        have.min = min(have.min, b.min)
+                        have.max = max(have.max, b.max)
+                        if sdoc["kind"] == "counter":
+                            # Fleet cumulative: endpoints add.
+                            have.first_v += b.first_v
+                            have.last_v += b.last_v
+                            have.first_t = max(have.first_t, b.first_t)
+                            have.last_t = min(have.last_t, b.last_t)
+                        else:
+                            if b.first_t < have.first_t:
+                                have.first_t, have.first_v = (
+                                    b.first_t, b.first_v
+                                )
+                            if b.last_t > have.last_t:
+                                have.last_t, have.last_v = (
+                                    b.last_t, b.last_v
+                                )
+        return {
+            "resolutions": resolutions or [],
+            "series": {
+                name: {
+                    "kind": doc["kind"],
+                    "rings": {
+                        step: [
+                            ring[start].to_list()
+                            for start in sorted(ring)
+                        ]
+                        for step, ring in doc["rings"].items()
+                    },
+                }
+                for name, doc in merged.items()
+            },
+        }
+
+
+def sparkline(values: Sequence[float], width: int = 32) -> str:
+    """Render ``values`` as a unicode sparkline (``▁``..``█``), resampled
+    to ``width`` columns. Shared by ``/graphz`` and ``tools/obs_top.py``.
+    Empty input renders as spaces; a flat series renders mid-height."""
+    blocks = "▁▂▃▄▅▆▇█"
+    if not values:
+        return " " * width
+    vals = list(values)
+    if len(vals) > width:
+        # Tail-biased resample: the newest samples are the interesting
+        # ones; one column per stride, mean within it.
+        stride = len(vals) / width
+        vals = [
+            sum(chunk) / len(chunk)
+            for chunk in (
+                vals[int(i * stride):max(int(i * stride) + 1,
+                                         int((i + 1) * stride))]
+                for i in range(width)
+            )
+        ]
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return ("▄" * len(vals)).rjust(width)
+    span = hi - lo
+    out = "".join(
+        blocks[min(len(blocks) - 1, int((v - lo) / span * len(blocks)))]
+        for v in vals
+    )
+    return out.rjust(width)
+
+
+__all__ = ["TimeSeriesDB", "DEFAULT_RESOLUTIONS", "sparkline"]

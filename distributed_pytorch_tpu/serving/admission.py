@@ -61,9 +61,15 @@ class AdmissionController:
         max_queue: int,
         max_request_tokens: int,
         max_queue_tokens: Optional[int] = None,
+        recent_rejections_max: int = 32,
     ):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if recent_rejections_max < 1:
+            raise ValueError(
+                "recent_rejections_max must be >= 1, got "
+                f"{recent_rejections_max}"
+            )
         self.max_queue = max_queue
         self.max_request_tokens = max_request_tokens
         self.max_queue_tokens = max_queue_tokens
@@ -80,9 +86,13 @@ class AdmissionController:
         # Last few rejections, keyed by the fleet-wide trace_id when the
         # caller supplied one: a request that never got past this gate has
         # no spans anywhere, so this ring is the only place ``/requestz``
-        # can point at to explain a missing trace.
+        # can point at to explain a missing trace. Bounded at
+        # ``recent_rejections_max`` entries (each a small dict — tens of
+        # bytes), so a rejection storm costs O(recent_rejections_max)
+        # memory, never O(rejections); the same eviction contract as the
+        # trace sampler's ``max_kept``.
         self.recent_rejections: "collections.deque[dict]" = (
-            collections.deque(maxlen=32)
+            collections.deque(maxlen=recent_rejections_max)
         )
 
     def close(self) -> None:

@@ -97,7 +97,10 @@ from distributed_pytorch_tpu.obs.goodput import (
     peak_flops_per_chip,
     transformer_decode_flops_per_token,
 )
+from distributed_pytorch_tpu.obs.regress import RegressionDetector
+from distributed_pytorch_tpu.obs.roofline import RooflineModel
 from distributed_pytorch_tpu.obs.slo import SLOMonitor, SLObjective
+from distributed_pytorch_tpu.obs.timeseries import TimeSeriesDB
 from distributed_pytorch_tpu.obs.tracer import NULL_TRACER, _PID_REQUESTS
 from distributed_pytorch_tpu.obs.xla import ProgramLedger, RecompileSentinel
 from distributed_pytorch_tpu.serving.admission import (
@@ -124,6 +127,40 @@ from distributed_pytorch_tpu.serving.scheduler import (
     SamplingParams,
     Scheduler,
 )
+
+
+class _PhaseSpan:
+    """Accounted step-phase context: enters the tracer's phase slice,
+    applies any chaos ``slow_program`` stall inside it, and accumulates
+    the phase's wall time into the engine's per-step ``_acct["phases"]``
+    scratch — the per-phase series the TSDB records and the regression
+    detector attributes blame with. Built by ``InferenceEngine._phase``
+    only when accounting or a perf fault is active."""
+
+    __slots__ = ("engine", "name", "stall", "_ctx", "_t0")
+
+    def __init__(self, engine, name: str, stall: float):
+        self.engine = engine
+        self.name = name
+        self.stall = stall
+
+    def __enter__(self):
+        self._ctx = self.engine.tracer.phase(self.name)
+        self._ctx.__enter__()
+        self._t0 = time.perf_counter()
+        if self.stall > 0.0:
+            time.sleep(self.stall)
+        return self
+
+    def __exit__(self, *exc):
+        acct = self.engine._acct
+        if acct is not None:
+            phases = acct["phases"]
+            phases[self.name] = (
+                phases.get(self.name, 0.0)
+                + (time.perf_counter() - self._t0)
+            )
+        return self._ctx.__exit__(*exc)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -208,6 +245,7 @@ class InferenceEngine:
         slo: Optional[Sequence[SLObjective]] = None,
         goodput=None,
         xla_ledger=None,
+        timeseries=None,
         max_live_adapters: int = 4,
     ):
         if max_seq_len % page_size:
@@ -392,11 +430,43 @@ class InferenceEngine:
         else:
             self.xla = None
             self.sentinel = None
+        # The performance observatory (obs/timeseries.py + obs/regress.py
+        # + obs/roofline.py). ``timeseries=True`` builds a default TSDB;
+        # pass a TimeSeriesDB for custom resolutions. Every registry
+        # counter/gauge plus the derived per-step series is sampled each
+        # accounted step; the CUSUM regression detector rides the same
+        # feed, and — when the XLA ledger is also on — a RooflineModel
+        # joins ledger bytes/FLOPs with the chip peaks. Pure host-side
+        # bookkeeping off the device path: tokens are bitwise-identical
+        # observatory-on vs -off (pinned in tests and the perfwatch bench).
+        if timeseries:
+            self.timeseries = (
+                timeseries
+                if isinstance(timeseries, TimeSeriesDB)
+                else TimeSeriesDB()
+            )
+            self.regress = RegressionDetector(
+                flight=self.flight, tracer=self.tracer
+            )
+        else:
+            self.timeseries = None
+            self.regress = None
+        if self.timeseries is not None and self.xla is not None:
+            self.roofline = RooflineModel(
+                self.xla,
+                self.timeseries,
+                device=jax.devices()[0],
+                fallback_flops_fn=self._analytic_program_flops(model),
+            )
+        else:
+            self.roofline = None
         # Introspection server handle (serve()/close()); while attached,
         # step()/submit() run under the registry lock so scrapes observe
         # step boundaries only.
         self._server = None
         self.registry = self._build_registry()
+        if self.timeseries is not None:
+            self.timeseries.track_registry(self.registry)
         # SLO burn-rate monitoring reads the registry it writes its
         # verdicts into, so one snapshot carries metrics AND alerts.
         self.slo = (
@@ -479,6 +549,44 @@ class InferenceEngine:
             n_devices=max(1, self._data_size * self._model_size),
         )
 
+    def _analytic_program_flops(self, model):
+        """Fallback FLOPs-per-call estimator for the roofline model, used
+        when a ledgered program's ``cost_analysis`` reports 0 (the CPU
+        backend omits flops — the same gap the goodput MFU path fills with
+        the analytic transformer model). Maps each engine program to the
+        decode FLOPs-per-token model by its token count per call."""
+        n_params = count_params(self.params)
+        embed = getattr(model, "vocab_size", 0) * getattr(
+            model, "d_model", 0
+        )
+        n_heads = max(1, getattr(model, "n_heads", 1))
+        head_dim = getattr(model, "d_model", 0) // n_heads
+        fpt = transformer_decode_flops_per_token(
+            n_params=n_params,
+            embed_params=min(embed, n_params),
+            n_layers=getattr(model, "n_layers", 0),
+            n_heads=n_heads,
+            head_dim=head_dim,
+            context_len=self.max_seq_len // 2,
+        )
+        max_slots, gamma = self.max_slots, self.gamma
+
+        def flops_for(record) -> float:
+            name = record.name
+            if "prefill_step_c" in name:
+                try:
+                    return fpt * int(name.rsplit("c", 1)[1])
+                except ValueError:
+                    return fpt
+            if name.startswith("decode_step"):
+                return fpt * max_slots
+            if name.startswith("spec_step"):
+                # gamma draft steps + one gamma-wide verify per slot.
+                return fpt * max_slots * (2 * gamma)
+            return 0.0  # copy_page and friends move bytes, not FLOPs
+
+        return flops_for
+
     def _build_registry(self) -> MetricsRegistry:
         """Every serving metric registered into one ``serving_``-namespaced
         :class:`MetricsRegistry`: the :class:`ServingMetrics` counters and
@@ -558,6 +666,34 @@ class InferenceEngine:
             self.xla.register_into(reg)
         if self.sentinel is not None:
             self.sentinel.register_into(reg)
+        if self.timeseries is not None:
+            ts = self.timeseries
+            reg.gauge_fn(
+                "timeseries_series",
+                lambda: float(len(ts.series_names())),
+                help="Series tracked by the in-process TSDB",
+            )
+            reg.gauge_fn(
+                "timeseries_memory_bytes",
+                lambda: float(ts.memory_bytes()),
+                help="Bounded TSDB retained-sample memory estimate",
+            )
+        if self.regress is not None:
+            # Late-bound through the engine attribute (not the instance)
+            # so a bench/test can swap in a differently-tuned detector
+            # before the first step without orphaning the metrics.
+            reg.counter_fn(
+                "perf_regressions_total",
+                lambda: float(self.regress.alerts),
+                help="Sustained perf-level shifts detected by CUSUM",
+            )
+            reg.gauge_fn(
+                "perf_regression_firing",
+                lambda: float(self.regress.firing),
+                help="1 after a perf regression until acknowledged",
+            )
+        if self.roofline is not None:
+            self.roofline.register_into(reg)
         if self.flight.enabled:
             fl = self.flight
             reg.counter_fn(
@@ -1186,6 +1322,7 @@ class InferenceEngine:
             and self.slo is None
             and not self.flight.enabled
             and self.xla is None
+            and self.timeseries is None
             and self._server is None
         ):
             return self._step_impl()
@@ -1193,6 +1330,7 @@ class InferenceEngine:
             t0 = time.perf_counter()
             self._acct = {
                 "plan": None, "rework": None, "emitted": 0, "proposed": 0,
+                "phases": {},
             }
             try:
                 finished = self._step_impl()
@@ -1243,6 +1381,31 @@ class InferenceEngine:
             )
         if self.slo is not None:
             self.slo.tick()
+        if self.timeseries is not None:
+            tpot = dt_s / emitted if emitted > 0 else None
+            derived = {
+                "step_wall_seconds": dt_s,
+                "decode_rows": float(decode_rows),
+                "prefill_tokens": float(prefill_tokens),
+                "tokens_per_sec": (emitted / dt_s) if dt_s > 0 else 0.0,
+            }
+            if tpot is not None:
+                derived["tpot_step_seconds"] = tpot
+            phases = acct.get("phases") or {}
+            for name, spent in phases.items():
+                derived[f"phase_{name}_seconds"] = spent
+            # One tick samples every tracked registry counter/gauge (the
+            # goodput fractions ride along as registry gauges) plus the
+            # derived serving series above.
+            self.timeseries.sample(**derived)
+            if self.regress is not None:
+                self.regress.observe(
+                    step_wall_seconds=dt_s,
+                    tpot_step_seconds=tpot,
+                    decode_rows=decode_rows,
+                    prefill_tokens=prefill_tokens,
+                    phases=phases,
+                )
 
     def _note_rework(self, req, start: int, chunk: int) -> None:
         """Charge the prefill positions below ``req.rework_until`` — K/V
@@ -1256,13 +1419,33 @@ class InferenceEngine:
             rework = self._acct["rework"] = {}
         rework[req.rework_kind] = rework.get(req.rework_kind, 0) + rw
 
+    def _phase(self, name: str):
+        """Step-phase span: the tracer's phase slice, plus (when the
+        accounting wrapper is active) per-phase wall-time accumulation
+        into ``_acct["phases"]`` — the series the regression detector
+        blames — and (when a chaos ``slow_program`` fault is armed) the
+        injected stall, slept INSIDE the span so traces, phase series,
+        and detector attribution all see the slowdown where it was
+        injected. With no accounting and no armed perf fault this returns
+        the tracer's own context, so the all-obs-off fast path stays one
+        attribute lookup away from the original code."""
+        plan = chaos.get_plan()
+        stall = (
+            plan.serving_stall(name)
+            if plan is not None and plan.has_perf_faults()
+            else 0.0
+        )
+        if self._acct is None and stall <= 0.0:
+            return self.tracer.phase(name)
+        return _PhaseSpan(self, name, stall)
+
     def _step_impl(self) -> List[int]:
         chaos.on_serving_phase(
             "step", queue_depth=self.scheduler.num_waiting
         )
         tr = self.tracer
         tr.begin_step()
-        with tr.phase("schedule"):
+        with self._phase("schedule"):
             plan = self.scheduler.schedule()
         if self._acct is not None:
             self._acct["plan"] = plan
@@ -1271,7 +1454,7 @@ class InferenceEngine:
             if self.xla is not None:
                 # Two staged int32 page-id scalars per CoW copy.
                 self.xla.count_h2d(8 * len(plan.copies))
-            with tr.phase("cow"):
+            with self._phase("cow"):
                 for _slot, src, dst in plan.copies:
                     # Copy-on-write fans out to every pool: the draft pool
                     # shares page ids with the target pool, so a page that
@@ -1286,7 +1469,7 @@ class InferenceEngine:
             # Nothing to dispatch — drain the outstanding readback (e.g.
             # the final token of the last request) before reporting idle.
             if self._inflight is not None:
-                with tr.phase("readback"):
+                with self._phase("readback"):
                     finished = self._resolve_inflight()
             else:
                 finished = []
@@ -1299,7 +1482,7 @@ class InferenceEngine:
 
         if plan.prefill:
             chaos.on_serving_phase("mid_prefill")
-            with tr.phase("prefill"):
+            with self._phase("prefill"):
                 for slot, chunk in plan.prefill:
                     req = self.scheduler.slots[slot]
                     start = req.len_cached
@@ -1330,7 +1513,7 @@ class InferenceEngine:
         finished: List[int] = []
         dispatched = None
         if plan.decode_slots:
-            with tr.phase("dispatch"):
+            with self._phase("dispatch"):
                 # Partition this step's decode rows. Async rows (no mods,
                 # or bias-only — their bias row is request-constant) keep
                 # the classic one-dispatch overlap via ``prev``/
@@ -1393,11 +1576,11 @@ class InferenceEngine:
         # Resolve LAST step's tokens now — the np.asarray sync overlaps
         # with the decode dispatched above.
         if self._inflight is not None:
-            with tr.phase("readback"):
+            with self._phase("readback"):
                 finished.extend(self._resolve_inflight())
         self._inflight = dispatched
         if not self.overlap and self._inflight is not None:
-            with tr.phase("readback"):
+            with self._phase("readback"):
                 finished.extend(self._resolve_inflight())
         self.metrics.observe_step(new_tokens=len(plan.decode_slots))
         if tr.enabled:
@@ -1415,7 +1598,7 @@ class InferenceEngine:
         tr = self.tracer
         dispatched = None
         if plan.decode_slots:
-            with tr.phase("dispatch"):
+            with self._phase("dispatch"):
                 self._stage_tables.fill(0)
                 self._stage_lens.fill(0)
                 for slot in plan.decode_slots:
@@ -1469,7 +1652,7 @@ class InferenceEngine:
 
         if plan.prefill:
             chaos.on_serving_phase("mid_prefill")
-            with tr.phase("prefill"):
+            with self._phase("prefill"):
                 for slot, chunk in plan.prefill:
                     req = self.scheduler.slots[slot]
                     start = req.len_cached
@@ -1499,7 +1682,7 @@ class InferenceEngine:
         finished: List[int] = []
         new_tokens = 0
         if dispatched is not None:
-            with tr.phase("readback"):
+            with self._phase("readback"):
                 emitted, n_acc, slot_reqs = dispatched
                 emitted_host = np.asarray(emitted)  # the ONE blocking sync
                 n_acc_host = np.asarray(n_acc)
@@ -1660,6 +1843,12 @@ class InferenceEngine:
                 out["xla"] = self.xla.metadata()
             if self.sentinel is not None:
                 out["recompile_sentinel"] = self.sentinel.status()
+            if self.timeseries is not None:
+                out["timeseries"] = self.timeseries.status()
+            if self.regress is not None:
+                out["perf_regress"] = self.regress.state()
+            if self.roofline is not None:
+                out["roofline"] = self.roofline.report()
             return out
 
     def arm_recompile_sentinel(self) -> RecompileSentinel:

@@ -1,0 +1,472 @@
+"""Performance-observatory unit/property tests: the TSDB's exactness
+contract and memory bound, the roofline math, and the stratified CUSUM
+regression detector. Pure Python — no JAX, no engine (the engine-level
+integration drill lives in ``tests/test_perfwatch.py``)."""
+
+import math
+
+import pytest
+
+from distributed_pytorch_tpu.obs.regress import RegressionDetector
+from distributed_pytorch_tpu.obs.registry import MetricsRegistry
+from distributed_pytorch_tpu.obs.roofline import (
+    HBM_BYTES_PER_SEC,
+    RooflineModel,
+    hbm_bandwidth_per_chip,
+    roofline_point,
+)
+from distributed_pytorch_tpu.obs.timeseries import (
+    DEFAULT_RESOLUTIONS,
+    TimeSeriesDB,
+    sparkline,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def make_db(**kw):
+    clock = FakeClock()
+    kw.setdefault("raw_capacity", 64)
+    kw.setdefault("resolutions", ((5.0, 12), (20.0, 24)))
+    db = TimeSeriesDB(clock=clock, **kw)
+    return db, clock
+
+
+# --------------------------------------------------------------- TSDB core
+
+
+class TestTimeSeriesDB:
+    def test_counter_rate_exact_within_raw_window(self):
+        db, clock = make_db()
+        import random
+
+        rng = random.Random(0)
+        shadow = []
+        total = 0.0
+        for _ in range(50):
+            total += rng.uniform(0, 5)
+            t = clock.advance(0.5)
+            db.record("toks", total, kind="counter", now=t)
+            shadow.append((t, total))
+        window = 10.0
+        since = clock.t - window
+        win = [p for p in shadow if p[0] >= since]
+        expect = (win[-1][1] - win[0][1]) / (win[-1][0] - win[0][0])
+        got = db.rate("toks", window, now=clock.t)
+        assert got == pytest.approx(expect, rel=1e-12)
+
+    def test_rate_exact_after_raw_ring_wrap(self):
+        """The headline exactness contract: once the window outgrows the
+        wrapped raw ring, rate() answers from downsampled buckets — and
+        because buckets keep REAL first/last samples, the answer equals
+        the brute-force delta over the same covered span of the full
+        (unbounded) shadow history."""
+        db, clock = make_db(raw_capacity=16, resolutions=((5.0, 1000),))
+        import random
+
+        rng = random.Random(1)
+        shadow = []
+        total = 0.0
+        for _ in range(400):  # raw keeps 16 samples = 8s; run 200s
+            total += rng.uniform(0, 3)
+            t = clock.advance(0.5)
+            db.record("toks", total, kind="counter", now=t)
+            shadow.append((t, total))
+        window = 100.0  # far beyond raw retention -> bucket path
+        since = clock.t - window
+        # Brute force over the documented covered span: all samples in
+        # buckets intersecting [since, now] (bucket width 5s).
+        covered = [
+            p for p in shadow
+            if math.floor(p[0] / 5.0) * 5.0 >= since - 5.0
+        ]
+        expect = (
+            (covered[-1][1] - covered[0][1])
+            / (covered[-1][0] - covered[0][0])
+        )
+        got = db.rate("toks", window, now=clock.t)
+        assert got == pytest.approx(expect, rel=1e-12)
+
+    def test_avg_over_time_exact_after_wrap(self):
+        db, clock = make_db(raw_capacity=16, resolutions=((5.0, 1000),))
+        import random
+
+        rng = random.Random(2)
+        shadow = []
+        for _ in range(400):
+            t = clock.advance(0.5)
+            v = rng.gauss(10.0, 2.0)
+            db.record("g", v, kind="gauge", now=t)
+            shadow.append((t, v))
+        window = 100.0
+        since = clock.t - window
+        covered = [
+            p for p in shadow
+            if math.floor(p[0] / 5.0) * 5.0 >= since - 5.0
+        ]
+        expect = sum(v for _t, v in covered) / len(covered)
+        got = db.avg_over_time("g", window, now=clock.t)
+        assert got == pytest.approx(expect, rel=1e-12)
+
+    def test_quantile_exact_over_raw(self):
+        db, clock = make_db()
+        import random
+
+        rng = random.Random(3)
+        vals = []
+        for _ in range(40):
+            t = clock.advance(0.5)
+            v = rng.uniform(0, 100)
+            db.record("g", v, kind="gauge", now=t)
+            vals.append(v)
+        window = 10.0
+        since = clock.t - window
+        win = sorted(
+            v for t, v in zip(
+                [0.5 * (i + 1) for i in range(40)], vals
+            ) if t >= since
+        )
+        got = db.quantile_over_time("g", 0.5, window, now=clock.t)
+        assert got == win[min(len(win) - 1, int(0.5 * len(win)))]
+
+    def test_memory_flat_over_10k_steps(self):
+        """Every ring wraps, then memory NEVER grows again — the fixed-
+        memory property the module docstring promises."""
+        db, clock = make_db(raw_capacity=32, resolutions=((2.0, 8), (8.0, 8)))
+        for i in range(2000):  # 1000s: wraps raw (16s), 2s (16s), 8s (64s)
+            t = clock.advance(0.5)
+            db.record("a", float(i), kind="counter", now=t)
+            db.record("b", math.sin(i / 10.0), kind="gauge", now=t)
+        plateau = db.memory_bytes()
+        peak = plateau
+        for i in range(10000):
+            t = clock.advance(0.5)
+            db.record("a", 2000.0 + i, kind="counter", now=t)
+            db.record("b", math.cos(i / 10.0), kind="gauge", now=t)
+            peak = max(peak, db.memory_bytes())
+        assert peak == plateau, (peak, plateau)
+        assert db.samples_taken == 0  # record() is not the sampling tick
+        assert db.status()["memory_bytes"] == db.memory_bytes()
+
+    def test_sample_tracks_registry_scalars_not_reservoirs(self):
+        db, clock = make_db()
+        reg = MetricsRegistry(namespace="t")
+        c = reg.counter("reqs_total")
+        g = reg.gauge("depth")
+        db.track_registry(reg)
+        c.inc(3)
+        g.set(7.0)
+        db.sample(now=clock.advance(1.0), step_wall_seconds=0.002)
+        c.inc(2)
+        db.sample(now=clock.advance(1.0), step_wall_seconds=0.003)
+        assert db.samples_taken == 2
+        assert db.kind_of("t_reqs_total") == "counter"
+        assert db.latest("t_reqs_total")[1] == 5.0
+        assert db.latest("t_depth")[1] == 7.0
+        assert db.latest("step_wall_seconds")[1] == 0.003
+        # scalars(): the cheap per-step read — counters+gauges only,
+        # qualified exactly like snapshot().
+        scal = reg.scalars()
+        snap = reg.snapshot()
+        assert scal["counters"] == snap["counters"]
+        assert scal["gauges"] == snap["gauges"]
+        assert set(scal) == {"counters", "gauges"}
+
+    def test_merge_fleet_counter_rate_sums(self):
+        docs = []
+        per_engine_rates = []
+        for k in range(2):
+            db, clock = make_db(resolutions=((5.0, 100),))
+            db.wall_epoch = 0.0  # align both engines on one timeline
+            total = 0.0
+            for i in range(40):
+                total += 2.0 + k  # engine 0: 2 tok/sample, engine 1: 3
+                db.record(
+                    "toks", total, kind="counter", now=clock.advance(0.5)
+                )
+            docs.append(db.export_state())
+            per_engine_rates.append(db.rate("toks", 15.0, now=clock.t))
+        merged = TimeSeriesDB.merge(docs)
+        rows = merged["series"]["toks"]["rings"]["5.0"]
+        # Fully-covered interior buckets: cumulative endpoints summed.
+        assert merged["series"]["toks"]["kind"] == "counter"
+        interior = rows[1]
+        first_v, last_v = interior[2], interior[4]
+        span = interior[3] - interior[1]
+        assert span > 0
+        fleet_rate = (last_v - first_v) / span
+        assert fleet_rate == pytest.approx(
+            sum(per_engine_rates), rel=0.25
+        )
+
+    def test_points_counter_plots_rate(self):
+        db, clock = make_db()
+        for i in range(10):
+            db.record(
+                "toks", 10.0 * i, kind="counter", now=clock.advance(1.0)
+            )
+        pts = db.points("toks")
+        assert len(pts) == 9
+        assert all(v == pytest.approx(10.0) for _t, v in pts)
+
+    def test_series_kind_conflict_raises(self):
+        db, clock = make_db()
+        db.record("x", 1.0, kind="counter", now=clock.advance(1.0))
+        with pytest.raises(ValueError):
+            db.record("x", 1.0, kind="gauge", now=clock.advance(1.0))
+
+    def test_dump_shape(self):
+        db, clock = make_db()
+        for i in range(5):
+            db.record("g", float(i), kind="gauge", now=clock.advance(1.0))
+        doc = db.dump(["g", "missing"])
+        assert set(doc["series"]) == {"g"}
+        assert doc["series"]["g"]["kind"] == "gauge"
+        assert len(doc["series"]["g"]["points"]) == 5
+        # Wall-epoch shift applied to every timestamp.
+        assert doc["series"]["g"]["points"][0][0] == pytest.approx(
+            db.wall_epoch + 1.0
+        )
+
+    def test_default_resolutions_memory_docstring_bound(self):
+        # ~30 KB/series at the defaults — keep the docstring honest.
+        per_series = 32 * (
+            2 * 512 + 9 * sum(c for _s, c in DEFAULT_RESOLUTIONS)
+        )
+        assert per_series < 600_000
+
+
+class TestSparkline:
+    def test_empty_is_spaces(self):
+        assert sparkline([], width=8) == " " * 8
+
+    def test_flat_is_mid_height(self):
+        out = sparkline([5.0, 5.0, 5.0], width=8)
+        assert out.strip() == "▄▄▄"
+
+    def test_resamples_to_width(self):
+        out = sparkline(list(range(100)), width=16)
+        assert len(out) == 16
+        assert out[0] == "▁" and out[-1] == "█"
+
+
+# ---------------------------------------------------------------- roofline
+
+
+class TestRoofline:
+    def test_point_bandwidth_bound(self):
+        p = roofline_point(
+            flops=1e9, hbm_bytes=1e9, peak_flops=100e12, peak_bw=800e9
+        )
+        assert p["bound"] == "bandwidth"
+        assert p["floor_s"] == pytest.approx(1e9 / 800e9)
+        assert p["intensity_flops_per_byte"] == pytest.approx(1.0)
+
+    def test_point_compute_bound(self):
+        p = roofline_point(
+            flops=1e12, hbm_bytes=1e6, peak_flops=100e12, peak_bw=800e9
+        )
+        assert p["bound"] == "compute"
+        assert p["floor_s"] == pytest.approx(1e12 / 100e12)
+
+    def test_point_degenerate(self):
+        p = roofline_point(0.0, 0.0, 100e12, 800e9)
+        assert p["bound"] == "unknown" and p["floor_s"] == 0.0
+
+    def test_bandwidth_table_lookup(self):
+        class Dev:
+            device_kind = "TPU v5 lite"
+
+        assert hbm_bandwidth_per_chip(Dev()) == HBM_BYTES_PER_SEC["v5 lite"]
+
+        class Unknown:
+            device_kind = "mystery"
+
+        assert hbm_bandwidth_per_chip(Unknown()) == 819e9
+
+    def test_model_joins_ledger_and_tsdb(self):
+        class Rec:
+            def __init__(self, flops, argb, outb, tmpb, calls):
+                self.name = "prog"
+                self.flops = flops
+                self.argument_bytes = argb
+                self.output_bytes = outb
+                self.temp_bytes = tmpb
+                self.calls = calls
+
+        class Ledger:
+            programs = {
+                "a": Rec(1e6, 8e6, 1e6, 1e6, 90),
+                "b": Rec(0.0, 1e6, 1e6, 0.0, 10),  # analytic fallback
+            }
+
+        db, clock = make_db()
+        for _ in range(10):
+            db.record(
+                "step_wall_seconds", 0.001, kind="gauge",
+                now=clock.advance(0.1),
+            )
+        m = RooflineModel(
+            Ledger(), db, peak_flops=100e12, peak_bw=800e9,
+            fallback_flops_fn=lambda r: 2e6, window_s=60.0,
+        )
+        rows = m.program_rows()
+        assert rows[0]["calls"] == 90
+        assert rows[1]["flops_source"] == "analytic"
+        assert rows[1]["flops"] == 2e6
+        floor = m.step_floor_s()
+        assert floor == pytest.approx(
+            (rows[0]["floor_s"] * 90 + rows[1]["floor_s"] * 10) / 100
+        )
+        rep = m.report()
+        assert rep["measured_step_s"] == pytest.approx(0.001)
+        assert 0.0 < rep["achieved_fraction"] <= 1.0
+        assert rep["dominant_bound"] == "bandwidth"
+
+    def test_gauges_serve_from_ttl_cache(self):
+        class Ledger:
+            programs = {}
+
+        m = RooflineModel(
+            Ledger(), None, peak_flops=1e12, peak_bw=1e12, cache_ttl_s=3600
+        )
+        reg = MetricsRegistry(namespace="t")
+        m.register_into(reg)
+        snap = reg.snapshot()
+        assert snap["gauges"]["t_roofline_step_floor_seconds"] == 0.0
+        # Mutating the ledger does NOT move the cached gauge inside TTL…
+        class Rec:
+            name, flops, calls = "p", 1e9, 1
+            argument_bytes = output_bytes = temp_bytes = 1e6
+
+        Ledger.programs = {"p": Rec()}
+        assert reg.snapshot()["gauges"]["t_roofline_step_floor_seconds"] == 0.0
+        # …but report() always recomputes exactly.
+        assert m.report()["step_floor_s"] > 0.0
+
+
+# --------------------------------------------------- regression detection
+
+
+def feed_clean(det, n, *, rows=4, wall=0.004, jitter=0.0002, seed=0,
+               phases=None):
+    import random
+
+    rng = random.Random(seed)
+    ev = None
+    for _ in range(n):
+        w = wall + rng.uniform(-jitter, jitter)
+        ph = dict(phases or {"dispatch": w * 0.5, "schedule": w * 0.2})
+        ev = det.observe(
+            step_wall_seconds=w, tpot_step_seconds=w / rows,
+            decode_rows=rows, prefill_tokens=0, phases=ph,
+        )
+    return ev
+
+
+class TestRegressionDetector:
+    def test_quiet_at_steady_state(self):
+        det = RegressionDetector()
+        feed_clean(det, 200)
+        assert det.alerts == 0 and not det.firing
+
+    def test_fires_on_sustained_shift_and_blames_phase(self):
+        det = RegressionDetector()
+        feed_clean(det, 60)
+        fired_at = None
+        for i in range(20):
+            w = 0.004 + 0.05  # persistent dispatch stall
+            ev = det.observe(
+                step_wall_seconds=w, tpot_step_seconds=w / 4,
+                decode_rows=4, prefill_tokens=0,
+                phases={"dispatch": 0.002 + 0.05, "schedule": 0.0008},
+            )
+            if ev is not None:
+                fired_at = i + 1
+                break
+        assert fired_at is not None and fired_at <= 4, fired_at
+        assert det.alerts == 1 and det.firing
+        event = det.events[-1]
+        assert event["attributed_phase"] == "dispatch"
+        assert event["decode_rows"] == 4
+        assert event["stratum_samples"] > 0
+        det.acknowledge()
+        assert not det.firing and det.alerts == 1
+
+    def test_single_spike_never_fires(self):
+        det = RegressionDetector()
+        feed_clean(det, 60)
+        ev = det.observe(
+            step_wall_seconds=10.0, tpot_step_seconds=2.5,
+            decode_rows=4, prefill_tokens=0,
+            phases={"dispatch": 9.0, "schedule": 0.5},
+        )
+        assert ev is None
+        feed_clean(det, 30)
+        assert det.alerts == 0
+
+    def test_load_shift_between_strata_stays_quiet(self):
+        """The stratification headline: traffic moving from 2-row steps
+        (fast) to 8-row steps (slow) is a LOAD change, not a regression —
+        an unstratified detector would page on it."""
+        det = RegressionDetector()
+        feed_clean(det, 60, rows=2, wall=0.002)
+        feed_clean(det, 60, rows=8, wall=0.008)  # 4x the level, new stratum
+        feed_clean(det, 60, rows=2, wall=0.002)
+        assert det.alerts == 0
+        assert sorted(det.state()["strata"]) == [2, 8]
+
+    def test_prefill_steps_skipped(self):
+        det = RegressionDetector()
+        det.observe(
+            step_wall_seconds=0.1, decode_rows=4, prefill_tokens=32
+        )
+        det.observe(step_wall_seconds=0.1, decode_rows=0)
+        assert det.steps == 2 and det.skipped_steps == 2
+        assert det.state()["strata"] == []
+
+    def test_refires_after_second_shift(self):
+        det = RegressionDetector()
+        feed_clean(det, 60)
+        feed_clean(
+            det, 20, wall=0.054,
+            phases={"dispatch": 0.052, "schedule": 0.0008},
+        )
+        assert det.alerts == 1  # rebaselined onto the new level
+        feed_clean(
+            det, 20, wall=0.104,
+            phases={"dispatch": 0.102, "schedule": 0.0008},
+        )
+        assert det.alerts == 2
+
+    def test_registry_export(self):
+        det = RegressionDetector()
+        reg = MetricsRegistry(namespace="t")
+        det.register_into(reg)
+        feed_clean(det, 60)
+        feed_clean(
+            det, 20, wall=0.054,
+            phases={"dispatch": 0.052, "schedule": 0.0008},
+        )
+        snap = reg.snapshot()
+        assert snap["counters"]["t_perf_regressions_total"] == 1.0
+        assert snap["gauges"]["t_perf_regression_firing"] == 1.0
+
+    def test_max_strata_bounds_memory(self):
+        det = RegressionDetector(max_strata=4)
+        for rows in range(1, 20):
+            det.observe(
+                step_wall_seconds=0.001 * rows, decode_rows=rows,
+                prefill_tokens=0,
+            )
+        assert len(det.state()["strata"]) == 4

@@ -137,6 +137,31 @@ def extract_row(bench: dict) -> dict:
             )
             if key in frontdoor
         }
+    perfwatch = bench.get("perfwatch")
+    if perfwatch:
+        # Un-gated like the fleet/frontdoor sections (a seeded mid-run
+        # stall makes the wall-clock numbers drill-shaped, not
+        # load-shaped) but recorded: the observatory-overhead and
+        # detection-latency trajectory is what the row is for.
+        out["perfwatch"] = {
+            key: perfwatch.get(key)
+            for key in (
+                "tokens_bitwise_identical",
+                "tokens_bitwise_identical_under_stall",
+                "detector_fired",
+                "detection_latency_steps",
+                "detection_latency_decode_samples",
+                "detection_within_budget",
+                "attributed_phase",
+                "attribution_correct",
+                "false_positive_alerts_clean_pass",
+                "timeseries_series",
+                "timeseries_memory_bytes",
+                "tpot_p50_perfwatch_overhead",
+                "tokens_per_sec_on",
+            )
+            if key in perfwatch
+        }
     return out
 
 

@@ -96,19 +96,29 @@ def analyze(logdir, n_steps, flops_per_step, peak_flops, peak_bw, bytes_per_step
         if bytes_per_step:
             # Bandwidth roofline from XLA's logical bytes (understates reuse
             # the caches capture; the xprof op_profile's measured HBM traffic
-            # is the sharper number when available).
-            intensity = flops_per_step / bytes_per_step
-            balance = peak_flops / peak_bw
-            ceiling = min(1.0, intensity / balance)
+            # is the sharper number when available). Same math as the serving
+            # observatory's per-program attribution — one source of truth.
+            from distributed_pytorch_tpu.obs.roofline import roofline_point
+
+            point = roofline_point(
+                flops_per_step, bytes_per_step, peak_flops, peak_bw
+            )
+            measured_s = per_step_ms / 1e3
+            frac = (
+                min(1.0, point["floor_s"] / measured_s)
+                if point["floor_s"] > 0 and measured_s > 0
+                else 0.0
+            )
             print(
-                f"intensity {intensity:.1f} FLOP/B vs machine balance "
-                f"{balance:.0f} FLOP/B -> "
-                + (
-                    f"bandwidth-bound: MFU ceiling {ceiling:.1%} at peak HBM "
-                    f"({peak_bw / 1e9:.0f} GB/s)"
-                    if ceiling < 1.0
-                    else "compute-bound at this intensity"
-                )
+                f"roofline: intensity "
+                f"{point['intensity_flops_per_byte']:.1f} FLOP/B vs ridge "
+                f"{point['ridge_flops_per_byte']:.0f} FLOP/B -> "
+                f"{point['bound']}-bound, floor "
+                f"{point['floor_s'] * 1e3:.3f} ms/step "
+                f"(compute {point['compute_floor_s'] * 1e3:.3f} / memory "
+                f"{point['memory_floor_s'] * 1e3:.3f}), achieved "
+                f"{frac:.1%} of the roofline at peak HBM "
+                f"({peak_bw / 1e9:.0f} GB/s)"
             )
     return op_time, cat_time, per_step_ms
 
@@ -267,10 +277,17 @@ def main():
     p.add_argument("--no-remat", dest="remat", action="store_false")
     p.add_argument("--logdir", default=None)
     p.add_argument(
-        "--peak_bw", type=float, default=819e9,
-        help="HBM bandwidth B/s for the roofline (v5e: 819 GB/s)",
+        "--peak_bw", type=float, default=None,
+        help="HBM bandwidth B/s for the roofline (default: by device kind "
+        "from obs.roofline.HBM_BYTES_PER_SEC; v5e-class 819 GB/s fallback)",
     )
     args = p.parse_args()
+    if args.peak_bw is None:
+        import jax
+
+        from distributed_pytorch_tpu.obs.roofline import hbm_bandwidth_per_chip
+
+        args.peak_bw = hbm_bandwidth_per_chip(jax.devices()[0])
     if args.workload == "lm" and args.remat is None:
         args.remat = False  # bench default: flash keeps activations linear in T
     if args.workload == "resnet":

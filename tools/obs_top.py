@@ -5,8 +5,12 @@ Polls one or more ``/statusz`` endpoints (see ``obs/server.py``) and
 redraws an ANSI dashboard: per-engine health, queue depth, running
 requests, page states, TTFT/TPOT percentiles, tokens/sec, SLO firing set,
 and the recompile-sentinel counter — plus the busiest in-flight requests
-of the first engine. Stdlib only, one process, no curses dependency (ANSI
-home+clear is enough and survives dumb terminals via ``--once``).
+of the first engine. Engines exposing the performance observatory's
+``/timeseries`` endpoint additionally get two sparkline columns
+(tokens/sec and per-step TPOT over the last minute); engines without it
+show ``-`` cells, nothing breaks. Stdlib only, one process, no curses
+dependency (ANSI home+clear is enough and survives dumb terminals via
+``--once``).
 
 Usage:
     python tools/obs_top.py http://127.0.0.1:8321 [more urls...]
@@ -52,6 +56,56 @@ def poll(url: str, timeout: float = 2.0) -> Optional[dict]:
         return None
 
 
+def poll_timeseries(
+    url: str,
+    timeout: float = 2.0,
+    window_s: float = 60.0,
+    series: str = "tokens_per_sec,tpot_step_seconds",
+) -> Optional[dict]:
+    """One ``/timeseries`` GET for the sparkline columns; None when the
+    engine predates the performance observatory (404) or is down — the
+    row just renders ``-`` cells."""
+    try:
+        with urllib.request.urlopen(
+            url.rstrip("/")
+            + f"/timeseries?series={series}&window={window_s:g}",
+            timeout=timeout,
+        ) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except Exception:
+        return None
+
+
+SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _spark(values: List[float], width: int = 12) -> str:
+    """Unicode sparkline of the trailing ``width`` values, min..max
+    normalised per cell (same ramp as ``obs.timeseries.sparkline``).
+    Charset-only — honors ``--no-color`` for free."""
+    vals = [
+        v for v in values if isinstance(v, (int, float)) and v == v
+    ][-width:]
+    if not vals:
+        return "-" * width
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return ("▄" * len(vals)).rjust(width)
+    top = len(SPARK_CHARS) - 1
+    return "".join(
+        SPARK_CHARS[min(top, int((v - lo) / (hi - lo) * top + 0.5))]
+        for v in vals
+    ).rjust(width)
+
+
+def _series_spark(ts_doc: Optional[dict], name: str, width: int = 12) -> str:
+    """Sparkline cell for one named series out of a ``/timeseries`` doc."""
+    if not ts_doc:
+        return "-" * width
+    points = (ts_doc.get("series", {}).get(name) or {}).get("points", [])
+    return _spark([p[1] for p in points if len(p) == 2], width)
+
+
 def _ms(value) -> str:
     """Seconds -> fixed-width milliseconds, '-' for missing/NaN."""
     if not isinstance(value, (int, float)) or value != value:
@@ -68,15 +122,23 @@ def _health_cell(health: str, color: bool) -> str:
 
 
 def render_frame(
-    polled: List[Tuple[str, Optional[dict]]], color: bool = True
+    polled: List[Tuple[str, Optional[dict]]],
+    color: bool = True,
+    timeseries: Optional[dict] = None,
 ) -> str:
-    """One dashboard frame from ``[(url, statusz-or-None), ...]``."""
+    """One dashboard frame from ``[(url, statusz-or-None), ...]``.
+
+    ``timeseries`` optionally maps url -> ``/timeseries`` doc (see
+    :func:`poll_timeseries`); when present the frame grows two sparkline
+    columns — tokens/sec and per-step TPOT over the polled window —
+    rendered entirely from the doc so this stays a pure function."""
     bold = BOLD if color else ""
     reset = RESET if color else ""
     lines = [
         f"{bold}{'ENGINE':<28} {'HEALTH':<8} {'Q':>4} {'RUN':>4} "
         f"{'PAGES f/r/i':>14} {'TTFT p50':>9} {'TPOT p50':>9} "
-        f"{'TPOT p95':>9} {'TOK/S':>8} {'RECOMP':>7}  SLO{reset}"
+        f"{'TPOT p95':>9} {'TOK/S':>8} {'TOK/S 60s':>12} "
+        f"{'TPOT 60s':>12} {'RECOMP':>7}  SLO{reset}"
     ]
     for url, doc in polled:
         name = url.replace("http://", "")[:28]
@@ -101,6 +163,7 @@ def render_frame(
         slo_cell = ",".join(firing) if firing else "ok"
         if color and firing:
             slo_cell = f"{RED}{slo_cell}{RESET}"
+        ts_doc = (timeseries or {}).get(url)
         lines.append(
             f"{name:<28} {_health_cell(doc.get('health', '?'), color)} "
             f"{doc.get('queue_depth', 0):>4} "
@@ -110,6 +173,8 @@ def render_frame(
             f"{_ms(latency.get('tpot_p50_s')):>9} "
             f"{_ms(latency.get('tpot_p95_s')):>9} "
             f"{latency.get('tokens_per_sec', 0) or 0:>8.1f} "
+            f"{_series_spark(ts_doc, 'tokens_per_sec'):>12} "
+            f"{_series_spark(ts_doc, 'tpot_step_seconds'):>12} "
             f"{recomp_cell}  {slo_cell}"
         )
     first = next((doc for _u, doc in polled if doc), None)
@@ -203,12 +268,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     args = parser.parse_args(argv)
     color = not args.no_color and sys.stdout.isatty()
-    render = render_tenant_frame if args.tenant else render_frame
     try:
         while True:
-            frame = render(
-                [(url, poll(url)) for url in args.urls], color=color
-            )
+            polled = [(url, poll(url)) for url in args.urls]
+            if args.tenant:
+                frame = render_tenant_frame(polled, color=color)
+            else:
+                frame = render_frame(
+                    polled,
+                    color=color,
+                    timeseries={
+                        url: poll_timeseries(url) for url in args.urls
+                    },
+                )
             if args.once:
                 sys.stdout.write(frame)
                 return 0

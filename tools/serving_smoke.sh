@@ -26,6 +26,7 @@
 #   bash tools/serving_smoke.sh mesh       # mesh-sharded scenario only
 #   bash tools/serving_smoke.sh frontdoor  # front-door scenario only
 #   bash tools/serving_smoke.sh disttrace  # fleet-wide tracing scenario
+#   bash tools/serving_smoke.sh perfwatch  # performance observatory drill
 #
 # The ``mesh`` scenario boots the engine on a (2,4) ("data","model") mesh
 # over 8 virtual CPU devices, replays a shared-prefix workload, and
@@ -417,6 +418,170 @@ print(
     f"{len(opened_pids)} lanes with failover_gap "
     f"{wf['components']['failover_gap'] * 1e3:.1f} ms, waterfall sums to "
     f"e2e within 5%, merged trace -> traces/fleet_trace.json"
+)
+EOF
+  exit 0
+fi
+
+# ``perfwatch``: the performance-observatory drill — TSDB + roofline +
+# regression detector ON, tokens bitwise-identical to a bare engine, the
+# /timeseries and /graphz wire views scraped mid-run, then a seeded
+# slow_program chaos stall that the CUSUM detector must notice within
+# budget AND blame on the stalled phase. Writes the full TSDB dump to
+# traces/timeseries_dump.json (uploaded as a CI artifact).
+if [ "$scenario" = "perfwatch" ]; then
+  env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python - <<'EOF'
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_pytorch_tpu import chaos
+from distributed_pytorch_tpu.models.transformer import TransformerLM
+from distributed_pytorch_tpu.obs.server import scrape
+from distributed_pytorch_tpu.obs.timeseries import TimeSeriesDB
+from distributed_pytorch_tpu.serving import InferenceEngine, SamplingParams
+
+VOCAB = 128
+model = TransformerLM(
+    vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+    dtype=jnp.float32,
+)
+params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+ENGINE_KW = dict(
+    max_slots=4, max_seq_len=32, page_size=4, token_budget=16,
+    max_prefill_chunk=8, debug=True,
+)
+sp = SamplingParams(max_new_tokens=6)
+rng = np.random.default_rng(11)
+prompts = [
+    rng.integers(0, VOCAB, int(n)).tolist() for n in rng.integers(3, 10, 6)
+]
+drill_prompts = [
+    rng.integers(0, VOCAB, int(n)).tolist() for n in rng.integers(3, 10, 6)
+]
+
+def replay(eng, batch):
+    rids = [eng.submit(p, sp) for p in batch]
+    eng.run()
+    return [eng.poll(r).generated for r in rids]
+
+# Bare reference: no observatory anywhere near the engine.
+ref_eng = InferenceEngine(model, params, **ENGINE_KW)
+ref = replay(ref_eng, prompts)
+ref_drill = replay(ref_eng, drill_prompts)
+ref_eng.close()
+
+os.environ.pop(chaos.ENV_VAR, None)
+chaos._reset()
+# Small raw ring (16 samples) so it WRAPS during the clean pass and the
+# flat-memory assertion below checks steady state, not a filling buffer.
+eng = InferenceEngine(
+    model, params, timeseries=TimeSeriesDB(raw_capacity=16),
+    xla_ledger=True, **ENGINE_KW
+)
+# Warm every prefill bucket + decode so the clean pass is compile-free
+# (this also runs the detector through its median/MAD warm-up).
+chunk = 1
+while chunk <= 8:
+    warm = eng.submit(
+        [(37 * chunk + i) % VOCAB for i in range(chunk + 1)],
+        SamplingParams(max_new_tokens=2),
+    )
+    eng.run()
+    assert eng.poll(warm).finished
+    chunk *= 2
+
+toks = replay(eng, prompts)
+assert toks == ref, "the performance observatory changed the tokens"
+assert eng.regress.alerts == 0, (
+    f"detector fired on a clean pass: {eng.regress.state()}"
+)
+assert eng.timeseries.status()["samples_taken"] > 16, (
+    "clean pass too short to wrap the raw ring"
+)
+mem_before = eng.timeseries.memory_bytes()
+
+# The wire views, mid-run: /timeseries JSON + /graphz sparklines.
+server = eng.serve()
+try:
+    ts = scrape(server.url, "/timeseries?series=step_wall_seconds,tokens_per_sec")
+    assert set(ts["series"]) == {"step_wall_seconds", "tokens_per_sec"}, ts
+    assert ts["series"]["step_wall_seconds"]["points"], "empty step series"
+    graphz = scrape(server.url, "/graphz")
+    assert "step_wall_seconds" in graphz, "graphz missing the step series"
+    assert any(c in graphz for c in "▁▂▃▄▅▆▇█"), "graphz has no sparklines"
+finally:
+    server.stop()
+
+# Seeded drill: stall the dispatch phase persistently from the 3rd step
+# of the drill batch. The detector must fire within budget and blame
+# dispatch — and the stall must not change a single greedy token.
+injected = {}
+
+def observer(kind, step, mode):
+    if kind == "slow_program" and "at" not in injected:
+        injected["at"] = eng.regress.steps + 1
+
+os.environ[chaos.ENV_VAR] = json.dumps({
+    "faults": [
+        {"kind": "slow_program", "phase": "dispatch",
+         "duration": 0.05, "at_step": 3}
+    ],
+})
+chaos._reset()  # re-arm from the env var (this also clears observers)
+chaos.add_fault_observer(observer)
+try:
+    drill_toks = replay(eng, drill_prompts)
+finally:
+    chaos.remove_fault_observer(observer)
+    os.environ.pop(chaos.ENV_VAR, None)
+    chaos._reset()
+
+assert drill_toks == ref_drill, "the injected stall changed the tokens"
+assert eng.regress.alerts >= 1, (
+    f"detector never fired under a persistent stall: {eng.regress.state()}"
+)
+event = eng.regress.events[0]
+latency = event["step"] - injected["at"] + 1
+assert 1 <= latency <= 10, (
+    f"detection took {latency} stalled steps (event {event}, "
+    f"injected at {injected['at']})"
+)
+assert event["attributed_phase"] == "dispatch", event
+snap = eng.registry.snapshot()
+assert snap["counters"]["serving_perf_regressions_total"] >= 1
+assert snap["gauges"]["serving_perf_regression_firing"] == 1.0
+
+# Memory bound: the drill added ~30 steps past a wrapped raw ring; only
+# a couple of fresh downsample buckets may appear, never per-step growth.
+mem_after = eng.timeseries.memory_bytes()
+assert mem_after <= mem_before * 1.05 + 8192, (mem_before, mem_after)
+
+os.makedirs("traces", exist_ok=True)
+with open("traces/timeseries_dump.json", "w") as f:
+    json.dump(
+        {
+            "status": eng.timeseries.status(),
+            "regress": eng.regress.state(),
+            "roofline": eng.roofline.report() if eng.roofline else None,
+            "dump": eng.timeseries.dump(),
+        },
+        f, indent=1, default=str,
+    )
+n_series = eng.timeseries.status()["series"]
+eng.close()
+
+print(
+    "[serving_smoke] PASS: perfwatch scenario, tokens identical with the "
+    f"observatory on AND under a seeded dispatch stall, detector fired "
+    f"after {latency} stalled step(s) blaming "
+    f"{event['attributed_phase']!r}, "
+    f"tsdb {n_series} series / "
+    f"{mem_after} bytes -> traces/timeseries_dump.json"
 )
 EOF
   exit 0

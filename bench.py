@@ -905,6 +905,7 @@ def bench_fleet(
     seed: int = 0,
     shared_prefix_len: int = 24,
     kill_round: int = 12,
+    procs: bool = False,
 ):
     """Routed-fleet benchmark with a mid-run replica kill: the SAME Poisson
     workload as ``bench_serving``, routed across ``n_replicas`` in-process
@@ -920,7 +921,14 @@ def bench_fleet(
     single-engine baseline TTFT p50. The acceptance row is
     ``greedy_tokens_match_single_engine``: every request (including the
     failed-over ones) must emit byte-identical greedy tokens to one
-    uninterrupted engine."""
+    uninterrupted engine.
+
+    ``procs=True`` runs the identical drill against ``n_replicas`` worker
+    SUBPROCESSES behind ``ProcessReplicaClient`` — the fault becomes a
+    real SIGKILL, detection a failed control call, and every metric rides
+    the localhost control plane. Reported as the ``fleet_procs`` section
+    so the in-process ``fleet`` row stays the baseline to compare the
+    process-isolation tax and failover spike against."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -1028,14 +1036,40 @@ def bench_fleet(
     os.environ[chaos.ENV_VAR] = json.dumps({
         "seed": seed,
         "faults": [
-            {"kind": "kill_replica", "replica": victim_idx,
-             "at_step": kill_round}
+            {"kind": (
+                "kill_replica_process" if procs else "kill_replica"
+            ), "replica": victim_idx, "at_step": kill_round}
         ],
     })
     chaos._reset()
-    router = FleetRouter(
-        [mk_engine() for _ in range(n_replicas)], probe_every=4
-    )
+    if procs:
+        from distributed_pytorch_tpu.serving import spawn_replica_clients
+
+        on_cpu_dtype = "float32" if on_cpu else "bfloat16"
+        worker_specs = [
+            {
+                "name": f"r{i}",
+                "model": dict(
+                    vocab_size=256, d_model=64, n_layers=2, n_heads=8,
+                    d_ff=256, dtype=on_cpu_dtype,
+                ),
+                "init_seed": 0,
+                "engine": dict(
+                    max_slots=4, max_seq_len=64, page_size=page_size,
+                    token_budget=64, max_prefill_chunk=32,
+                    max_queue=n_requests, prefix_cache=True,
+                ),
+                # Same off-the-clock warm-up as mk_engine: one request
+                # per prefill bucket (lengths 2..33), compiled before the
+                # clock starts.
+                "warm_chunks": [2, 3, 5, 9, 17, 33],
+            }
+            for i in range(n_replicas)
+        ]
+        members = spawn_replica_clients(worker_specs)
+    else:
+        members = [mk_engine() for _ in range(n_replicas)]
+    router = FleetRouter(members, probe_every=4)
     try:
         fleet_tokens, elapsed = drive(
             router.submit,
@@ -1057,11 +1091,12 @@ def bench_fleet(
             "requests_failed_over_total"
         )
         leaked = sum(
-            int(rep.engine.registry.read_gauge("pages_referenced"))
+            int(rep.client.read_gauge("pages_referenced"))
             for rep in router.replicas()
             if rep.state != "dead"
         )
         fleet_doc = {
+            "transport": "process" if procs else "in_process",
             "n_replicas": n_replicas,
             "workload": (
                 f"fleet{n_replicas}_poisson{arrival_rate_hz:g}hz"
@@ -1128,7 +1163,7 @@ def bench_fleet(
             "device_kind": jax.devices()[0].device_kind,
             "rows": [],
         }
-    doc["fleet"] = fleet_doc
+    doc["fleet_procs" if procs else "fleet"] = fleet_doc
     with open(path, "w") as f:
         json.dump(doc, f, indent=1)
     return fleet_doc
@@ -2040,6 +2075,15 @@ def main():
         "section into BENCH_SERVING.json",
     )
     parser.add_argument(
+        "--procs", action="store_true",
+        help="with --fleet N: run the replicas as worker SUBPROCESSES "
+        "behind ProcessReplicaClient — the kill becomes a real SIGKILL "
+        "and every metric rides the localhost control plane; merges a "
+        "'fleet_procs' section into BENCH_SERVING.json and appends a "
+        "BENCH_HISTORY.jsonl row (un-gated: the first row seeds the "
+        "cross-process baseline)",
+    )
+    parser.add_argument(
         "--frontdoor", action="store_true",
         help="benchmark the multi-tenant streaming front door under a "
         "mixed-tenant Poisson workload (streamed-vs-polled bitwise "
@@ -2240,11 +2284,15 @@ def run_benches(args, dev, peak):
         fleet = bench_fleet(
             n_replicas=args.fleet,
             shared_prefix_len=args.shared_prefix_len,
+            procs=args.procs,
         )
         print(
             json.dumps(
                 {
-                    "metric": "fleet_aggregate_tok_per_sec",
+                    "metric": (
+                        "fleet_procs_aggregate_tok_per_sec"
+                        if args.procs else "fleet_aggregate_tok_per_sec"
+                    ),
                     "value": fleet["aggregate_tokens_per_sec"],
                     "unit": "tok/s",
                     "vs_baseline": 1.0,
@@ -2263,6 +2311,25 @@ def run_benches(args, dev, peak):
                 }
             )
         )
+        if args.procs:
+            # The --procs contract includes the history row (un-gated —
+            # the first row seeds the cross-process baseline): load the
+            # gate module by path (tools/ is not a package) and append
+            # the fresh BENCH_SERVING.json to BENCH_HISTORY.jsonl.
+            import importlib.util
+
+            here = os.path.dirname(os.path.abspath(__file__))
+            spec = importlib.util.spec_from_file_location(
+                "bench_history",
+                os.path.join(here, "tools", "bench_history.py"),
+            )
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            mod.main([
+                "append",
+                "--bench", os.path.join(here, "BENCH_SERVING.json"),
+                "--history", os.path.join(here, "BENCH_HISTORY.jsonl"),
+            ])
         return
 
     if args.frontdoor:

@@ -71,10 +71,24 @@ _SERVING_PHASE = {
 # object for kill, stop reaching it for partition, delay its steps for
 # slow). That keeps this module free of any engine knowledge while the
 # drill stays seeded and declarative.
+#
+# The *_process kinds are the cross-process twins: when the targeted
+# replica is a real worker subprocess (serving/replica_worker.py behind a
+# ProcessReplicaClient) the router delivers the REAL failure —
+# kill_replica_process SIGKILLs the child, hang_replica_process SIGSTOPs
+# it (SIGCONT after `duration` seconds when > 0), and
+# partition_replica_process black-holes the control socket client-side for
+# `duration` seconds (0 = until the run ends). Applied to an in-process
+# replica they degrade to the nearest in-process semantics (kill ->
+# abandon, hang/partition -> unreachable), so one plan drives both fleet
+# shapes.
 _FLEET_KINDS = (
     "kill_replica",
     "partition_replica",
     "slow_replica",
+    "kill_replica_process",
+    "hang_replica_process",
+    "partition_replica_process",
 )
 
 # Performance fault kinds: unlike every kind above, these do not kill,

@@ -48,6 +48,7 @@ endpoint serves JSON, raw text for ``/metrics``.
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
 import urllib.error
@@ -68,7 +69,11 @@ _PROM = "text/plain; version=0.0.4; charset=utf-8"
 
 class IntrospectionServer:
     """HTTP introspection for one engine. ``port=0`` (default) binds an
-    ephemeral port — read it back from :attr:`port` / :attr:`url`.
+    ephemeral port — read it back from :attr:`port` / :attr:`url`. That is
+    the contract replica worker processes rely on: N workers on one host
+    each bind port 0 and REPORT the kernel-assigned port up to their
+    parent (the spawn handshake in ``serving/replica_worker.py``), so
+    fleet spawn never races on a port and never needs a port registry.
     Constructed-and-started by :meth:`InferenceEngine.serve`; usable
     standalone around anything exposing the same surface (``registry``,
     ``status()``, ``tracer``, ``flight``, ``admission``, ``_closed``)."""
@@ -358,7 +363,12 @@ def scrape(
             # mid-response stall raises are both OSError subclasses.
             if attempt + 1 >= attempts:
                 raise
-            time.sleep(backoff_s * (2 ** attempt))
+            # Full-jittered exponential backoff: a fleet of scrapers that
+            # all saw the same replica blip must not retry in lockstep and
+            # thundering-herd it the instant it comes back.
+            time.sleep(
+                backoff_s * (2 ** attempt) * (0.5 + random.random() * 0.5)
+            )
             continue
         if _JSON in ctype:
             return json.loads(body)

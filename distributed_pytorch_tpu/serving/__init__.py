@@ -30,6 +30,7 @@ from distributed_pytorch_tpu.serving.elastic import (
     DrainController,
     EngineSnapshot,
     RequestSnapshot,
+    SnapshotUnavailable,
     adopt_snapshot,
     drain_engine,
     publish_snapshot,
@@ -69,6 +70,16 @@ from distributed_pytorch_tpu.serving.mesh import (
     make_serving_mesh,
     mesh_fingerprint,
 )
+from distributed_pytorch_tpu.serving.replica import (
+    CircuitBreaker,
+    LocalReplicaClient,
+    ProcessReplicaClient,
+    ReplicaClient,
+    ReplicaDead,
+    ReplicaError,
+    ReplicaUnavailable,
+    spawn_replica_clients,
+)
 from distributed_pytorch_tpu.serving.scheduler import (
     PENDING_TOKEN,
     Request,
@@ -84,12 +95,14 @@ __all__ = [
     "AdmissionError",
     "AutoscalePolicy",
     "BlockTable",
+    "CircuitBreaker",
     "DrainController",
     "EngineDraining",
     "EngineSnapshot",
     "FleetRouter",
     "FrontDoor",
     "InferenceEngine",
+    "LocalReplicaClient",
     "ModState",
     "Mods",
     "NoLiveReplica",
@@ -98,7 +111,12 @@ __all__ = [
     "PagePoolGroup",
     "PagedBlockAllocator",
     "PrefixCache",
+    "ProcessReplicaClient",
     "QueueFull",
+    "ReplicaClient",
+    "ReplicaDead",
+    "ReplicaError",
+    "ReplicaUnavailable",
     "Request",
     "RequestSnapshot",
     "RequestState",
@@ -106,6 +124,7 @@ __all__ = [
     "SamplingParams",
     "Scheduler",
     "ServingMetrics",
+    "SnapshotUnavailable",
     "StepPlan",
     "TenantConfig",
     "TenantQuotaExceeded",
@@ -120,4 +139,5 @@ __all__ = [
     "publish_snapshot",
     "restore_engine",
     "snapshot_engine",
+    "spawn_replica_clients",
 ]

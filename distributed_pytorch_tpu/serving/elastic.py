@@ -59,6 +59,15 @@ from distributed_pytorch_tpu.serving.scheduler import (
 SNAPSHOT_VERSION = 1
 
 
+class SnapshotUnavailable(RuntimeError):
+    """No snapshot appeared under the polled key within the deadline.
+
+    Raised only by the bounded-poll mode of :func:`adopt_snapshot` /
+    :func:`fetch_snapshot_text` (``timeout_s`` set): the fail-fast mode
+    keeps returning ``[]`` / ``None`` so existing probe-style callers
+    ("adopt if a peer left something") stay cheap and exception-free."""
+
+
 @dataclasses.dataclass(frozen=True)
 class RequestSnapshot:
     """One admitted-but-unfinished request, as the codec persists it.
@@ -541,19 +550,59 @@ def publish_snapshot(store, key: str, snapshot: EngineSnapshot) -> None:
     store.set(key, snapshot.to_json())
 
 
+def fetch_snapshot_text(
+    store, key: str, *, timeout_s: float,
+    poll_interval_s: float = 0.02,
+) -> str:
+    """Poll ``store`` for ``key`` until it appears or ``timeout_s``
+    elapses, sleeping a jittered exponential backoff between probes
+    (capped at 0.25s so a snapshot published late in the window is still
+    picked up promptly). The race this covers: a dying replica's final
+    ``publish_snapshot`` can lose to the survivor's adoption attempt by
+    milliseconds, and failing fast there turns a clean hand-off into an
+    avoidable re-generation. Raises :class:`SnapshotUnavailable` on
+    deadline."""
+    import random
+
+    deadline = time.monotonic() + max(0.0, timeout_s)
+    sleep_s = max(1e-4, poll_interval_s)
+    while True:
+        text = store.get(key)
+        if text is not None:
+            return text
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise SnapshotUnavailable(
+                f"no snapshot under {key!r} after {timeout_s:.3f}s"
+            )
+        # Full jitter on the backoff: many survivors polling one store for
+        # one victim's key should not probe in lockstep.
+        time.sleep(min(remaining, sleep_s * (0.5 + random.random() * 0.5)))
+        sleep_s = min(sleep_s * 2.0, 0.25)
+
+
 def adopt_snapshot(
     engine, store, key: str, *, delete: bool = True,
-    rebase_ids: bool = False,
+    rebase_ids: bool = False, timeout_s: Optional[float] = None,
 ) -> List[int]:
     """Fetch a published snapshot and restore it into ``engine``; deletes
     the key afterwards by default (adopt-once). Returns the restored ids,
     or ``[]`` when no snapshot is published under ``key``.
     ``rebase_ids=True`` mints fresh ids on adoption (see
     :func:`restore_engine`) — required when one survivor adopts snapshots
-    from several peers whose id spaces overlap."""
-    text = store.get(key)
-    if text is None:
-        return []
+    from several peers whose id spaces overlap.
+
+    ``timeout_s`` switches from fail-fast to a bounded poll with jittered
+    backoff (see :func:`fetch_snapshot_text`): the adopter waits that long
+    for a not-yet-published key before raising
+    :class:`SnapshotUnavailable` — covering a publisher whose final write
+    races its own death."""
+    if timeout_s is None:
+        text = store.get(key)
+        if text is None:
+            return []
+    else:
+        text = fetch_snapshot_text(store, key, timeout_s=timeout_s)
     ids = restore_engine(
         engine, EngineSnapshot.from_json(text), rebase_ids=rebase_ids
     )

@@ -1,7 +1,7 @@
 """Routed replica fleet: prefix-affinity routing, health-checked
 token-identical failover, hedging with request-id dedup, and SLO-driven
-scale-out/scale-in over in-process :class:`~.engine.InferenceEngine`
-replicas.
+scale-out/scale-in over N replicas — in-process engines or real worker
+subprocesses, behind one :class:`~.replica.ReplicaClient` interface.
 
 One engine replica is production-shaped — elastic, chaos-drilled,
 observable — but a fleet needs three things no single replica provides:
@@ -63,14 +63,35 @@ already exists rather than new device code:
   replica down — both as observable route-table transitions, not
   orchestration outside the process.
 
+The router holds every replica through a :class:`~.replica.ReplicaClient`
+— :class:`~.replica.LocalReplicaClient` for an in-process engine
+(behaviorally identical to the pre-interface router; ``replica.engine``
+still exposes the real engine object), or
+:class:`~.replica.ProcessReplicaClient` for a replica worker SUBPROCESS
+that can genuinely crash. Cross-process robustness is breaker-shaped:
+each client carries a :class:`~.replica.CircuitBreaker`, and a
+breaker-open replica enters DEGRADED mode — excluded from rendezvous
+hashing and skipped by the pump, but its shadow snapshots are retained
+and it is NOT declared dead, so a hung (SIGSTOPped) replica costs the
+fleet capacity instead of tail latency and rejoins after one successful
+half-open probe. Death, for a process replica, means the process: the
+client observed the child exit (``ReplicaDead``), or the liveness
+deadline expired while it held work.
+
 Chaos integration: the router calls :func:`chaos.on_fleet_step` once per
-pump round; the armed plan's fleet faults (``kill_replica``,
-``partition_replica``, ``slow_replica``) come back as declarations and the
-router applies the damage — abandoning the engine object mid-flight for a
-kill (the in-process SIGKILL twin), refusing contact for a partition,
-sleeping before each step for a straggler. ``tests/test_serving_fleet.py``
-drills a seeded SIGKILL of one of three replicas mid-decode under Poisson
-load and asserts union token parity against a single-engine reference.
+pump round; the armed plan's fleet faults come back as declarations and
+the router applies the damage. In-process kinds (``kill_replica``,
+``partition_replica``, ``slow_replica``) damage the route table —
+abandoning the engine object mid-flight for a kill (the in-process
+SIGKILL twin), refusing contact for a partition, sleeping before each
+step for a straggler. Process kinds (``kill_replica_process``,
+``hang_replica_process``, ``partition_replica_process``) deliver REAL
+damage through the client — SIGKILL, SIGSTOP, a black-holed control
+socket — and degrade to the in-process semantics when the target replica
+is local. ``tests/test_serving_fleet.py`` drills a seeded SIGKILL of one
+of three replicas mid-decode under Poisson load and asserts union token
+parity against a single-engine reference; ``tests/test_fleet_procs.py``
+runs the same drill against real worker processes.
 """
 
 from __future__ import annotations
@@ -93,12 +114,16 @@ from distributed_pytorch_tpu.serving.elastic import (
     SNAPSHOT_VERSION,
     EngineSnapshot,
     RequestSnapshot,
-    adopt_snapshot,
-    drain_engine,
     publish_snapshot,
-    restore_engine,
 )
 from distributed_pytorch_tpu.serving.engine import RequestStatus
+from distributed_pytorch_tpu.serving.replica import (
+    LocalReplicaClient,
+    ReplicaClient,
+    ReplicaDead,
+    ReplicaError,
+    ReplicaUnavailable,
+)
 from distributed_pytorch_tpu.serving.scheduler import SamplingParams
 
 # Per-replica request-id namespace width. Replica k mints ids from
@@ -150,24 +175,35 @@ def _rendezvous(key: str, names: Sequence[str]) -> str:
 
 @dataclasses.dataclass
 class Replica:
-    """Route-table entry for one engine. ``state`` transitions:
+    """Route-table entry for one replica. ``state`` transitions:
     ``live -> draining`` (healthz 503 / drain notice; out of admission
     rotation, still stepped), ``-> dead`` (kill / probe threshold /
     liveness deadline; engine abandoned, work failed over), ``-> removed``
-    (clean drain handoff; engine closed and leak-checked)."""
+    (clean drain handoff; engine closed and leak-checked). Orthogonal to
+    ``state``, the client's circuit breaker adds a DEGRADED overlay: a
+    live replica whose breaker is open is skipped by routing and the pump
+    but keeps its shadows — capacity lost, no work lost."""
 
     name: str
-    engine: object
+    client: ReplicaClient
     index: int
     state: str = "live"
     url: Optional[str] = None
     last_ok_s: float = 0.0
     probe_failures: int = 0
     dead_reason: Optional[str] = None
-    # Chaos damage the router applies to itself:
+    # Chaos damage the router applies to itself (in-process fault kinds;
+    # process kinds deliver real signals through the client instead):
     killed_at: Optional[float] = None
     partitioned_until: Optional[float] = None
     slow_delay_s: float = 0.0
+
+    @property
+    def engine(self):
+        """The wrapped in-process engine (None for a process replica) —
+        the pre-interface surface, kept so local-fleet tests and drills
+        reach gauges and trackers exactly as before."""
+        return self.client.engine
 
 
 @dataclasses.dataclass
@@ -238,6 +274,7 @@ class FleetRouter:
         engines: Sequence = (),
         *,
         engine_factory: Optional[Callable[[], object]] = None,
+        replica_factory: Optional[Callable[[], ReplicaClient]] = None,
         affinity_pages: int = 1,
         spill_queue_depth: Optional[int] = None,
         probe_every: int = 4,
@@ -254,6 +291,12 @@ class FleetRouter:
         tracer=None,
     ):
         self.engine_factory = engine_factory
+        # Scale-out factory returning a ready ReplicaClient (either kind:
+        # a LocalReplicaClient, or a ProcessReplicaClient whose worker it
+        # already spawned). Preferred over engine_factory when both are
+        # given — the autoscaler graduates from constructing engines to
+        # spawning processes without the policy changing shape.
+        self.replica_factory = replica_factory
         # Router-level span lane (Perfetto pid 4): routing decisions,
         # hedge twin links, failover marks. NULL by default — the hot
         # path costs one attribute load when untraced.
@@ -337,20 +380,20 @@ class FleetRouter:
     def add_replica(
         self, engine, *, name: Optional[str] = None, serve: bool = False
     ) -> Replica:
-        """Attach one engine: fingerprint-check it against the fleet,
-        namespace its request ids (``index * id_stride`` — the collision
-        guard for multi-snapshot adoption), register its health gauge,
-        and put it in the admission rotation. ``serve=True`` starts its
-        introspection server and probes ``/healthz`` over HTTP instead of
-        in-process."""
-        fp = {
-            "page_size": engine.page_size,
-            "max_seq_len": engine.max_seq_len,
-            "top_k": engine._top_k,
-            "top_p": engine._top_p,
-            "speculative": engine.speculative,
-            "mesh": engine.mesh_fingerprint,
-        }
+        """Attach one replica — a bare engine (wrapped in a
+        :class:`~.replica.LocalReplicaClient`) or a ready
+        :class:`~.replica.ReplicaClient` of either kind. Fingerprint-check
+        it against the fleet, namespace its request ids
+        (``index * id_stride`` — the collision guard for multi-snapshot
+        adoption), register its health gauge, and put it in the admission
+        rotation. ``serve=True`` starts a local replica's introspection
+        server and probes ``/healthz`` over HTTP instead of in-process
+        (process replicas always serve)."""
+        client = (
+            engine if isinstance(engine, ReplicaClient)
+            else LocalReplicaClient(engine)
+        )
+        fp = client.fingerprint()
         if self._fingerprint is None:
             self._fingerprint = fp
         elif fp != self._fingerprint:
@@ -365,12 +408,12 @@ class FleetRouter:
             name = f"r{index}"
         if name in self._by_name:
             raise ValueError(f"replica name {name!r} already attached")
-        engine._next_id = max(engine._next_id, index * self.id_stride)
+        client.reserve_ids(index * self.id_stride)
         replica = Replica(
             name=name,
-            engine=engine,
+            client=client,
             index=index,
-            url=engine.serve().url if serve else None,
+            url=client.start_server() if serve else client.url,
             last_ok_s=self._clock(),
         )
         self._replicas.append(replica)
@@ -398,22 +441,29 @@ class FleetRouter:
         return until is not None and self._clock() < until
 
     def _eligible(self) -> List[Replica]:
+        """Replicas in the admission rotation: live, reachable, and
+        breaker-CLOSED. Degraded-mode rule: a breaker-open (or probing
+        half-open) replica is excluded from rendezvous hashing — its keys
+        re-rendezvous onto the survivors exactly as a dead replica's
+        would — but its shadows are retained and it is not failed over;
+        when the breaker closes, the same keys snap back."""
         return [
             r
             for r in self._replicas
-            if r.state == "live" and not self._unreachable(r)
+            if r.state == "live"
+            and not self._unreachable(r)
+            and r.client.breaker.state == "closed"
         ]
 
     def _load(self, replica: Replica) -> float:
         """Least-loaded signal, read from the replica's own registry
-        gauges (the same numbers a remote router would scrape)."""
-        reg = replica.engine.registry
-        return reg.read_gauge("queue_depth") + reg.read_gauge(
-            "running_requests"
-        )
+        gauges for a local replica and from the last step response's
+        piggybacked load for a process replica (no extra round-trip on
+        the routing hot path)."""
+        return replica.client.load()
 
     def _queue_depth(self, replica: Replica) -> float:
-        return replica.engine.registry.read_gauge("queue_depth")
+        return replica.client.queue_depth()
 
     # ------------------------------------------------------------- routing
 
@@ -479,7 +529,7 @@ class FleetRouter:
             if attempts > self.max_retries:
                 break
             try:
-                req_id = replica.engine.submit(
+                req_id = replica.client.submit(
                     prompt, params, metadata,
                     tenant_id=tenant_id, mods=mods, trace_id=trace_id,
                 )
@@ -499,6 +549,14 @@ class FleetRouter:
                     time.sleep(
                         self.retry_backoff_s * (2 ** (attempts - 1))
                     )
+                continue
+            except ReplicaError:
+                # Transport-level: the replica timed out, partitioned, or
+                # its process just exited. No admission answer was given
+                # (the client's own request-id dedup guarantees a retried
+                # submit never double-admits) — go straight to the next
+                # candidate; death, if that's what this was, is declared
+                # by the next pump round, not mid-submit.
                 continue
             fid = self._next_fid
             self._next_fid += 1
@@ -581,10 +639,31 @@ class FleetRouter:
                     continue  # unreachable: no step lands
             if replica.slow_delay_s > 0:
                 time.sleep(replica.slow_delay_s)
+            if replica.client.breaker.state == "open":
+                # Degraded mode: a breaker-open replica is not contacted
+                # at all (fast-fail costs zero deadline budget). Its
+                # shadows stay; the half-open probe below re-admits it.
+                continue
             try:
-                step_finished = replica.engine.step()
+                # When the breaker is half-open this step call IS the
+                # probe: success closes the breaker, failure re-opens it.
+                step_finished = replica.client.step()
             except chaos.InjectedFault:
                 self._mark_dead(replica, "injected_fault", died_at=now)
+                continue
+            except ReplicaDead as exc:
+                died_at = (
+                    replica.client.killed_at
+                    if replica.client.killed_at is not None
+                    else replica.last_ok_s
+                )
+                self._mark_dead(replica, exc.reason, died_at=died_at)
+                continue
+            except ReplicaUnavailable:
+                # Timed out / partitioned / breaker refused mid-call: no
+                # step landed, nothing to finalize. The breaker has done
+                # its bookkeeping; a hung replica degrades here instead
+                # of being declared dead.
                 continue
             replica.last_ok_s = self._clock()
             replica.probe_failures = 0
@@ -636,9 +715,11 @@ class FleetRouter:
         replica = self._by_name.get(shadow.replica)
         if replica is not None and replica.state not in ("dead", "removed"):
             try:
-                state = replica.engine.poll(shadow.req_id).state
+                state = replica.client.poll(shadow.req_id).state
             except KeyError:
                 state = "recovering"
+            except ReplicaError:
+                pass  # unreachable right now: the shadow view stands
         return RequestStatus(
             req_id=fid,
             state=state,
@@ -665,8 +746,8 @@ class FleetRouter:
             if replica is None or replica.state in ("dead", "removed"):
                 continue
             try:
-                replica.engine.cancel(rid)
-            except KeyError:
+                replica.client.cancel(rid)
+            except (KeyError, ReplicaError):
                 pass
         shadow.finished = True
         shadow.cancelled = True
@@ -679,7 +760,13 @@ class FleetRouter:
         fid = self._by_owner.get((replica.name, req_id))
         if fid is None:
             return None
-        status = replica.engine.poll(req_id)
+        try:
+            status = replica.client.poll(req_id)
+        except (KeyError, ReplicaError):
+            # The completion is real (the replica reported the id) but
+            # its status is briefly unreadable; the next round's
+            # re-delivery (process clients ack at-least-once) retries.
+            return None
         if status.state == "cancelled":
             return None  # a cancelled twin retires through finished ids too
         shadow = self._shadows[fid]
@@ -704,7 +791,10 @@ class FleetRouter:
         if twin is not None:
             other = self._by_name.get(twin[0])
             if other is not None and other.state not in ("dead", "removed"):
-                other.engine.cancel(twin[1])
+                try:
+                    other.client.cancel(twin[1])
+                except ReplicaError:
+                    pass  # twin replica unreachable: its copy is moot
         if self.tracer.enabled:
             self.tracer.span_end(
                 _PID_ROUTER, fid, "route",
@@ -730,8 +820,8 @@ class FleetRouter:
             else:
                 continue
             try:
-                status = replica.engine.poll(req_id)
-            except KeyError:
+                status = replica.client.poll(req_id)
+            except (KeyError, ReplicaError):
                 continue
             if len(status.generated) > len(shadow.generated):
                 shadow.generated = list(status.generated)
@@ -773,24 +863,33 @@ class FleetRouter:
                 pass  # probe cannot land; counts as a failure below
             else:
                 try:
-                    if replica.url is not None:
-                        from distributed_pytorch_tpu.obs.server import scrape
-
-                        doc = scrape(
-                            replica.url,
-                            "/healthz",
-                            timeout=self.probe_timeout_s,
-                            retries=0,
-                        )
-                        verdict = doc.get("status")
-                    else:
-                        verdict = replica.engine.health()
+                    verdict = replica.client.health(
+                        timeout_s=self.probe_timeout_s
+                    )
+                except ReplicaDead as exc:
+                    died_at = (
+                        replica.client.killed_at
+                        if replica.client.killed_at is not None
+                        else replica.last_ok_s
+                    )
+                    self._mark_dead(replica, exc.reason, died_at=died_at)
+                    continue
                 except Exception:
                     verdict = None
             if verdict is None:
                 replica.probe_failures += 1
                 self._c["probe_failures_total"].inc()
-                if replica.probe_failures >= self.probe_fail_threshold:
+                if (
+                    not replica.client.is_process
+                    and replica.probe_failures >= self.probe_fail_threshold
+                ):
+                    # In-process replicas have no other death signal, so
+                    # the probe threshold declares it. A PROCESS replica
+                    # that merely stops answering is DEGRADED, not dead —
+                    # its breaker excludes it, its shadows stay — because
+                    # the unambiguous death signal (the process exiting)
+                    # is observable directly; only the liveness deadline
+                    # above escalates a silent replica that holds work.
                     self._mark_dead(
                         replica, "probe_failures", died_at=replica.last_ok_s
                     )
@@ -901,7 +1000,7 @@ class FleetRouter:
                         to_replica=name,
                         committed_tokens=len(shadow.generated),
                     )
-            restore_engine(target.engine, self._snapshot_for(shadows, now))
+            target.client.restore(self._snapshot_for(shadows, now))
             for shadow in shadows:
                 shadow.replica = name
                 self._by_owner[(name, shadow.req_id)] = shadow.fid
@@ -994,12 +1093,12 @@ class FleetRouter:
                 continue
             target = min(others, key=lambda r: (self._load(r), r.index))
             try:
-                req_id = target.engine.submit(
+                req_id = target.client.submit(
                     list(shadow.prompt), shadow.params, shadow.metadata,
                     tenant_id=shadow.tenant_id, mods=shadow.mods,
                     trace_id=shadow.trace_id,
                 )
-            except AdmissionError:
+            except (AdmissionError, ReplicaError):
                 continue
             shadow.hedge_replica = target.name
             shadow.hedge_req_id = req_id
@@ -1038,17 +1137,17 @@ class FleetRouter:
         # cancel them rather than migrating a duplicate.
         for shadow in self._shadows.values():
             if not shadow.finished and shadow.hedge_replica == name:
-                replica.engine.cancel(shadow.hedge_req_id)
+                replica.client.cancel(shadow.hedge_req_id)
                 self._by_owner.pop((name, shadow.hedge_req_id), None)
                 shadow.hedge_replica = None
                 shadow.hedge_req_id = None
-        snap = drain_engine(replica.engine, reason="fleet_drain")
+        snap = replica.client.drain(reason="fleet_drain")
         # finish_inflight may have completed requests whose final readback
         # was in flight: deliver them before re-homing the remainder.
         for shadow in list(self._shadows.values()):
             if shadow.finished or shadow.replica != name:
                 continue
-            if replica.engine.poll(shadow.req_id).finished:
+            if replica.client.poll(shadow.req_id).finished:
                 self._finalize(replica, shadow.req_id)
         if snap.requests:
             survivors = [
@@ -1063,16 +1162,16 @@ class FleetRouter:
             if store is not None:
                 handoff_key = key or f"fleet/handoff/{name}"
                 publish_snapshot(store, handoff_key, snap)
-                adopt_snapshot(target.engine, store, handoff_key)
+                target.client.adopt(store, handoff_key)
             else:
-                restore_engine(target.engine, snap)
+                target.client.restore(snap)
             for shadow in self._shadows.values():
                 if shadow.finished or shadow.replica != name:
                     continue
                 self._by_owner.pop((name, shadow.req_id), None)
                 shadow.replica = target.name
                 self._by_owner[(target.name, shadow.req_id)] = shadow.fid
-        replica.engine.close()
+        replica.client.close()
         replica.state = "removed"
         self._c["drain_handoffs_total"].inc()
         return len(snap.requests)
@@ -1090,20 +1189,14 @@ class FleetRouter:
         # Scale OUT: any live replica's SLO burn-rate alert is firing.
         firing = []
         for replica in live:
-            slo = getattr(replica.engine, "slo", None)
-            if slo is None:
-                continue
-            firing.extend(
-                name
-                for name, st in slo.state().items()
-                if st["firing"]
-            )
+            firing.extend(replica.client.slo_firing())
+        factory = self.replica_factory or self.engine_factory
         if (
             firing
             and len(live) < policy.max_replicas
-            and self.engine_factory is not None
+            and factory is not None
         ):
-            replica = self.add_replica(self.engine_factory())
+            replica = self.add_replica(factory())
             self._c["scale_outs_total"].inc()
             self._last_scale_round = self._round
             print(
@@ -1116,14 +1209,9 @@ class FleetRouter:
         if len(live) > policy.min_replicas:
             idle_fractions = []
             for replica in live:
-                goodput = getattr(replica.engine, "goodput", None)
-                if goodput is None:
-                    continue
-                total = goodput.productive_s + goodput.wasted_total_s()
-                if total > 0:
-                    idle_fractions.append(
-                        goodput.wasted["budget_idle"] / total
-                    )
+                fraction = replica.client.idle_fraction()
+                if fraction is not None:
+                    idle_fractions.append(fraction)
             if idle_fractions and (
                 sum(idle_fractions) / len(idle_fractions)
                 >= policy.scale_in_idle_fraction
@@ -1155,12 +1243,36 @@ class FleetRouter:
         if replica.state in ("dead", "removed"):
             return
         now = self._clock()
-        if fault.kind == "kill_replica":
-            replica.killed_at = now
-        elif fault.kind == "partition_replica":
-            replica.partitioned_until = (
-                now + fault.duration if fault.duration > 0 else float("inf")
-            )
+        is_proc = replica.client.is_process
+        if fault.kind in ("kill_replica", "kill_replica_process"):
+            if is_proc:
+                # REAL damage: SIGKILL the worker. Detection stays the
+                # router's job — the next contact fails, exactly like the
+                # in-process twin's first touch of killed_at.
+                replica.client.kill(chaos_kind=fault.kind)
+            else:
+                replica.killed_at = now
+        elif fault.kind in ("partition_replica",
+                            "partition_replica_process"):
+            if is_proc and fault.kind == "partition_replica_process":
+                replica.client.partition(fault.duration)
+            else:
+                replica.partitioned_until = (
+                    now + fault.duration
+                    if fault.duration > 0 else float("inf")
+                )
+        elif fault.kind == "hang_replica_process":
+            if is_proc:
+                # SIGSTOP: sockets stay open, reads stall to the call
+                # deadline — the fault the circuit breaker exists for.
+                replica.client.suspend(fault.duration)
+            else:
+                # Nearest in-process semantics: unreachable for the
+                # window (an in-process engine cannot "hang" mid-call).
+                replica.partitioned_until = (
+                    now + fault.duration
+                    if fault.duration > 0 else float("inf")
+                )
         elif fault.kind == "slow_replica":
             replica.slow_delay_s = max(0.0, float(fault.duration))
 
@@ -1176,9 +1288,9 @@ class FleetRouter:
                 continue
             if replica.state == "dead" and not include_dead:
                 continue
-            snaps.append(
-                replica.engine.registry.snapshot(include_state=True)
-            )
+            snap = replica.client.metrics_snapshot()
+            if snap is not None:
+                snaps.append(snap)
         return MetricsRegistry.merge(snaps)
 
     def trace_documents(self) -> List[dict]:
@@ -1195,10 +1307,7 @@ class FleetRouter:
         for replica in self._replicas:
             if replica.state == "removed":
                 continue
-            tracer = getattr(replica.engine, "tracer", None)
-            if tracer is not None and getattr(tracer, "enabled", False):
-                with replica.engine.registry.lock:
-                    docs.append(tracer.to_perfetto())
+            docs.extend(replica.client.trace_documents())
         return docs
 
     def describe(self) -> dict:
@@ -1210,6 +1319,8 @@ class FleetRouter:
                 {
                     "name": r.name,
                     "state": r.state,
+                    "kind": r.client.kind,
+                    "breaker": r.client.breaker.state,
                     "index": r.index,
                     "url": r.url,
                     "dead_reason": r.dead_reason,
@@ -1241,21 +1352,19 @@ class FleetRouter:
 
     def close(self) -> None:
         """Close every live/draining replica (leak-checked, like a single
-        engine). Dead replicas' engines are NOT closed — a SIGKILLed
-        process never runs its destructors; survivors are the ones whose
-        quiescence the drill asserts — but their introspection servers
-        (router-side threads) are stopped."""
+        engine — a process replica runs its leak asserts INSIDE the
+        worker and a failure surfaces here as a
+        :class:`~.replica.ReplicaError`). Dead replicas are NOT closed —
+        a SIGKILLed process never runs its destructors; survivors are the
+        ones whose quiescence the drill asserts — but their residue
+        (router-side server threads, child pipes, an unreaped zombie) is
+        torn down via :meth:`~.replica.ReplicaClient.abandon`."""
         for replica in self._replicas:
             if replica.state in ("live", "draining"):
-                replica.engine.close()
+                replica.client.close()
                 replica.state = "removed"
             elif replica.state == "dead":
-                server = getattr(replica.engine, "_server", None)
-                if server is not None:
-                    try:
-                        server.stop()
-                    except Exception:
-                        pass
+                replica.client.abandon()
 
 
 __all__ = [

@@ -1,0 +1,484 @@
+"""Replica worker: one inference engine in its own process.
+
+Spawned by :class:`~.replica.ProcessReplicaClient` as
+``python -m distributed_pytorch_tpu.serving.replica_worker`` with its
+spec in the ``TPURUN_REPLICA_SPEC`` env var. The worker builds the
+engine, optionally warms the prefill shape buckets (so a fleet drill's
+failover TTFT measures routing, not XLA compiles), starts TWO servers on
+kernel-assigned ports —
+
+* the standard :class:`~distributed_pytorch_tpu.obs.server
+  .IntrospectionServer` (``/metrics`` ``/healthz`` ``/statusz``
+  ``/snapshot`` ``/trace`` ``/postmortem`` — everything a fleet scraper
+  or ``MetricsRegistry.merge_remote`` expects),
+* a control server (this module) carrying the data plane —
+
+then announces both in ONE hello line on stdout and serves until told to
+shut down (or until stdin hits EOF: the parent died, so exit rather than
+orphan).
+
+Control-plane wire format (all JSON over localhost HTTP):
+
+==================  ========================================================
+endpoint            semantics
+==================  ========================================================
+``POST /submit``    ``{rid, prompt, params, metadata, tenant_id, mods,
+                    trace_id}`` -> ``{req_id}``. ``rid`` is the client-
+                    minted idempotency key: a replayed rid returns the
+                    ORIGINAL req_id without re-admitting (the replay map
+                    that makes submit retry-safe). Admission refusals come
+                    back as 409 + exception class name.
+``POST /step``      ``{ack: [req_id...]}`` -> ``{finished, statuses, load,
+                    queue_depth, slo_firing, idle_fraction, trace?}``.
+                    ``finished`` is every finished-but-unacked id — an
+                    at-least-once protocol: a response lost in transport
+                    is re-reported next step until the client acks it.
+                    ``statuses`` carries every live + unacked request, so
+                    the client's shadow refresh costs zero extra calls.
+``GET /poll?id=``   one request's status; 404 (KeyError) when unknown.
+``POST /cancel``    ``{req_id}`` -> ``{ok}`` (False for unknown: engine
+                    cancel semantics, never raises).
+``POST /drain``     ``{reason}`` -> ``{snapshot, statuses}`` — the
+                    SIGTERM-with-notice protocol, run worker-side.
+``POST /restore``   ``{snapshot, rebase_ids}`` -> ``{restored}`` —
+                    fingerprint refusals come back as 409 ValueError.
+``POST /reserve_ids``  ``{base}`` -> ``{next_id}`` (id-space namespacing).
+``GET /health``     ``{status: live|draining|closed}`` (always 200 — the
+                    verdict is the payload; transport failure is the
+                    signal the breaker consumes).
+``GET /gauge?name=``  one registry gauge, for drill assertions.
+``GET /describe``   ``engine.status()`` (the /statusz document).
+``POST /shutdown``  close the engine (allocator leak asserts run HERE and
+                    surface as a 500 + non-zero exit), answer, exit 0.
+==================  ========================================================
+
+Mutating handlers serialize on one worker lock AND the engine's registry
+lock, so introspection scrapes keep their step-boundary-consistent view.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+_JSON = "application/json"
+SPEC_ENV = "TPURUN_REPLICA_SPEC"
+
+
+def _status_doc(status) -> dict:
+    return {
+        "req_id": status.req_id,
+        "state": status.state,
+        "prompt_len": status.prompt_len,
+        "generated": list(status.generated),
+        "finished": status.finished,
+        "preempt_count": status.preempt_count,
+    }
+
+
+def build_engine(spec: dict):
+    """Build the worker's engine from its spec: either a dotted
+    ``factory`` (``"pkg.mod:fn"`` called with ``factory_kwargs``) for
+    arbitrary setups, or the builtin demo path — ``model`` kwargs for
+    :class:`~distributed_pytorch_tpu.models.transformer.TransformerLM`,
+    ``init_seed`` for params, ``engine`` kwargs for the engine itself,
+    plus ``trace`` (bool) and ``flight`` ({capacity, path}) riders."""
+    if "factory" in spec:
+        import importlib
+
+        mod_name, _, fn_name = spec["factory"].partition(":")
+        fn = getattr(importlib.import_module(mod_name), fn_name)
+        return fn(**spec.get("factory_kwargs", {}))
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_pytorch_tpu.models.transformer import TransformerLM
+    from distributed_pytorch_tpu.obs import FlightRecorder, Tracer
+    from distributed_pytorch_tpu.serving.engine import InferenceEngine
+
+    model_kw = dict(spec.get("model", {}))
+    if "dtype" in model_kw:
+        model_kw["dtype"] = jnp.dtype(model_kw["dtype"])
+    model = TransformerLM(**model_kw)
+    params = model.init(
+        jax.random.PRNGKey(int(spec.get("init_seed", 0))),
+        jnp.zeros((1, 8), jnp.int32),
+    )["params"]
+    engine_kw = dict(spec.get("engine", {}))
+    if spec.get("trace"):
+        engine_kw["tracer"] = Tracer()
+    flight_spec = spec.get("flight")
+    if flight_spec:
+        engine_kw["flight"] = FlightRecorder(
+            int(flight_spec.get("capacity", 4096)),
+            path=flight_spec.get("path"),
+        )
+    return InferenceEngine(model, params, **engine_kw)
+
+
+def warm_engine(engine, chunks) -> None:
+    """Pre-compile the prefill shape buckets (one dummy request per
+    prompt length) plus the decode step, then drain — so the serving run
+    never pays an XLA compile mid-drill."""
+    from distributed_pytorch_tpu.serving.scheduler import SamplingParams
+
+    vocab = getattr(engine, "vocab_size", None) or 8
+    for n in chunks:
+        prompt = [(i % max(1, vocab - 2)) + 1 for i in range(int(n))]
+        engine.submit(prompt, SamplingParams(max_new_tokens=2))
+        engine.run()
+
+
+class ReplicaControlServer:
+    """The control half of the worker: a stdlib HTTP server whose
+    handlers drive the engine under one lock. Port 0 always — the caller
+    reads the kernel's choice from :attr:`url`."""
+
+    def __init__(
+        self,
+        engine,
+        *,
+        host: str = "127.0.0.1",
+        trace_every: int = 4,
+        flight_dump_every: int = 0,
+    ):
+        self.engine = engine
+        self.trace_every = max(1, int(trace_every))
+        self.flight_dump_every = int(flight_dump_every)
+        self._lock = threading.Lock()
+        self._replay = {}  # rid -> req_id (submit idempotency)
+        self._unacked = set()  # finished ids not yet acked by the client
+        self._steps = 0
+        self.shutdown_event = threading.Event()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                outer._route(self, None)
+
+            def do_POST(self):
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(
+                        self.rfile.read(length).decode("utf-8") or "{}"
+                    )
+                except ValueError:
+                    outer._send(self, 400, {
+                        "error_kind": "ValueError",
+                        "error": "malformed JSON body",
+                    })
+                    return
+                outer._route(self, body)
+
+        self._httpd = ThreadingHTTPServer((host, 0), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ReplicaControlServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="replica-control",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # ------------------------------------------------------------- routing
+
+    @staticmethod
+    def _send(handler, code: int, doc: dict) -> None:
+        payload = json.dumps(doc, default=str).encode("utf-8")
+        try:
+            handler.send_response(code)
+            handler.send_header("Content-Type", _JSON)
+            handler.send_header("Content-Length", str(len(payload)))
+            handler.end_headers()
+            handler.wfile.write(payload)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client gave up (deadline); at-least-once covers it
+
+    def _route(self, handler, body) -> None:
+        from distributed_pytorch_tpu.serving.admission import AdmissionError
+
+        parsed = urlparse(handler.path)
+        op = parsed.path.rstrip("/") or "/"
+        try:
+            if op == "/submit":
+                doc = self._submit(body)
+            elif op == "/step":
+                doc = self._step(body)
+            elif op == "/poll":
+                doc = self._poll(parse_qs(parsed.query))
+            elif op == "/cancel":
+                with self._lock:
+                    doc = {"ok": self.engine.cancel(int(body["req_id"]))}
+            elif op == "/drain":
+                doc = self._drain(body)
+            elif op == "/restore":
+                doc = self._restore(body)
+            elif op == "/reserve_ids":
+                with self._lock:
+                    self.engine._next_id = max(
+                        self.engine._next_id, int(body["base"])
+                    )
+                    doc = {"next_id": self.engine._next_id}
+            elif op == "/health":
+                doc = {"status": self.engine.health()}
+            elif op == "/gauge":
+                name = parse_qs(parsed.query).get("name", [""])[0]
+                doc = {
+                    "name": name,
+                    "value": self.engine.registry.read_gauge(name),
+                }
+            elif op == "/describe":
+                doc = self.engine.status()
+            elif op == "/shutdown":
+                doc = self._shutdown()
+            else:
+                self._send(handler, 404, {
+                    "error_kind": "NotFound", "error": op,
+                })
+                return
+        except AdmissionError as exc:
+            # An ANSWER, not a failure: the class name crosses the wire
+            # and the client re-raises the real admission type.
+            self._send(handler, 409, {
+                "error_kind": type(exc).__name__, "error": str(exc),
+            })
+            return
+        except KeyError as exc:
+            self._send(handler, 404, {
+                "error_kind": "KeyError", "error": str(exc),
+            })
+            return
+        except ValueError as exc:
+            self._send(handler, 409, {
+                "error_kind": "ValueError", "error": str(exc),
+            })
+            return
+        except Exception as exc:  # handler bug or engine crash: 500
+            self._send(handler, 500, {
+                "error_kind": type(exc).__name__, "error": repr(exc),
+            })
+            return
+        self._send(handler, 200, doc)
+        if op == "/shutdown":
+            self.shutdown_event.set()
+
+    # ------------------------------------------------------------ handlers
+
+    def _submit(self, body: dict) -> dict:
+        from distributed_pytorch_tpu.serving.mods import Mods
+        from distributed_pytorch_tpu.serving.scheduler import SamplingParams
+
+        rid = body.get("rid")
+        with self._lock:
+            if rid is not None and rid in self._replay:
+                # Idempotent replay: the first attempt's admission stands.
+                return {"req_id": self._replay[rid], "replayed": True}
+            pdoc = dict(body.get("params") or {})
+            pdoc["stop_sequences"] = tuple(
+                tuple(int(t) for t in seq)
+                for seq in pdoc.get("stop_sequences", ())
+            )
+            params = SamplingParams(**pdoc)
+            mods = (
+                Mods.from_spec(body["mods"]) if body.get("mods") else None
+            )
+            req_id = self.engine.submit(
+                [int(t) for t in body["prompt"]],
+                params,
+                body.get("metadata"),
+                tenant_id=body.get("tenant_id") or "anon",
+                mods=mods,
+                trace_id=body.get("trace_id"),
+            )
+            if rid is not None:
+                self._replay[rid] = req_id
+            return {"req_id": req_id}
+
+    def _step(self, body: dict) -> dict:
+        engine = self.engine
+        with self._lock:
+            for rid in (body or {}).get("ack", []):
+                self._unacked.discard(int(rid))
+            finished_now = engine.step()
+            self._unacked.update(finished_now)
+            self._steps += 1
+            statuses = []
+            for rid, req in list(engine.requests.items()):
+                if not req.done or rid in self._unacked:
+                    statuses.append(_status_doc(engine.poll(rid)))
+            reg = engine.registry
+            doc = {
+                "finished": sorted(self._unacked),
+                "statuses": statuses,
+                "load": (
+                    reg.read_gauge("queue_depth")
+                    + reg.read_gauge("running_requests")
+                ),
+                "queue_depth": reg.read_gauge("queue_depth"),
+                "slo_firing": self._slo_firing(),
+                "idle_fraction": self._idle_fraction(),
+            }
+            if (
+                engine.tracer.enabled
+                and self._steps % self.trace_every == 0
+            ):
+                # Piggybacked trace snapshot: the client caches the last
+                # one, so a SIGKILLed worker's lanes survive into the
+                # merged fleet waterfall.
+                doc["trace"] = engine.tracer.to_perfetto()
+            if (
+                self.flight_dump_every
+                and engine.flight.enabled
+                and self._steps % self.flight_dump_every == 0
+            ):
+                # Rolling on-disk postmortem: the recovery artifact for a
+                # SIGKILL, which by definition never dumps at fault time.
+                engine._dump_postmortem("rolling")
+            return doc
+
+    def _poll(self, query: dict) -> dict:
+        req_id = int(query.get("id", ["-1"])[0])
+        with self._lock:
+            return _status_doc(self.engine.poll(req_id))
+
+    def _drain(self, body: dict) -> dict:
+        from distributed_pytorch_tpu.serving.elastic import drain_engine
+
+        engine = self.engine
+        with self._lock, engine.registry.lock:
+            snap = drain_engine(
+                engine, reason=(body or {}).get("reason", "drain")
+            )
+            statuses = [
+                _status_doc(engine.poll(rid)) for rid in engine.requests
+            ]
+        return {"snapshot": snap.to_json(), "statuses": statuses}
+
+    def _restore(self, body: dict) -> dict:
+        from distributed_pytorch_tpu.serving.elastic import (
+            EngineSnapshot,
+            restore_engine,
+        )
+
+        engine = self.engine
+        with self._lock, engine.registry.lock:
+            ids = restore_engine(
+                engine,
+                EngineSnapshot.from_json(body["snapshot"]),
+                rebase_ids=bool(body.get("rebase_ids", False)),
+            )
+        return {"restored": ids}
+
+    def _shutdown(self) -> dict:
+        with self._lock:
+            # Leak asserts (debug engines) raise HERE: the client sees a
+            # 500 and the worker exits non-zero — a failed quiescence
+            # check is loud on both sides of the process boundary.
+            self.engine.close()
+        return {"ok": True}
+
+    def _slo_firing(self) -> list:
+        slo = getattr(self.engine, "slo", None)
+        if slo is None:
+            return []
+        return [n for n, st in slo.state().items() if st["firing"]]
+
+    def _idle_fraction(self):
+        goodput = getattr(self.engine, "goodput", None)
+        if goodput is None:
+            return None
+        total = goodput.productive_s + goodput.wasted_total_s()
+        if total <= 0:
+            return None
+        return goodput.wasted["budget_idle"] / total
+
+
+def main() -> int:
+    spec_text = os.environ.get(SPEC_ENV)
+    if not spec_text:
+        print(f"replica_worker: {SPEC_ENV} not set", file=sys.stderr)
+        return 2
+    spec = json.loads(spec_text)
+    engine = build_engine(spec)
+    if spec.get("warm_chunks"):
+        warm_engine(engine, spec["warm_chunks"])
+    host = spec.get("host", "127.0.0.1")
+    obs = engine.serve(host=host)
+    control = ReplicaControlServer(
+        engine,
+        host=host,
+        trace_every=int(spec.get("trace_every", 4)),
+        flight_dump_every=int(spec.get("flight_dump_every", 0)),
+    ).start()
+
+    fp = {
+        "page_size": engine.page_size,
+        "max_seq_len": engine.max_seq_len,
+        "top_k": engine._top_k,
+        "top_p": engine._top_p,
+        "speculative": engine.speculative,
+        "mesh": engine.mesh_fingerprint,
+    }
+    print(json.dumps({"replica_hello": {
+        "pid": os.getpid(),
+        "name": os.environ.get("TPURUN_REPLICA_NAME", spec.get("name")),
+        "control_url": control.url,
+        "obs_url": obs.url,
+        "fingerprint": fp,
+    }}), flush=True)
+
+    def _watch_stdin():
+        # Orphan prevention: stdin EOF means the parent is gone. os._exit
+        # because a vanished parent deserves SIGKILL semantics, not
+        # graceful teardown racing interpreter shutdown. Raw os.read, NOT
+        # sys.stdin.buffer: a daemon thread blocked holding the buffered
+        # reader's lock deadlocks CPython finalization on a clean exit.
+        try:
+            while os.read(0, 4096):
+                pass
+        except OSError:
+            pass
+        if not control.shutdown_event.is_set():
+            os._exit(3)
+
+    threading.Thread(
+        target=_watch_stdin, name="parent-watch", daemon=True
+    ).start()
+
+    control.shutdown_event.wait()
+    control.stop()
+    # engine.close() (already run by /shutdown) stops the obs server too;
+    # stop again for the factory-path engines that override close().
+    try:
+        obs.stop()
+    except Exception:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,335 @@
+"""Cross-process fleet drills: REAL faults against worker subprocesses.
+
+The in-process fleet tests (``test_serving_fleet.py``) prove the routing
+and failover logic against simulated faults. This file runs the same
+drills against ``ProcessReplicaClient`` workers where the fault is the
+real thing:
+
+* ``kill_replica_process`` delivers an actual SIGKILL to a loaded worker
+  mid-decode — detection is a failed control call, recovery is shadow
+  re-admission on a survivor, and the acceptance bar is unchanged:
+  greedy-token parity with an uninterrupted single-engine reference,
+  zero referenced pages on every survivor, one trace_id spanning the
+  victim's lanes and the survivor's.
+* ``hang_replica_process`` delivers SIGSTOP — the "hung but alive" fault
+  the circuit breaker exists for: calls time out, the breaker opens,
+  routing degrades around the replica WITHOUT declaring it dead, and
+  when SIGCONT lands the half-open probe re-admits it with no request
+  lost and no token emitted twice.
+
+All slow (each spawns JAX subprocesses); the fleet-chaos CI job runs
+them alongside ``tools/fleet_smoke.sh procs``.
+"""
+
+import json
+import os
+import random
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_pytorch_tpu import chaos
+from distributed_pytorch_tpu.models.transformer import TransformerLM
+from distributed_pytorch_tpu.obs import Tracer, merge_traces
+from distributed_pytorch_tpu.serving import (
+    AutoscalePolicy,
+    FleetRouter,
+    InferenceEngine,
+    ProcessReplicaClient,
+    SamplingParams,
+    prefix_affinity_key,
+    spawn_replica_clients,
+)
+from distributed_pytorch_tpu.serving.fleet import ID_STRIDE, _rendezvous
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+MODEL_KW = dict(
+    vocab_size=48, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+)
+ENGINE_KW = dict(
+    max_slots=2, max_seq_len=32, page_size=4, token_budget=16,
+    max_prefill_chunk=8, debug=True,
+)
+MAX_NEW = 6
+PAGE = ENGINE_KW["page_size"]
+
+PREFIX = [5, 7, 11, 2]
+AFFINITY_PROMPTS = [PREFIX + [t, t + 1] for t in (1, 9, 17, 25, 33)]
+OTHER_PROMPTS = [[2, 2, 3, 17, 40], [6, 1, 9], [40, 41], [3, 3, 3, 3, 8]]
+DRILL_PROMPTS = AFFINITY_PROMPTS + OTHER_PROMPTS
+
+
+def worker_spec(name, **extra):
+    spec = {
+        "name": name,
+        "model": dict(MODEL_KW, dtype="float32"),
+        "init_seed": 0,
+        "engine": ENGINE_KW,
+        "trace": True,
+        "trace_every": 1,  # piggyback a trace doc on EVERY step response
+    }
+    spec.update(extra)
+    return spec
+
+
+def params_for(i):
+    return SamplingParams(max_new_tokens=MAX_NEW)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_chaos_plan():
+    chaos._reset()
+    yield
+    os.environ.pop(chaos.ENV_VAR, None)
+    chaos._reset()
+
+
+def arm(plan):
+    os.environ[chaos.ENV_VAR] = json.dumps(plan)
+    chaos._reset()
+
+
+@pytest.fixture(scope="module")
+def ref_outputs():
+    """Uninterrupted single-engine reference, in-parent, from the same
+    init seed the workers build from — token parity across the process
+    boundary is exact."""
+    model = TransformerLM(**MODEL_KW, dtype=jnp.float32)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    eng = InferenceEngine(model, params, **ENGINE_KW)
+    ids = [
+        eng.submit(p, params_for(i)) for i, p in enumerate(DRILL_PROMPTS)
+    ]
+    eng.run()
+    out = {i: eng.poll(rid).generated for i, rid in enumerate(ids)}
+    eng.close()
+    return out
+
+
+def assert_parity(router, fids_by_prompt_idx, ref_outputs):
+    for idx, fid in fids_by_prompt_idx.items():
+        st = router.poll(fid)
+        assert st.finished, f"prompt {idx} (fid {fid}) never finished"
+        assert list(st.generated) == list(ref_outputs[idx]), (
+            f"prompt {idx}: fleet produced {st.generated}, "
+            f"reference {ref_outputs[idx]}"
+        )
+
+
+# ------------------------------------------------------ the headline drill
+
+
+def test_process_fleet_kill_drill(ref_outputs):
+    """SIGKILL a loaded replica PROCESS mid-decode under seeded Poisson
+    load: union token parity, zero survivor page leaks, one trace_id
+    spanning victim and survivor lanes."""
+    key = prefix_affinity_key(AFFINITY_PROMPTS[0], PAGE)
+    victim = _rendezvous(key, ["r0", "r1", "r2"])
+    victim_idx = int(victim[1:])
+    arm({
+        "seed": 1234,
+        "faults": [
+            {"kind": "kill_replica_process", "replica": victim_idx,
+             "at_step": 3}
+        ],
+    })
+    clients = spawn_replica_clients(
+        [worker_spec(f"r{i}") for i in range(3)]
+    )
+    router = FleetRouter(clients, probe_every=2, tracer=Tracer())
+    rng = random.Random(1234)
+    schedule = {}
+    rnd = 0
+    for idx in range(len(DRILL_PROMPTS)):
+        schedule.setdefault(rnd, []).append(idx)
+        while rng.random() < 0.5:
+            rnd += 1
+    fids = {}
+    try:
+        rounds = 0
+        while True:
+            for idx in schedule.pop(rounds, []):
+                fids[idx] = router.submit(
+                    DRILL_PROMPTS[idx], params_for(idx)
+                )
+            done = not schedule and all(
+                s.finished for s in router._shadows.values()
+            )
+            if done and len(fids) == len(DRILL_PROMPTS):
+                break
+            router.step()
+            rounds += 1
+            assert rounds < 500, "drill did not converge"
+
+        dead = [r for r in router.replicas() if r.state == "dead"]
+        assert [r.name for r in dead] == [victim]
+        assert dead[0].dead_reason == "kill_replica_process"
+        # The kill was real: the worker process is gone (SIGKILL = -9).
+        assert clients[victim_idx]._proc.poll() == -9
+        assert (
+            router.registry.read_counter("requests_failed_over_total") >= 1
+        )
+        assert (
+            router.registry.read_gauge("dead_replica_detection_seconds")
+            >= 0.0
+        )
+        assert_parity(router, fids, ref_outputs)
+        # Zero leaked pages on every survivor — read over the wire.
+        for rep in router.replicas():
+            if rep.state == "dead":
+                continue
+            assert rep.client.read_gauge("pages_referenced") == 0, (
+                f"{rep.name} leaked referenced pages"
+            )
+
+        # One trace identity spans the failover: the victim's lanes come
+        # from the client's LAST piggybacked trace snapshot (the process
+        # is dead; nothing else could have them), the survivor's from a
+        # live scrape — merged, the moved request's trace_id opens spans
+        # in at least two distinct process lanes.
+        moved = [
+            fid for fid, s in router._shadows.items() if s.failovers > 0
+        ]
+        assert moved, "kill landed but nothing failed over"
+        tid = router._shadows[moved[0]].trace_id
+        merged = merge_traces(*router.trace_documents())
+        opened_pids = {
+            e["pid"]
+            for e in merged["traceEvents"]
+            if e.get("ph") == "b"
+            and e.get("args", {}).get("trace_id") == tid
+        }
+        assert len(opened_pids) >= 2, (
+            f"trace {tid} only spans lanes {sorted(opened_pids)}"
+        )
+    finally:
+        router.close()
+
+
+# ------------------------------------------------- breaker degraded mode
+
+
+def test_process_breaker_sigstop_degrade_and_rejoin(ref_outputs):
+    """SIGSTOP the loaded worker: its calls time out, the breaker opens
+    within the deadline budget, routing excludes it WITHOUT declaring it
+    dead; after SIGCONT the half-open probe closes the breaker and it
+    rejoins — every request finishes exactly once, token-identical."""
+    clients = spawn_replica_clients(
+        [worker_spec(f"r{i}") for i in range(2)],
+        call_timeout_s=0.5,
+        call_retries=1,
+        breaker_fail_threshold=2,
+        breaker_reset_s=0.4,
+    )
+    router = FleetRouter(clients, probe_every=2, probe_timeout_s=0.5)
+    fids = {}
+    emitted = []
+    try:
+        for idx in range(4):
+            fids[idx] = router.submit(
+                DRILL_PROMPTS[idx], params_for(idx)
+            )
+        emitted.extend(router.step())
+        victim_name = router._shadows[fids[0]].replica
+        victim = router._by_name[victim_name]
+        victim_idx = int(victim_name[1:])
+        assert any(
+            not s.finished and s.replica == victim_name
+            for s in router._shadows.values()
+        ), "victim must hold live work when the hang lands"
+
+        # Deliver the real SIGSTOP (auto-SIGCONT after 2s).
+        router._apply_fault(chaos.Fault(
+            kind="hang_replica_process", replica=victim_idx, duration=2.0,
+        ))
+        rounds = 0
+        while victim.client.breaker.state != "open":
+            emitted.extend(router.step())
+            rounds += 1
+            assert rounds < 20, "breaker never opened under SIGSTOP"
+
+        # Degraded, not dead: excluded from routing, shadows intact.
+        assert victim.state == "live"
+        assert victim_name not in [
+            r.name for r in router._eligible()
+        ]
+        for idx in range(4, len(DRILL_PROMPTS)):
+            fids[idx] = router.submit(
+                DRILL_PROMPTS[idx], params_for(idx)
+            )
+            assert router._shadows[fids[idx]].replica != victim_name, (
+                "breaker-open replica must not take new work"
+            )
+
+        emitted.extend(router.run())
+
+        assert victim.state == "live", "SIGSTOP must never declare death"
+        assert victim.client.breaker.state == "closed"
+        assert victim.client.breaker.opens_total >= 1
+        assert victim.client.breaker.closes_total >= 1
+        assert (
+            router.registry.read_counter("requests_failed_over_total") == 0
+        ), "nothing died, so nothing may fail over"
+        # Exactly-once delivery across the blackout: the ack protocol
+        # re-reports finishes whose responses were lost, the router
+        # finalizes each fleet id once.
+        assert sorted(emitted) == sorted(fids.values())
+        assert_parity(router, fids, ref_outputs)
+    finally:
+        router.close()
+
+
+# ------------------------------------------------------- autoscale spawns
+
+
+def test_autoscale_spawns_process_replica():
+    """The autoscaler graduates from constructing engines to spawning
+    PROCESSES: scale-out calls ``replica_factory``, the new worker joins
+    with its own id namespace; scale-in drains one cleanly over the
+    control plane and its worker exits zero."""
+    clients = spawn_replica_clients(
+        [worker_spec(f"r{i}") for i in range(2)]
+    )
+    policy = AutoscalePolicy(min_replicas=1, max_replicas=3)
+    router = FleetRouter(
+        clients,
+        autoscale=policy,
+        replica_factory=lambda: ProcessReplicaClient(worker_spec("r2")),
+    )
+    try:
+        # A firing burn-rate alert on r0 (the cached gauge the step
+        # exchange would normally refresh).
+        router._by_name["r0"].client._slo_firing = ["ttft_p95"]
+        action = router.maybe_autoscale()
+        assert action == ("out", "r2")
+        grown = router._by_name["r2"]
+        assert grown.client.is_process
+        assert len(router._eligible()) == 3
+        assert router.registry.read_counter("scale_outs_total") == 1
+        # Fresh id namespace, enforced over the wire by /reserve_ids.
+        rid = grown.client.submit([9, 4], SamplingParams(max_new_tokens=1))
+        assert rid >= 2 * ID_STRIDE
+        done = set()
+        for _ in range(100):
+            done.update(grown.client.step())
+            if rid in done:
+                break
+        assert rid in done
+
+        router._by_name["r0"].client._slo_firing = []
+        for rep in router.replicas():
+            rep.client._idle_fraction = 0.9
+        action = router.maybe_autoscale()
+        assert action is not None and action[0] == "in"
+        assert router.registry.read_counter("scale_ins_total") == 1
+        assert len(router._eligible()) == 2
+        removed = action[1]
+        # Clean drain: the removed worker was told to shut down and
+        # exited ZERO (its leak asserts passed).
+        assert router._by_name[removed].client._proc.wait(10) == 0
+    finally:
+        router.close()

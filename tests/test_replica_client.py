@@ -1,0 +1,427 @@
+"""ReplicaClient conformance suite + breaker/adopt unit tests.
+
+One parametrized suite runs the SAME assertions against both sides of
+the replica interface — ``LocalReplicaClient`` (in-process wrap, the
+parity-pinned default) and ``ProcessReplicaClient`` (worker subprocess
+behind the localhost control plane) — so the process boundary is proven
+behaviorally invisible: same tokens, same error types, same drain
+snapshot, same gauges. Process variants are marked ``slow`` (each spawns
+a JAX subprocess); local variants run in tier-1.
+
+Alongside: deterministic CircuitBreaker state-machine tests (injectable
+clock, no sleeps) and the bounded-poll ``adopt_snapshot`` contract
+(:class:`SnapshotUnavailable` on deadline, late publisher still adopted).
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_pytorch_tpu.models.transformer import TransformerLM
+from distributed_pytorch_tpu.serving import (
+    CircuitBreaker,
+    InferenceEngine,
+    LocalReplicaClient,
+    ProcessReplicaClient,
+    RequestTooLong,
+    SamplingParams,
+    SnapshotUnavailable,
+    adopt_snapshot,
+    drain_engine,
+    publish_snapshot,
+)
+from distributed_pytorch_tpu.serving.elastic import fetch_snapshot_text
+
+MODEL_KW = dict(
+    vocab_size=48, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+)
+ENGINE_KW = dict(
+    max_slots=2, max_seq_len=32, page_size=4, token_budget=16,
+    max_prefill_chunk=8, debug=True,
+)
+MAX_NEW = 6
+PROMPTS = [[5, 7, 11, 2, 1, 2], [2, 2, 3, 17, 40], [6, 1, 9]]
+
+# The worker builds this same model from the spec with the same init
+# seed, so local and process replicas hold identical params — token
+# parity across the process boundary is exact, not approximate.
+WORKER_SPEC = {
+    "name": "conformance",
+    "model": dict(MODEL_KW, dtype="float32"),
+    "init_seed": 0,
+    "engine": ENGINE_KW,
+    "trace": True,
+}
+
+KINDS = [
+    pytest.param("local", id="local"),
+    pytest.param("process", id="process", marks=pytest.mark.slow),
+]
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = TransformerLM(**MODEL_KW, dtype=jnp.float32)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def ref_outputs(model_and_params):
+    model, params = model_and_params
+    eng = InferenceEngine(model, params, **ENGINE_KW)
+    ids = [
+        eng.submit(p, SamplingParams(max_new_tokens=MAX_NEW))
+        for p in PROMPTS
+    ]
+    eng.run()
+    out = [eng.poll(rid).generated for rid in ids]
+    eng.close()
+    return out
+
+
+@pytest.fixture(scope="module")
+def shared_process_client():
+    """One worker subprocess shared by the non-destructive conformance
+    tests (spawn + XLA warm-up dominates; state accumulation is harmless
+    because token streams are slot/batch/id-invariant)."""
+    client = ProcessReplicaClient(WORKER_SPEC, name="conformance")
+    yield client
+    try:
+        client.close()
+    except Exception:
+        client.abandon()
+
+
+def _fresh_client(kind, model_and_params, name="fresh"):
+    if kind == "local":
+        model, params = model_and_params
+        return LocalReplicaClient(InferenceEngine(model, params, **ENGINE_KW))
+    return ProcessReplicaClient(
+        dict(WORKER_SPEC, name=name), name=name
+    )
+
+
+@pytest.fixture(params=KINDS)
+def client(request, model_and_params):
+    if request.param == "local":
+        c = _fresh_client("local", model_and_params)
+        yield c
+        c.close()
+    else:
+        yield request.getfixturevalue("shared_process_client")
+
+
+def run_to_done(client, rids, *, max_steps=400):
+    done = set()
+    for _ in range(max_steps):
+        done.update(client.step())
+        if done >= set(rids):
+            return done
+    raise AssertionError(f"requests never finished: {set(rids) - done}")
+
+
+# ------------------------------------------------------------- conformance
+
+
+def test_submit_step_poll_token_parity(client, ref_outputs):
+    """The headline invariant: a client of either kind produces the exact
+    reference token streams through submit/step/poll."""
+    rids = [
+        client.submit(p, SamplingParams(max_new_tokens=MAX_NEW))
+        for p in PROMPTS
+    ]
+    run_to_done(client, rids)
+    for rid, ref in zip(rids, ref_outputs):
+        st = client.poll(rid)
+        assert st.finished
+        assert list(st.generated) == list(ref)
+
+
+def test_step_reports_load_and_queue_depth(client):
+    rid = client.submit(
+        PROMPTS[0], SamplingParams(max_new_tokens=MAX_NEW)
+    )
+    client.step()
+    # load() is the last step exchange's gauge (the process client
+    # refreshes it from the piggybacked step response, one round stale
+    # at most); after one step the request is still mid-decode.
+    assert client.load() >= 1.0
+    run_to_done(client, [rid])
+    client.step()  # one idle step so gauges settle back
+    assert client.load() == 0.0
+    assert client.queue_depth() == 0.0
+    assert client.read_gauge("queue_depth") == 0.0
+
+
+def test_cancel_semantics(client):
+    rid = client.submit(PROMPTS[1], SamplingParams(max_new_tokens=MAX_NEW))
+    assert client.cancel(rid) is True
+    assert client.cancel(rid) is False  # already terminal
+    assert client.cancel(987_654_321) is False  # unknown id
+    st = client.poll(rid)
+    assert st.state == "cancelled"
+
+
+def test_unknown_poll_raises_keyerror(client):
+    with pytest.raises(KeyError):
+        client.poll(987_654_321)
+
+
+def test_admission_error_type_crosses_boundary(client):
+    """A refusal must surface as the REAL admission class (process: class
+    name over the wire, re-raised) and count as breaker success — an
+    answer from a live worker, not a transport failure."""
+    too_long = list(range(1, 40))  # prompt alone exceeds max_seq_len=32
+    with pytest.raises(RequestTooLong):
+        client.submit(too_long, SamplingParams(max_new_tokens=8))
+    assert client.breaker.state == "closed"
+
+
+def test_health_describe_and_metrics(client):
+    assert client.health() == "live"
+    doc = client.describe()
+    assert "engine" in doc and "admission" in doc
+    snap = client.metrics_snapshot()
+    assert snap is not None
+    assert "counters" in snap and "gauges" in snap
+    assert client.slo_firing() == []
+    fp = client.fingerprint()
+    assert fp["page_size"] == ENGINE_KW["page_size"]
+    assert fp["max_seq_len"] == ENGINE_KW["max_seq_len"]
+
+
+def test_reserve_ids_namespaces_id_space(client, model_and_params):
+    base = 5_000_000
+    client.reserve_ids(base)
+    rid = client.submit(PROMPTS[2], SamplingParams(max_new_tokens=2))
+    assert rid >= base
+    run_to_done(client, [rid])
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_drain_restore_handoff(kind, model_and_params, ref_outputs):
+    """Drain a loaded replica mid-decode, restore the snapshot into a
+    fresh replica OF THE SAME KIND, finish there: every stream must match
+    the uninterrupted reference (for the process kind the snapshot makes
+    two trips over the control plane — /drain out, /restore in)."""
+    source = _fresh_client(kind, model_and_params, name="drain-src")
+    target = _fresh_client(kind, model_and_params, name="drain-dst")
+    try:
+        rids = [
+            source.submit(p, SamplingParams(max_new_tokens=MAX_NEW))
+            for p in PROMPTS
+        ]
+        for _ in range(3):  # partial progress only
+            source.step()
+        snap = source.drain(reason="conformance")
+        live = [r.req_id for r in snap.requests]
+        assert live, "drain mid-decode should snapshot live requests"
+        restored = target.restore(snap)
+        assert restored == live
+        run_to_done(target, live)
+        for rid, ref in zip(rids, ref_outputs):
+            client = target if rid in live else source
+            st = client.poll(rid)
+            assert st.finished
+            assert list(st.generated) == list(ref), (
+                f"req {rid} diverged after {kind} drain/restore handoff"
+            )
+    finally:
+        source.abandon()
+        target.abandon()
+
+
+# ------------------------------------------------- process-only contracts
+
+
+@pytest.mark.slow
+def test_process_submit_rid_dedup(shared_process_client):
+    """The replay map behind retry-safe submit: the same client-minted
+    rid admits ONCE; the replay answers with the original req_id."""
+    c = shared_process_client
+    body = {
+        "rid": "conformance-dedup-0",
+        "prompt": PROMPTS[0],
+        "params": {"max_new_tokens": 2},
+    }
+    first = c._call("/submit", dict(body))
+    second = c._call("/submit", dict(body))
+    assert second["req_id"] == first["req_id"]
+    assert second.get("replayed") is True
+    run_to_done(c, [int(first["req_id"])])
+
+
+@pytest.mark.slow
+def test_process_trace_documents_survive_scrape(shared_process_client):
+    docs = shared_process_client.trace_documents()
+    assert docs, "worker runs with trace=True; scrape should return a doc"
+    assert "traceEvents" in docs[0]
+
+
+# ---------------------------------------------------------- circuit breaker
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+class TestCircuitBreaker:
+    def make(self, **kw):
+        clock = FakeClock()
+        kw.setdefault("fail_threshold", 3)
+        kw.setdefault("reset_timeout_s", 1.0)
+        return CircuitBreaker(clock=clock, **kw), clock
+
+    def test_opens_after_consecutive_failures(self):
+        br, clock = self.make()
+        for _ in range(2):
+            br.record_failure()
+        assert br.state == "closed" and br.allow()
+        br.record_failure()
+        assert br.state == "open"
+        assert not br.allow()
+        assert br.opens_total == 1
+
+    def test_success_resets_failure_streak(self):
+        br, clock = self.make()
+        br.record_failure()
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "closed", "streak must reset on success"
+
+    def test_half_open_grants_single_probe_then_closes(self):
+        br, clock = self.make()
+        for _ in range(3):
+            br.record_failure()
+        assert br.state == "open"
+        clock.advance(1.01)
+        assert br.state == "half_open"
+        assert br.allow(), "half-open grants one probe"
+        assert not br.allow(), "second concurrent probe refused"
+        br.record_success()
+        assert br.state == "closed"
+        assert br.allow()
+        assert br.closes_total == 1
+
+    def test_failed_probe_reopens_and_restarts_cooldown(self):
+        br, clock = self.make()
+        for _ in range(3):
+            br.record_failure()
+        clock.advance(1.01)
+        assert br.allow()
+        br.record_failure()  # probe failed
+        assert br.state == "open"
+        clock.advance(0.5)
+        assert br.state == "open", "cooldown restarted by failed probe"
+        clock.advance(0.6)
+        assert br.state == "half_open"
+        assert br.allow()
+        br.record_success()
+        assert br.state == "closed"
+
+    def test_disabled_breaker_never_opens(self):
+        br, clock = self.make(enabled=False)
+        for _ in range(50):
+            br.record_failure()
+        assert br.state == "closed"
+        assert br.allow()
+
+    def test_fail_threshold_validated(self):
+        with pytest.raises(ValueError):
+            CircuitBreaker(fail_threshold=0)
+
+
+# ------------------------------------------------------ bounded adopt poll
+
+
+class _DictStore:
+    def __init__(self):
+        self.data = {}
+
+    def set(self, key, value):
+        self.data[key] = value
+
+    def get(self, key):
+        return self.data.get(key)
+
+    def delete(self, key):
+        self.data.pop(key, None)
+
+
+class TestAdoptBoundedPoll:
+    def test_deadline_raises_snapshot_unavailable(self):
+        store = _DictStore()
+        t0 = time.monotonic()
+        with pytest.raises(SnapshotUnavailable):
+            fetch_snapshot_text(store, "never", timeout_s=0.2)
+        assert time.monotonic() - t0 >= 0.2
+
+    def test_late_publisher_still_fetched(self):
+        store = _DictStore()
+
+        def publish_late():
+            time.sleep(0.15)
+            store.set("handoff", "snapshot-text")
+
+        t = threading.Thread(target=publish_late)
+        t.start()
+        try:
+            text = fetch_snapshot_text(store, "handoff", timeout_s=5.0)
+        finally:
+            t.join()
+        assert text == "snapshot-text"
+
+    def test_adopt_without_timeout_keeps_fail_fast(self, model_and_params):
+        model, params = model_and_params
+        eng = InferenceEngine(model, params, **ENGINE_KW)
+        assert adopt_snapshot(eng, _DictStore(), "missing") == []
+        eng.close()
+
+    def test_adopt_with_timeout_raises_typed_error(self, model_and_params):
+        model, params = model_and_params
+        eng = InferenceEngine(model, params, **ENGINE_KW)
+        with pytest.raises(SnapshotUnavailable):
+            adopt_snapshot(eng, _DictStore(), "missing", timeout_s=0.1)
+        eng.close()
+
+    def test_adopt_races_publisher_and_wins(self, model_and_params):
+        """The race the bounded poll exists for: the adopter starts
+        polling BEFORE the dying replica's snapshot lands."""
+        model, params = model_and_params
+        src = InferenceEngine(model, params, **ENGINE_KW)
+        rid = src.submit(PROMPTS[0], SamplingParams(max_new_tokens=MAX_NEW))
+        src.step()
+        store = _DictStore()
+
+        def publish_late():
+            time.sleep(0.15)
+            publish_snapshot(store, "handoff", drain_engine(src))
+
+        t = threading.Thread(target=publish_late)
+        t.start()
+        dst = InferenceEngine(model, params, **ENGINE_KW)
+        try:
+            restored = adopt_snapshot(dst, store, "handoff", timeout_s=5.0)
+        finally:
+            t.join()
+        assert restored == [rid]
+        assert store.data == {}, "adopt-once must delete the key"
+        dst.run()
+        assert dst.poll(rid).finished
+        dst.close()
+        src.close()

@@ -119,6 +119,27 @@ def extract_row(bench: dict) -> dict:
             )
             if key in fleet
         }
+    fleet_procs = bench.get("fleet_procs")
+    if fleet_procs:
+        # Un-gated like the in-process fleet row (same drill-shaped
+        # noise, plus subprocess spawn jitter), but recorded: the
+        # cross-process isolation tax — aggregate tok/s vs the in-process
+        # row, detection latency over a real SIGKILL, and the failover
+        # TTFT spike — is the trajectory this row exists to track.
+        out["fleet_procs"] = {
+            key: fleet_procs.get(key)
+            for key in (
+                "n_replicas",
+                "aggregate_tokens_per_sec",
+                "requests_failed_over",
+                "detection_latency_s",
+                "failover_ttft_s_p50",
+                "failover_ttft_spike_x",
+                "greedy_tokens_match_single_engine",
+                "pages_leaked_on_survivors",
+            )
+            if key in fleet_procs
+        }
     frontdoor = bench.get("frontdoor")
     if frontdoor:
         # Un-gated like the fleet section (open-loop streaming wall time

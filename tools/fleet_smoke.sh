@@ -30,14 +30,197 @@
 # The <90s pytest version of this drill is
 # tests/test_serving_fleet.py::test_fleet_kill_drill_token_parity; this
 # script adds the flight-recorder postmortem path and the artifact upload.
+#
+#   bash tools/fleet_smoke.sh procs
+#
+# runs the CROSS-PROCESS variant: three replica WORKER SUBPROCESSES behind
+# ProcessReplicaClient (each its own engine + control endpoint + rolling
+# on-disk flight dumps), a kill_replica_process fault delivering a REAL
+# SIGKILL to the affinity-loaded worker under queue pressure, union token
+# parity on the survivors, worker-side assert_quiescent on clean shutdown
+# (a leaked page = non-zero worker exit = drill failure), and the victim's
+# LAST rolling flight dump as the postmortem artifact — a SIGKILLed
+# process by definition cannot dump at fault time, so the rolling dump IS
+# the recovery artifact. The pytest version is
+# tests/test_fleet_procs.py::test_process_fleet_kill_drill.
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
 REPO="$PWD"
+SCENARIO="${1:-inproc}"
 
 WORK="$(mktemp -d /tmp/fleet_smoke.XXXXXX)"
 trap 'rm -rf "$WORK"' EXIT
-echo "[fleet_smoke] workdir: $WORK"
+echo "[fleet_smoke] workdir: $WORK (scenario: $SCENARIO)"
+
+if [ "$SCENARIO" = "procs" ]; then
+cat > "$WORK/drill_procs.py" <<'EOF'
+"""Cross-process fleet chaos drill driver: reference run in-parent, then a
+routed run over three worker SUBPROCESSES with a seeded real SIGKILL (see
+fleet_smoke.sh for the full scenario)."""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+
+from distributed_pytorch_tpu import chaos
+from distributed_pytorch_tpu.models.transformer import TransformerLM
+from distributed_pytorch_tpu.serving import (
+    FleetRouter,
+    InferenceEngine,
+    SamplingParams,
+    prefix_affinity_key,
+    spawn_replica_clients,
+)
+from distributed_pytorch_tpu.serving.fleet import _rendezvous
+
+PAGE = 4
+PREFIX = [5, 7, 11, 2]  # one full page -> a routable affinity key
+PROMPTS = (
+    [PREFIX + [t, t + 1] for t in (1, 9, 17, 25)]  # affinity -> victim
+    + [[3, 3, 7], [6, 1, 9, 9, 2], [2, 40, 17], [8, 8, 8, 1]]
+)
+MAX_NEW = 8
+MODEL_KW = dict(vocab_size=48, d_model=16, n_layers=2, n_heads=2, d_ff=32)
+ENGINE_KW = dict(max_slots=2, max_seq_len=32, page_size=PAGE,
+                 token_budget=16, max_prefill_chunk=8, debug=True)
+
+# Uninterrupted single-engine reference, in-parent, same init seed as the
+# workers build from: the token-parity oracle.
+model = TransformerLM(**MODEL_KW, dtype=jnp.float32)
+params = model.init(
+    jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+)["params"]
+ref = InferenceEngine(model, params, **ENGINE_KW)
+ref_ids = [ref.submit(p, SamplingParams(max_new_tokens=MAX_NEW))
+           for p in PROMPTS]
+ref.run()
+REF = [ref.poll(i).generated for i in ref_ids]
+ref.close()
+
+# The kill must land on the replica the shared prefix routes to.
+names = ["r0", "r1", "r2"]
+victim = _rendezvous(prefix_affinity_key(PROMPTS[0], PAGE), names)
+vidx = int(victim[1:])
+os.environ[chaos.ENV_VAR] = json.dumps({
+    "seed": 7,
+    "faults": [{"kind": "kill_replica_process", "replica": vidx,
+                "at_step": 4}],
+})
+chaos._reset()
+
+# Three worker subprocesses, spawned concurrently. Each runs its engine
+# with the flight recorder on and dumps the ring to disk after EVERY
+# control step — the victim cannot dump at SIGKILL time, so its last
+# rolling dump is the postmortem.
+clients = spawn_replica_clients([
+    {
+        "name": f"r{i}",
+        "model": dict(MODEL_KW, dtype="float32"),
+        "init_seed": 0,
+        "engine": ENGINE_KW,
+        "flight": {"capacity": 2048,
+                   "path": f"postmortem_proc_r{i}.json"},
+        "flight_dump_every": 1,
+        "warm_chunks": [3, 6],  # pre-compile the drill's prefill buckets
+    }
+    for i in range(3)
+])
+router = FleetRouter(clients)
+queue = list(enumerate(PROMPTS))
+fids = {}
+rounds = 0
+while queue or any(not s.finished for s in router._shadows.values()):
+    for _ in range(2):  # 2 admissions per router round: open-loop load
+        if queue:
+            i, p = queue.pop(0)
+            fids[i] = router.submit(p, SamplingParams(max_new_tokens=MAX_NEW))
+    router.step()
+    rounds += 1
+    assert rounds < 500, "fleet never drained"
+
+vrep = next(r for r in router.replicas() if r.name == victim)
+assert vrep.state == "dead", f"victim {victim} state={vrep.state}"
+assert vrep.dead_reason == "kill_replica_process", vrep.dead_reason
+assert clients[vidx]._proc.poll() == -9, (
+    f"victim worker should be SIGKILLed, exit={clients[vidx]._proc.poll()}"
+)
+failed_over = int(router.registry.read_counter("requests_failed_over_total"))
+assert failed_over >= 1, "kill landed on an idle replica (no failover)"
+detection_s = router.registry.read_gauge("dead_replica_detection_seconds")
+
+outs = [router.poll(fids[i]).generated for i in range(len(PROMPTS))]
+for i, (got, want) in enumerate(zip(outs, REF)):
+    assert list(got) == list(want), (
+        f"request {i} diverged after failover: {got} != {want}"
+    )
+
+# Zero leaked pages on the survivors, read over the wire (dead replica
+# exempt — its pages died with the process, as a real SIGKILL should).
+for rep in router.replicas():
+    if rep.state != "dead":
+        held = rep.client.read_gauge("pages_referenced")
+        assert held == 0, f"{rep.name} leaked {held} page(s)"
+# close() shuts surviving workers down politely: each worker's
+# engine.close() runs assert_quiescent IN the worker; a leak there is a
+# non-zero worker exit and close() raises.
+router.close()
+
+print(json.dumps({
+    "victim": victim,
+    "victim_postmortem": f"postmortem_proc_r{vidx}.json",
+    "requests_failed_over": failed_over,
+    "detection_ms": round(detection_s * 1e3, 3),
+    "rounds": rounds,
+    "routed_affinity": int(
+        router.registry.read_counter("routed_affinity_total")
+    ),
+}))
+print("FLEET-PROCS-DRILL-OK")
+EOF
+
+cd "$WORK"
+rc=0
+env PYTHONPATH="$REPO" JAX_PLATFORMS=cpu python drill_procs.py > drill.log 2>&1 || rc=$?
+echo "--- drill.log"
+cat drill.log
+
+fail() { echo "[fleet_smoke] FAIL: $1"; exit 1; }
+[ "$rc" -eq 0 ] || fail "drill exited with $rc"
+grep -q "FLEET-PROCS-DRILL-OK" drill.log || fail "drill never reached the final assertion"
+grep -q "fleet fault kill_replica_process" drill.log || fail "kill_replica_process never fired"
+grep -q "dead (kill_replica_process)" drill.log || fail "router never marked the victim dead"
+
+POSTMORTEM="$(grep -oE 'postmortem_proc_r[0-9]+\.json' drill.log | head -1)"
+[ -n "$POSTMORTEM" ] && [ -e "$POSTMORTEM" ] || fail "no victim rolling flight dump on disk"
+
+# The victim's LAST rolling dump (written worker-side before the SIGKILL)
+# must replay into a valid Chrome trace-event document.
+env PYTHONPATH="$REPO" POSTMORTEM="$POSTMORTEM" python - <<'EOF'
+import json
+import os
+
+from distributed_pytorch_tpu.obs import replay_to_tracer
+
+dump = json.load(open(os.environ["POSTMORTEM"]))
+assert dump["reason"] == "rolling", dump["reason"]
+assert dump["events"], "postmortem ring buffer is empty"
+kinds = {e["kind"] for e in dump["events"]}
+assert "step" in kinds, f"no engine step records in dump: {kinds}"
+doc = json.loads(json.dumps(replay_to_tracer(dump).to_perfetto()))
+events = doc["traceEvents"]
+assert isinstance(events, list) and events, "replay produced no trace events"
+print(f"[fleet_smoke] victim rolling dump: {len(dump['events'])} events "
+      f"(reason={dump['reason']}) -> {len(events)} trace events, replay OK")
+EOF
+
+mkdir -p "$REPO/traces"
+cp "$POSTMORTEM" "$REPO/traces/fleet_procs_postmortem.json"
+
+echo "[fleet_smoke] PASS (procs)"
+exit 0
+fi
 
 cat > "$WORK/drill.py" <<'EOF'
 """Fleet chaos drill driver: reference run, then routed run with a seeded

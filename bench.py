@@ -1169,6 +1169,339 @@ def bench_fleet(
     return fleet_doc
 
 
+def bench_fleet_router(
+    n_replicas: int = 3,
+    n_requests: int = 24,
+    arrival_rate_hz: float = 20.0,
+    seed: int = 0,
+    shared_prefix_len: int = 24,
+    kill_round: int = 12,
+    procs: bool = False,
+):
+    """Durable-control-plane benchmark: the ROUTER dies mid-run. The same
+    Poisson workload as ``bench_fleet``, but the seeded fault is a
+    raise-mode ``kill_router`` at a step boundary — the router object is
+    abandoned with shadows, streams and route state in memory, exactly as
+    a SIGKILL leaves them, and ``FleetRouter.recover`` rebuilds a
+    successor from the write-ahead journal.
+
+    Reported into the ``fleet_router`` section of ``BENCH_SERVING.json``:
+    recovery wall time (journal replay + worker re-adoption + shadow
+    reconciliation), the resume-TTFT spike — time from recovery start to
+    each re-adopted request's next committed token, against the baseline
+    single-engine TTFT p50 — and the reconciliation counts
+    (re_adopted / re_admitted / lost / finished_tails). The acceptance
+    row is greedy token parity with one uninterrupted engine across the
+    crash, and zero leaked pages fleet-wide (the workers all survive the
+    router, so there is no SIGKILL exemption).
+
+    ``procs=True`` runs the workers as registry-tracked SUBPROCESSES:
+    recovery re-adopts them via ``ProcessReplicaClient.attach`` from the
+    on-disk worker registry, and reconciliation polls ride the localhost
+    control plane."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_pytorch_tpu import chaos
+    from distributed_pytorch_tpu.models.transformer import TransformerLM
+    from distributed_pytorch_tpu.serving import (
+        FleetRouter,
+        InferenceEngine,
+        LocalReplicaClient,
+        SamplingParams,
+    )
+    from distributed_pytorch_tpu.serving.admission import ServingMetrics
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    model = TransformerLM(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=8, d_ff=256,
+        dtype=jnp.float32 if on_cpu else jnp.bfloat16,
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    rng = np.random.default_rng(seed)
+    shared = (
+        rng.integers(0, 256, shared_prefix_len).tolist()
+        if shared_prefix_len else []
+    )
+    arrivals = np.cumsum(rng.exponential(1.0 / arrival_rate_hz, n_requests))
+    prompts = [
+        shared + rng.integers(0, 256, int(rng.integers(4, 17))).tolist()
+        for _ in range(n_requests)
+    ]
+    warm_rng = np.random.default_rng(seed + 1)
+    page_size = 8
+
+    def mk_engine():
+        eng = InferenceEngine(
+            model, params, max_slots=4, max_seq_len=64, page_size=page_size,
+            token_budget=64, max_prefill_chunk=32, max_queue=n_requests,
+            prefix_cache=True,
+        )
+        chunk = 1
+        while chunk <= 32:
+            warm = eng.submit(
+                warm_rng.integers(0, 256, chunk + 1).tolist(),
+                SamplingParams(max_new_tokens=2),
+            )
+            eng.run()
+            assert eng.poll(warm).finished
+            chunk *= 2
+        eng.metrics = ServingMetrics(speculative=False)
+        eng.admission.accepted = 0
+        eng.admission.cached_tokens_admitted = 0
+        eng.prefix_cache.lookups = eng.prefix_cache.hits = 0
+        eng.prefix_cache.tokens_hit = eng.prefix_cache.tokens_missed = 0
+        return eng
+
+    # Single-engine reference: the token-parity oracle and the baseline
+    # TTFT the resume spike is measured against.
+    ref = mk_engine()
+    start = time.perf_counter()
+    submitted, ref_ids = 0, []
+    while submitted < n_requests or (
+        ref.scheduler.has_work or ref._inflight is not None
+    ):
+        now = time.perf_counter() - start
+        while submitted < n_requests and arrivals[submitted] <= now:
+            ref_ids.append(
+                ref.submit(
+                    prompts[submitted], SamplingParams(max_new_tokens=16)
+                )
+            )
+            submitted += 1
+        if ref.scheduler.has_work or ref._inflight is not None:
+            ref.step()
+        elif submitted < n_requests:
+            time.sleep(min(arrivals[submitted] - now, 0.01))
+    ref_tokens = [ref.poll(i).generated for i in ref_ids]
+    baseline_ttft_p50 = ref.stats().get("ttft_s_p50")
+    ref.close()
+
+    jdir = tempfile.mkdtemp(prefix="bench_router_journal.")
+    prev_plan = os.environ.get(chaos.ENV_VAR)
+    os.environ[chaos.ENV_VAR] = json.dumps({
+        "seed": seed,
+        "faults": [
+            {"kind": "kill_router", "mode": "raise", "at_step": kill_round}
+        ],
+    })
+    chaos._reset()
+    members = None
+    try:
+        if procs:
+            from distributed_pytorch_tpu.serving import (
+                spawn_replica_clients,
+            )
+
+            env = dict(os.environ)
+            env.pop(chaos.ENV_VAR, None)  # the fault is the router's
+            env["TPURUN_ORPHAN_GRACE"] = "300"
+            worker_specs = [
+                {
+                    "name": f"r{i}",
+                    "model": dict(
+                        vocab_size=256, d_model=64, n_layers=2, n_heads=8,
+                        d_ff=256,
+                        dtype="float32" if on_cpu else "bfloat16",
+                    ),
+                    "init_seed": 0,
+                    "engine": dict(
+                        max_slots=4, max_seq_len=64, page_size=page_size,
+                        token_budget=64, max_prefill_chunk=32,
+                        max_queue=n_requests, prefix_cache=True,
+                    ),
+                    "warm_chunks": [2, 3, 5, 9, 17, 33],
+                }
+                for i in range(n_replicas)
+            ]
+            members = spawn_replica_clients(
+                worker_specs, run_dir=jdir, env=env
+            )
+        else:
+            members = [
+                LocalReplicaClient(mk_engine()) for _ in range(n_replicas)
+            ]
+        router = FleetRouter(members, probe_every=4, journal_dir=jdir)
+
+        # Incarnation 1: pump the Poisson schedule until the armed fault
+        # "kills" the router (raise-mode: the object is abandoned with all
+        # its state in memory, never stepped or closed again).
+        start = time.perf_counter()
+        submitted = 0
+        handles: list = [None] * n_requests
+        crashed = False
+        while submitted < n_requests or any(
+            not s.finished for s in router._shadows.values()
+        ):
+            now = time.perf_counter() - start
+            while submitted < n_requests and arrivals[submitted] <= now:
+                handles[submitted] = router.submit(
+                    prompts[submitted], SamplingParams(max_new_tokens=16)
+                )
+                submitted += 1
+            try:
+                if any(not s.finished for s in router._shadows.values()):
+                    router.step()
+                elif submitted < n_requests:
+                    time.sleep(min(arrivals[submitted] - now, 0.01))
+            except chaos.InjectedFault:
+                crashed = True
+                break
+        assert crashed, (
+            f"kill_router at step {kill_round} never fired (workload "
+            "drained first — raise kill_round or n_requests)"
+        )
+        del router  # crash: no close(), no journal flush beyond the WAL
+
+        # Disarm before the successor steps, or it would crash too.
+        os.environ.pop(chaos.ENV_VAR, None)
+        chaos._reset()
+
+        # Incarnation 2: replay the journal, re-adopt the workers,
+        # reconcile. This is the headline number — how long the control
+        # plane is dark.
+        t_rec = time.perf_counter()
+        router = FleetRouter.recover(
+            jdir,
+            replicas=(
+                None if procs
+                else {f"r{i}": members[i] for i in range(n_replicas)}
+            ),
+            probe_every=4,
+        )
+        recovery_s = time.perf_counter() - t_rec
+        summary = dict(router.last_recovery)
+
+        # Resume TTFT: recovery start -> next committed token, per
+        # re-adopted (still unfinished) request.
+        pre_lens = {
+            fid: len(s.generated)
+            for fid, s in router._shadows.items()
+            if not s.finished
+        }
+        resume_ttft: dict = {}
+        while submitted < n_requests or any(
+            not s.finished for s in router._shadows.values()
+        ):
+            now = time.perf_counter() - start
+            while submitted < n_requests and arrivals[submitted] <= now:
+                handles[submitted] = router.submit(
+                    prompts[submitted], SamplingParams(max_new_tokens=16)
+                )
+                submitted += 1
+            if any(not s.finished for s in router._shadows.values()):
+                router.step()
+            elif submitted < n_requests:
+                time.sleep(min(arrivals[submitted] - now, 0.01))
+            t_now = time.perf_counter()
+            for fid, pre in pre_lens.items():
+                if fid not in resume_ttft and len(
+                    router._shadows[fid].generated
+                ) > pre:
+                    resume_ttft[fid] = t_now - t_rec
+        elapsed = time.perf_counter() - start
+
+        fleet_tokens = [
+            router.poll(handles[i]).generated for i in range(n_requests)
+        ]
+        total_tokens = sum(len(t) for t in fleet_tokens)
+        leaked = sum(
+            int(rep.client.read_gauge("pages_referenced"))
+            for rep in router.replicas()
+        )
+        resumes = sorted(resume_ttft.values())
+        resume_p50 = (
+            resumes[len(resumes) // 2] if resumes else None
+        )
+        fleet_doc = {
+            "transport": "process" if procs else "in_process",
+            "n_replicas": n_replicas,
+            "workload": (
+                f"fleet{n_replicas}_poisson{arrival_rate_hz:g}hz"
+                f"_n{n_requests}_prefix{shared_prefix_len}"
+                "_kill_router"
+            ),
+            "kill_round": kill_round,
+            "recovery_s": round(recovery_s, 6),
+            "re_adopted": summary["re_adopted"],
+            "re_admitted": summary["re_admitted"],
+            "lost": summary["lost"],
+            "finished_tails": summary["finished_tails"],
+            "re_adopted_workers": summary["re_adopted_workers"],
+            "records_replayed": summary["records_replayed"],
+            "aggregate_tokens_per_sec": round(total_tokens / elapsed, 2),
+            "requests_completed": len(fleet_tokens),
+            "resume_ttft_s_p50": (
+                round(resume_p50, 6) if resume_p50 is not None else None
+            ),
+            "resume_ttft_s_max": (
+                round(resumes[-1], 6) if resumes else None
+            ),
+            "baseline_ttft_s_p50": baseline_ttft_p50,
+            # The spike: resume-TTFT p50 over baseline TTFT p50 — how much
+            # worse a request's next token is for having lived through a
+            # router crash than a fresh request's first.
+            "resume_ttft_spike_x": (
+                round(resume_p50 / baseline_ttft_p50, 4)
+                if resume_p50 is not None and baseline_ttft_p50
+                else None
+            ),
+            "greedy_tokens_match_single_engine": (
+                fleet_tokens == ref_tokens
+            ),
+            "pages_leaked": leaked,
+        }
+        router.close()
+        if procs:
+            # The recovered router closed the ATTACHED clients; the
+            # original spawner objects still hold the pipes and the (now
+            # zombie) children — reap them.
+            for m in members:
+                try:
+                    m.abandon()
+                except Exception:
+                    pass
+            members = None
+    finally:
+        if procs and members is not None:
+            for m in members:
+                try:
+                    m.abandon()
+                except Exception:
+                    pass
+        if prev_plan is None:
+            os.environ.pop(chaos.ENV_VAR, None)
+        else:
+            os.environ[chaos.ENV_VAR] = prev_plan
+        chaos._reset()
+        shutil.rmtree(jdir, ignore_errors=True)
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_SERVING.json"
+    )
+    if os.path.exists(path):
+        with open(path) as f:
+            doc = json.load(f)
+    else:
+        doc = {
+            "mode": "serving_fleet_only",
+            "platform": jax.devices()[0].platform,
+            "device_kind": jax.devices()[0].device_kind,
+            "rows": [],
+        }
+    doc["fleet_router"] = fleet_doc
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    return fleet_doc
+
+
 def bench_frontdoor(
     n_requests: int = 24,
     arrival_rate_hz: float = 20.0,
@@ -2084,6 +2417,18 @@ def main():
         "cross-process baseline)",
     )
     parser.add_argument(
+        "--kill-router", action="store_true",
+        help="with --fleet N: kill the ROUTER instead of a replica — a "
+        "seeded raise-mode kill_router fault abandons the router object "
+        "mid-run and FleetRouter.recover rebuilds a successor from the "
+        "write-ahead journal (recovery wall time, resume-TTFT spike, "
+        "re_adopted/re_admitted counts, greedy parity across the crash); "
+        "merges a 'fleet_router' section into BENCH_SERVING.json and "
+        "appends an un-gated BENCH_HISTORY.jsonl row; pair with --procs "
+        "for registry-tracked worker subprocesses re-adopted over the "
+        "localhost control plane",
+    )
+    parser.add_argument(
         "--frontdoor", action="store_true",
         help="benchmark the multi-tenant streaming front door under a "
         "mixed-tenant Poisson workload (streamed-vs-polled bitwise "
@@ -2273,6 +2618,55 @@ def run_benches(args, dev, peak):
             ]
             line["mesh_greedy_parity"] = result["mesh_greedy_parity"]
         print(json.dumps(line))
+        return
+
+    if args.fleet and args.kill_router:
+        # Exclusive mode: the durable-control-plane drill — the ROUTER is
+        # the victim. The headline is recovery wall time; the acceptance
+        # row is greedy token parity with one uninterrupted engine across
+        # the router crash.
+        fr = bench_fleet_router(
+            n_replicas=args.fleet,
+            shared_prefix_len=args.shared_prefix_len,
+            procs=args.procs,
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "fleet_router_recovery_s",
+                    "value": fr["recovery_s"],
+                    "unit": "s",
+                    "vs_baseline": 1.0,
+                    "transport": fr["transport"],
+                    "n_replicas": fr["n_replicas"],
+                    "re_adopted": fr["re_adopted"],
+                    "re_admitted": fr["re_admitted"],
+                    "lost": fr["lost"],
+                    "resume_ttft_s_p50": fr["resume_ttft_s_p50"],
+                    "resume_ttft_spike_x": fr["resume_ttft_spike_x"],
+                    "greedy_tokens_match_single_engine": fr[
+                        "greedy_tokens_match_single_engine"
+                    ],
+                    "pages_leaked": fr["pages_leaked"],
+                }
+            )
+        )
+        # The mode's contract includes the history row (un-gated — the
+        # first row seeds the router-recovery baseline).
+        import importlib.util
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        spec = importlib.util.spec_from_file_location(
+            "bench_history",
+            os.path.join(here, "tools", "bench_history.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.main([
+            "append",
+            "--bench", os.path.join(here, "BENCH_SERVING.json"),
+            "--history", os.path.join(here, "BENCH_HISTORY.jsonl"),
+        ])
         return
 
     if args.fleet:

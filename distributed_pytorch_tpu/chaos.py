@@ -91,6 +91,23 @@ _FLEET_KINDS = (
     "partition_replica_process",
 )
 
+# Router fault kinds: the control plane is the target, not a replica. They
+# fire from the same on_fleet_step hook (the router's own pump is the only
+# vantage point that knows queue pressure), but unlike the fleet kinds the
+# blast radius is the CALLING process: "hard" mode delivers the real signal
+# to self (SIGKILL for kill_router — the coordinator dies with shadows,
+# streams and route state in memory; SIGTERM for restart_router_under_load,
+# so a supervising shell can restart it), while "raise" raises
+# InjectedFault for in-process pytest drills that model router death by
+# abandoning the router object and recovering from the journal.
+# restart_router_under_load accepts ``min_queue``: it waits for at least
+# that many in-flight requests before firing, so the drill provably
+# crashes a BUSY control plane rather than an idle one.
+_ROUTER_KINDS = (
+    "kill_router",
+    "restart_router_under_load",
+)
+
 # Performance fault kinds: unlike every kind above, these do not kill,
 # hang, or disconnect anything — they make the engine SLOWER while it
 # keeps producing correct tokens, which is exactly the failure the
@@ -115,7 +132,7 @@ _KINDS = (
     "drain",
     "corrupt_snapshot",
     "store_partition",
-) + _SERVING_KINDS + _FLEET_KINDS + _PERF_KINDS
+) + _SERVING_KINDS + _FLEET_KINDS + _ROUTER_KINDS + _PERF_KINDS
 
 
 class InjectedFault(RuntimeError):
@@ -220,6 +237,15 @@ class Fault:
                 f"'replica' only applies to fleet kinds {_FLEET_KINDS}, "
                 f"not {self.kind!r}"
             )
+        elif self.kind in _ROUTER_KINDS:
+            # The router itself is the target; naming a replica is a typo.
+            if self.mode == "flip":  # dataclass default; router = hard
+                self.mode = "hard"
+            if self.mode not in ("hard", "raise"):
+                raise ValueError(
+                    f"router fault mode must be 'hard' or 'raise', "
+                    f"got {self.mode!r}"
+                )
         elif self.kind in _SERVING_KINDS:
             if self.mode == "flip":  # the dataclass default; serving = hard
                 self.mode = "hard"
@@ -248,10 +274,13 @@ class Fault:
                 f"'phase' only applies to perf kinds {_PERF_KINDS}, "
                 f"not {self.kind!r}"
             )
-        if self.min_queue is not None and self.kind != "reclaim_under_queue_pressure":
+        if self.min_queue is not None and self.kind not in (
+            "reclaim_under_queue_pressure",
+            "restart_router_under_load",
+        ):
             raise ValueError(
-                f"min_queue only applies to reclaim_under_queue_pressure, "
-                f"not {self.kind!r}"
+                f"min_queue only applies to reclaim_under_queue_pressure "
+                f"and restart_router_under_load, not {self.kind!r}"
             )
 
 
@@ -479,14 +508,23 @@ class FaultPlan:
     def has_perf_faults(self) -> bool:
         return any(f.kind in _PERF_KINDS for f in self.faults)
 
-    def on_fleet_step(self) -> List[Fault]:
+    def on_fleet_step(self, *, inflight: int = 0) -> List[Fault]:
         """Fleet chaos hook: the FleetRouter calls this once per pump
-        round. Advances the fleet-round counter and returns the due fleet
-        faults (``at_step`` is a lower bound; unset = due now) for the
-        ROUTER to apply — chaos declares, the router executes, so killing
-        "replica 2" needs no knowledge of engine objects here. Each fault
-        fires once; observers are notified exactly as for signal-delivered
-        kinds (the flight recorder's pre-SIGKILL dump hook)."""
+        round, carrying its in-flight request count. Advances the
+        fleet-round counter and returns the due fleet faults (``at_step``
+        is a lower bound; unset = due now) for the ROUTER to apply —
+        chaos declares, the router executes, so killing "replica 2" needs
+        no knowledge of engine objects here. Each fault fires once;
+        observers are notified exactly as for signal-delivered kinds (the
+        flight recorder's pre-SIGKILL dump hook).
+
+        Router kinds (kill_router / restart_router_under_load) are also
+        fired from here — the router's own pump is the one place that
+        knows both the round count and the live queue pressure — but they
+        never appear in the returned list: in "hard" mode the signal to
+        self lands before this function returns, and in "raise" mode the
+        InjectedFault propagates out of the router's step loop the same
+        way an in-process replica death would."""
         with self._lock:
             self._fleet_steps += 1
             step = self._fleet_steps
@@ -506,6 +544,37 @@ class FaultPlan:
             )
             _notify_observers(fault.kind, step, fault.mode)
             due.append(fault)
+        for i, fault in enumerate(self.faults):
+            if fault.kind not in _ROUTER_KINDS or i in self._fired:
+                continue
+            if fault.at_step is not None and step < fault.at_step:
+                continue
+            if fault.kind == "restart_router_under_load":
+                need = fault.min_queue if fault.min_queue is not None else 1
+                if inflight < need:
+                    continue
+            if not self._identity_matches(fault):
+                continue
+            self._fired.add(i)
+            _notify_observers(fault.kind, step, fault.mode)
+            if fault.mode == "raise":
+                print(
+                    f"[chaos] raising {fault.kind} at router round {step} "
+                    f"(inflight={inflight})",
+                    flush=True,
+                )
+                raise InjectedFault(fault.kind, step)
+            sig = (
+                signal.SIGKILL
+                if fault.kind == "kill_router"
+                else signal.SIGTERM
+            )
+            print(
+                f"[chaos] {fault.kind}: {signal.Signals(sig).name} self at "
+                f"router round {step} (inflight={inflight})",
+                flush=True,
+            )
+            os.kill(os.getpid(), sig)
         return due
 
     def _fire_serving(self, fault: Fault) -> None:
@@ -653,11 +722,11 @@ def on_serving_phase(phase: str, queue_depth: int = 0) -> None:
         plan.on_serving_phase(phase, queue_depth=queue_depth)
 
 
-def on_fleet_step() -> List[Fault]:
+def on_fleet_step(*, inflight: int = 0) -> List[Fault]:
     plan = get_plan()
     if plan is None:
         return []
-    return plan.on_fleet_step()
+    return plan.on_fleet_step(inflight=inflight)
 
 
 def serving_stall(phase: str) -> float:

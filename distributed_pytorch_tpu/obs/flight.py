@@ -136,19 +136,24 @@ class FlightRecorder:
         return doc
 
 
-def replay_to_tracer(dump: Union[dict, str], tracer=None):
+def replay_to_tracer(dump: Union[dict, str], tracer=None, *, pid=None):
     """Rebuild a Perfetto-loadable trace from a postmortem dump.
 
     ``dump`` may be the document dict, its JSON text, or a path to the
     dump file. ``step`` events (which carry ``dur_s``) become complete
     slices on the engine step track; everything else becomes an instant on
     the phase track, so admit/preempt/evict/fault marks line up under the
-    step timeline exactly as a live trace would show them.
+    step timeline exactly as a live trace would show them. ``pid``
+    selects the Perfetto process lane (default: the engine lane; a
+    router recovery dump replays into the router lane).
 
     Returns the tracer (a fresh one unless passed in); call
     ``to_perfetto()`` / ``save()`` on it for the Chrome trace-event JSON.
     """
     from distributed_pytorch_tpu.obs.tracer import _PID_ENGINE, Tracer
+
+    if pid is None:
+        pid = _PID_ENGINE
 
     if isinstance(dump, str):
         if os.path.exists(dump):
@@ -180,7 +185,7 @@ def replay_to_tracer(dump: Union[dict, str], tracer=None):
                     "ph": "X",
                     "ts": t_us - dur_us,
                     "dur": dur_us,
-                    "pid": _PID_ENGINE,
+                    "pid": pid,
                     "tid": 1,
                     "args": args,
                 }
@@ -193,7 +198,7 @@ def replay_to_tracer(dump: Union[dict, str], tracer=None):
                     "ph": "i",
                     "s": "g",
                     "ts": t_us,
-                    "pid": _PID_ENGINE,
+                    "pid": pid,
                     "tid": 0,
                     "args": args,
                 }

@@ -54,6 +54,14 @@ from distributed_pytorch_tpu.serving.grammar import (
     TokenDFA,
     compile_grammar,
 )
+from distributed_pytorch_tpu.serving.journal import (
+    Journal,
+    JournalError,
+    JournalState,
+    pid_alive,
+    read_worker_registry,
+    replay_journal,
+)
 from distributed_pytorch_tpu.serving.mods import (
     AdapterStore,
     Mods,
@@ -102,6 +110,9 @@ __all__ = [
     "FleetRouter",
     "FrontDoor",
     "InferenceEngine",
+    "Journal",
+    "JournalError",
+    "JournalState",
     "LocalReplicaClient",
     "ModState",
     "Mods",
@@ -135,8 +146,11 @@ __all__ = [
     "drain_engine",
     "make_serving_mesh",
     "mesh_fingerprint",
+    "pid_alive",
     "prefix_affinity_key",
     "publish_snapshot",
+    "read_worker_registry",
+    "replay_journal",
     "restore_engine",
     "snapshot_engine",
     "spawn_replica_clients",

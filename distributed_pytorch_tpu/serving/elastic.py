@@ -189,6 +189,36 @@ class EngineSnapshot:
             return cls.from_json(f.read())
 
 
+# ----------------------------------------------------- sampling-params codec
+
+
+def params_to_doc(params: SamplingParams) -> dict:
+    """Canonical JSON-able form of :class:`SamplingParams` — ONE codec for
+    every place a request's sampling config crosses a process or crash
+    boundary (the replica control plane's ``/submit`` body, the router's
+    write-ahead journal). JSON round-trips tuples as lists, so
+    ``stop_sequences`` is listified here and re-tupled by
+    :func:`params_from_doc`; keeping both directions side by side is what
+    stops the wire format and the journal format from drifting apart."""
+    doc = dataclasses.asdict(params)
+    doc["stop_sequences"] = [
+        [int(t) for t in seq] for seq in params.stop_sequences
+    ]
+    return doc
+
+
+def params_from_doc(doc: Optional[dict]) -> SamplingParams:
+    """Inverse of :func:`params_to_doc`. Tolerates a doc that came through
+    JSON (lists re-tuple) and one written by an older incarnation (missing
+    keys take the dataclass defaults)."""
+    pdoc = dict(doc or {})
+    pdoc["stop_sequences"] = tuple(
+        tuple(int(t) for t in seq)
+        for seq in pdoc.get("stop_sequences", ())
+    )
+    return SamplingParams(**pdoc)
+
+
 # ----------------------------------------------------------------- snapshot
 
 

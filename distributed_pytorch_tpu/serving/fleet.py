@@ -98,11 +98,13 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import os
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from distributed_pytorch_tpu import chaos
 from distributed_pytorch_tpu.metrics import ReservoirHistogram
+from distributed_pytorch_tpu.obs.flight import NULL_FLIGHT_RECORDER
 from distributed_pytorch_tpu.obs.registry import MetricsRegistry
 from distributed_pytorch_tpu.obs.tracer import NULL_TRACER, _PID_ROUTER
 from distributed_pytorch_tpu.serving.admission import (
@@ -114,11 +116,22 @@ from distributed_pytorch_tpu.serving.elastic import (
     SNAPSHOT_VERSION,
     EngineSnapshot,
     RequestSnapshot,
+    params_from_doc,
+    params_to_doc,
     publish_snapshot,
 )
 from distributed_pytorch_tpu.serving.engine import RequestStatus
+from distributed_pytorch_tpu.serving.journal import (
+    Journal,
+    JournalState,
+    pid_alive,
+    read_worker_registry,
+    replay_journal,
+)
+from distributed_pytorch_tpu.serving.mods import Mods
 from distributed_pytorch_tpu.serving.replica import (
     LocalReplicaClient,
+    ProcessReplicaClient,
     ReplicaClient,
     ReplicaDead,
     ReplicaError,
@@ -236,6 +249,10 @@ class ShadowRequest:
     tenant_id: str = "anon"
     mods: Optional["Mods"] = None
     cancelled: bool = False
+    # Streaming high-water mark: tokens the door already handed to the
+    # client. Journaled (batched, once per pump) so a restarted router
+    # resumes every stream at exactly the next undelivered token.
+    delivered: int = 0
     # Fleet-wide trace identity: one string across the original replica,
     # hedge twins, and every failover re-admission. Minted by the front
     # door when present, else by the router at submit.
@@ -289,6 +306,10 @@ class FleetRouter:
         id_stride: int = ID_STRIDE,
         clock: Callable[[], float] = time.perf_counter,
         tracer=None,
+        journal: Optional[Journal] = None,
+        journal_dir: Optional[str] = None,
+        journal_segment_records: int = 4096,
+        flight=None,
     ):
         self.engine_factory = engine_factory
         # Scale-out factory returning a ready ReplicaClient (either kind:
@@ -325,6 +346,27 @@ class FleetRouter:
         self._next_fid = 0
         self._round = 0
         self._last_scale_round = -(10**9)
+
+        # Durable control plane: the write-ahead journal (see journal.py)
+        # records submits/assigns/marks/finishes/replica events as they
+        # happen, so FleetRouter.recover can rebuild this router after a
+        # SIGKILL. None = journaling off (zero-cost; every hook is one
+        # `is not None` check). The flight recorder is the router-side
+        # black box — recovery dumps it with the reconciliation summary.
+        if journal is None and journal_dir is not None:
+            journal = Journal(
+                journal_dir, segment_max_records=journal_segment_records
+            )
+        self.journal = journal
+        self.flight = flight if flight is not None else NULL_FLIGHT_RECORDER
+        # Batched journal marks: delivered high-waters noted since the
+        # last flush, and the committed-length each fid was last journaled
+        # at (only growth is written).
+        self._dirty_delivered: Dict[int, int] = {}
+        self._progress_marked: Dict[int, int] = {}
+        #: Reconciliation summary of the recovery that built this router
+        #: (None for a first-incarnation router); surfaced in /statusz.
+        self.last_recovery: Optional[dict] = None
 
         self.registry = MetricsRegistry(namespace="fleet")
         self._c = {
@@ -378,7 +420,12 @@ class FleetRouter:
     # ------------------------------------------------------------ replicas
 
     def add_replica(
-        self, engine, *, name: Optional[str] = None, serve: bool = False
+        self,
+        engine,
+        *,
+        name: Optional[str] = None,
+        serve: bool = False,
+        index: Optional[int] = None,
     ) -> Replica:
         """Attach one replica — a bare engine (wrapped in a
         :class:`~.replica.LocalReplicaClient`) or a ready
@@ -402,8 +449,12 @@ class FleetRouter:
                 "token-identical failover requires identical geometry and "
                 "sampling truncation on every replica"
             )
-        index = self._attached
-        self._attached += 1
+        # ``index`` pins the attach-order slot across a recovery: the id
+        # namespace (index * id_stride) and chaos-plan targeting must mean
+        # the same replica in both router incarnations.
+        if index is None:
+            index = self._attached
+        self._attached = max(self._attached, int(index) + 1)
         if name is None:
             name = f"r{index}"
         if name in self._by_name:
@@ -423,6 +474,16 @@ class FleetRouter:
             lambda r=replica: _HEALTH_VALUE[r.state],
             help=f"1 live, 0.5 draining, 0 dead, -1 removed ({name})",
         )
+        if self.journal is not None:
+            self.journal.append_replica(
+                "spawn", name,
+                kind=client.kind,
+                index=index,
+                pid=getattr(client, "pid", None),
+                control_url=getattr(client, "control_url", None),
+                obs_url=getattr(client, "obs_url", None),
+                fingerprint=fp,
+            )
         return replica
 
     def replicas(self) -> List[Replica]:
@@ -574,6 +635,22 @@ class FleetRouter:
             )
             self._shadows[fid] = shadow
             self._by_owner[(replica.name, req_id)] = fid
+            if self.journal is not None:
+                # Journal AFTER the worker admitted (a refused submit
+                # needs no recovery) but before the caller learns the
+                # fid — the crash window between admit and this append
+                # loses only a request the caller never got a handle to.
+                self.journal.append_submit(
+                    fid,
+                    prompt=prompt,
+                    params=params_to_doc(params),
+                    metadata=metadata,
+                    tenant=tenant_id,
+                    mods=mods.to_spec() if mods is not None else None,
+                    trace_id=trace_id,
+                    replica=replica.name,
+                    req_id=req_id,
+                )
             self._c["submitted_total"].inc()
             routed_via = (
                 "affinity" if pos == 0 and routed_by == "affinity"
@@ -614,7 +691,18 @@ class FleetRouter:
         stragglers, and autoscale. Returns fleet ids finished this
         round."""
         self._round += 1
-        for fault in chaos.on_fleet_step():
+        # Flush delivered marks noted since the last round BEFORE chaos
+        # can kill this process: the pump boundary is the journal's
+        # consistency point, so a router_kill fault finds every token the
+        # door handed out already journaled (exactly-once across the
+        # crash). The inflight count feeds restart_router_under_load's
+        # min_queue condition — and a hard router fault never returns
+        # from on_fleet_step.
+        self._flush_journal_marks()
+        inflight = sum(
+            1 for s in self._shadows.values() if not s.finished
+        )
+        for fault in chaos.on_fleet_step(inflight=inflight):
             self._apply_fault(fault)
         finished: List[int] = []
         for replica in list(self._replicas):
@@ -680,6 +768,7 @@ class FleetRouter:
             and self._round % self.autoscale_every == 0
         ):
             self.maybe_autoscale()
+        self._flush_journal_marks()
         return finished
 
     def run(self, max_steps: int = 10_000) -> List[int]:
@@ -752,6 +841,53 @@ class FleetRouter:
         shadow.finished = True
         shadow.cancelled = True
         shadow.tokens = list(shadow.prompt) + list(shadow.generated)
+        if self.journal is not None:
+            self.journal.append_cancel(fid)
+
+    def note_delivered(self, fid: int, n: int) -> None:
+        """The door's streaming high-water mark for fleet request ``fid``:
+        ``n`` tokens have been handed to the client. Recorded on the
+        shadow and queued for the next batched journal flush; propagated
+        to the owning in-process engine (when there is one) so drain
+        snapshots carry it too."""
+        shadow = self._shadows.get(fid)
+        if shadow is None:
+            return
+        n = int(n)
+        if n > shadow.delivered:
+            shadow.delivered = n
+            if self.journal is not None:
+                self._dirty_delivered[fid] = n
+        replica = self._by_name.get(shadow.replica)
+        if (
+            replica is not None
+            and replica.engine is not None
+            and not shadow.finished
+        ):
+            req = replica.engine.requests.get(shadow.req_id)
+            if req is not None:
+                req.delivered = n
+
+    def _flush_journal_marks(self) -> None:
+        """Write the batched deliver/progress high-water marks. Called at
+        the pump boundaries — once per router step, not per token — so
+        journaling costs two records a round regardless of stream count."""
+        if self.journal is None:
+            return
+        if self._dirty_delivered:
+            self.journal.append_deliver(self._dirty_delivered)
+            self._dirty_delivered = {}
+        marks: Dict[int, int] = {}
+        for fid, shadow in self._shadows.items():
+            if shadow.finished:
+                self._progress_marked.pop(fid, None)
+                continue
+            n = len(shadow.generated)
+            if n > self._progress_marked.get(fid, 0):
+                marks[fid] = n
+                self._progress_marked[fid] = n
+        if marks:
+            self.journal.append_progress(marks)
 
     def _finalize(self, replica: Replica, req_id: int) -> Optional[int]:
         """One engine-level completion. The dedup rule lives here: the
@@ -776,6 +912,12 @@ class FleetRouter:
         shadow.finished = True
         shadow.generated = list(status.generated)
         shadow.tokens = list(shadow.prompt) + list(status.generated)
+        if self.journal is not None:
+            # Finish records carry the FULL generated list: a finished-
+            # but-undelivered tail must drain after recovery even if this
+            # worker is gone by then (no engine can regenerate it once
+            # the journal forgets it).
+            self.journal.append_finish(fid, status.generated)
         if shadow.first_token_s is None and status.generated:
             shadow.first_token_s = self._clock()
         won_by_hedge = (replica.name, req_id) == (
@@ -935,6 +1077,15 @@ class FleetRouter:
             f"detection {detection * 1e3:.1f}ms",
             flush=True,
         )
+        if self.journal is not None:
+            # Journaled deaths are final: recovery never re-adopts a
+            # replica this incarnation already declared dead, even if
+            # its registry entry still points at a live pid.
+            self.journal.append_replica("dead", replica.name, reason=reason)
+        self.flight.record(
+            "replica_dead", name=replica.name, reason=reason,
+            detection_s=detection,
+        )
         self._failover_from(replica)
 
     def _failover_from(self, dead: Replica) -> None:
@@ -967,10 +1118,24 @@ class FleetRouter:
                     shadow.hedge_replica = None
                     shadow.hedge_req_id = None
                     self._c["hedge_promotions_total"].inc()
+                    if self.journal is not None:
+                        self.journal.append_assign(
+                            shadow.fid, shadow.replica, shadow.req_id
+                        )
                     continue
                 shadow.hedge_replica = None
                 shadow.hedge_req_id = None
             moved.append(shadow)
+        if not moved:
+            return
+        self._rehome(moved, from_name=dead.name)
+
+    def _rehome(
+        self, moved: List[ShadowRequest], *, from_name: str
+    ) -> None:
+        """Re-admit ``moved`` shadows on live replicas through
+        ``restore_engine``'s re-prefill path, grouped by the same
+        affinity routing as fresh traffic."""
         if not moved:
             return
         now = self._clock()
@@ -982,7 +1147,7 @@ class FleetRouter:
             order, _ = self._route_order(key)
             if not order:
                 raise NoLiveReplica(
-                    f"replica {dead.name} died holding {len(moved)} "
+                    f"replica {from_name} died holding {len(moved)} "
                     "requests and no live replica remains to adopt them"
                 )
             groups.setdefault(order[0].name, []).append(shadow)
@@ -996,7 +1161,7 @@ class FleetRouter:
                     self.tracer.span_event(
                         _PID_ROUTER, shadow.fid, "failover",
                         trace_id=shadow.trace_id,
-                        from_replica=dead.name,
+                        from_replica=from_name,
                         to_replica=name,
                         committed_tokens=len(shadow.generated),
                     )
@@ -1007,6 +1172,10 @@ class FleetRouter:
                 shadow.failovers += 1
                 shadow.failover_pending_since = now
                 shadow.len_at_failover = len(shadow.generated)
+                if self.journal is not None:
+                    self.journal.append_assign(
+                        shadow.fid, name, shadow.req_id
+                    )
             self._c["requests_failed_over_total"].inc(len(shadows))
 
     def _snapshot_for(
@@ -1044,6 +1213,7 @@ class FleetRouter:
                     kv_committed=len(shadow.prompt) + len(shadow.generated),
                     trie_keys=(),
                     tenant_id=shadow.tenant_id,
+                    delivered=min(shadow.delivered, len(shadow.generated)),
                     stop_sequences=tuple(
                         tuple(int(t) for t in seq)
                         for seq in p.stop_sequences
@@ -1171,8 +1341,14 @@ class FleetRouter:
                 self._by_owner.pop((name, shadow.req_id), None)
                 shadow.replica = target.name
                 self._by_owner[(target.name, shadow.req_id)] = shadow.fid
+                if self.journal is not None:
+                    self.journal.append_assign(
+                        shadow.fid, target.name, shadow.req_id
+                    )
         replica.client.close()
         replica.state = "removed"
+        if self.journal is not None:
+            self.journal.append_replica("dead", name, reason="drained")
         self._c["drain_handoffs_total"].inc()
         return len(snap.requests)
 
@@ -1348,7 +1524,256 @@ class FleetRouter:
                     if s.hedge_replica is not None
                 ),
             },
+            "recovery": self.last_recovery,
         }
+
+    # ----------------------------------------------------------- recovery
+
+    def _journal_state(self) -> JournalState:
+        """Condense this router's live truth into a
+        :class:`~.journal.JournalState` — the seed for the post-recovery
+        journal's compaction base (the old incarnation's segments are
+        fully captured by it and deleted)."""
+        state = JournalState()
+        for replica in self._replicas:
+            if replica.state not in ("live", "draining"):
+                continue
+            client = replica.client
+            state.replicas[replica.name] = {
+                "kind": client.kind,
+                "index": replica.index,
+                "pid": getattr(client, "pid", None),
+                "control_url": getattr(client, "control_url", None),
+                "obs_url": getattr(client, "obs_url", None),
+                "fingerprint": self._fingerprint,
+                "alive": True,
+            }
+        for fid, shadow in self._shadows.items():
+            state.requests[fid] = {
+                "prompt": list(shadow.prompt),
+                "params": params_to_doc(shadow.params),
+                "metadata": shadow.metadata,
+                "tenant": shadow.tenant_id,
+                "mods": (
+                    shadow.mods.to_spec()
+                    if shadow.mods is not None
+                    else None
+                ),
+                "trace_id": shadow.trace_id,
+                "replica": shadow.replica,
+                "req_id": shadow.req_id,
+                "delivered": int(shadow.delivered),
+                "committed": len(shadow.generated),
+                "finished": shadow.finished,
+                "gen": list(shadow.generated) if shadow.finished else None,
+                "cancelled": shadow.cancelled,
+            }
+        state.next_fid = self._next_fid
+        return state
+
+    @classmethod
+    def recover(
+        cls,
+        journal_dir: str,
+        *,
+        replicas: Optional[Dict[str, ReplicaClient]] = None,
+        attach_kwargs: Optional[dict] = None,
+        segment_max_records: int = 4096,
+        **kwargs,
+    ) -> "FleetRouter":
+        """Rebuild a router after a crash from its write-ahead journal.
+
+        Reconciliation rules (in order):
+
+        - **The journal wins on request existence.** Every journaled
+          open request gets a shadow; nothing a worker reports that the
+          journal never saw is resurrected.
+        - **The worker wins on committed tokens.** Each unfinished
+          request's owning worker is polled; its engine state replaces
+          the journal's progress marks (which are a lower bound — the
+          batched flush lags by up to one pump round).
+        - **Journal-dead replicas are never re-adopted**, even if their
+          registry entry still points at a live pid (PID reuse, or a
+          worker this incarnation already failed over away from).
+
+        Workers come from ``replicas`` (name -> ready client, the
+        in-process drill path) or the run-dir worker registry
+        (``ProcessReplicaClient.attach`` on live pids — the real-crash
+        path). Orphaned requests whose worker is gone are re-admitted
+        through the same token-identical re-prefill machinery as
+        failover; finished-but-undelivered tails drain straight from the
+        journal (no engine needed). Streams resume at the journaled
+        delivered high-water, so across the restart every client sees
+        each token exactly once.
+
+        ``kwargs`` are forwarded to the constructor and must not include
+        ``journal``/``journal_dir`` — the recovered router always writes
+        a fresh compacted journal into ``journal_dir``.
+        """
+        if "journal" in kwargs or "journal_dir" in kwargs:
+            raise ValueError(
+                "recover() owns the journal; pass journal_dir positionally"
+            )
+        state = replay_journal(journal_dir)
+        router = cls(**kwargs)
+        registry = read_worker_registry(journal_dir)
+        provided = dict(replicas or {})
+        summary: dict = {
+            "re_adopted": 0,
+            "re_admitted": 0,
+            "lost": 0,
+            "finished_tails": 0,
+            "re_adopted_workers": [],
+            "lost_workers": [],
+            "corrupt_segments": list(state.corrupt),
+            "records_replayed": state.records,
+        }
+        for name, doc in sorted(
+            state.replicas.items(),
+            key=lambda kv: (kv[1].get("index") or 0, kv[0]),
+        ):
+            if not doc.get("alive"):
+                continue  # journal-dead: never re-adopt
+            client = provided.pop(name, None)
+            if client is None:
+                entry = registry.get(name)
+                if entry is None or not pid_alive(entry.get("pid")):
+                    summary["lost_workers"].append(name)
+                    continue
+                try:
+                    client = ProcessReplicaClient.attach(
+                        entry, run_dir=journal_dir,
+                        **(attach_kwargs or {}),
+                    )
+                except (ReplicaError, ValueError, KeyError, OSError) as exc:
+                    print(
+                        f"[fleet] recovery: worker {name} not "
+                        f"re-adoptable ({exc})",
+                        flush=True,
+                    )
+                    summary["lost_workers"].append(name)
+                    continue
+            router.add_replica(client, name=name, index=doc.get("index"))
+            summary["re_adopted_workers"].append(name)
+        router._next_fid = max(router._next_fid, state.next_fid)
+        now = router._clock()
+        open_docs = state.open_requests()
+        orphans: List[ShadowRequest] = []
+        for fid in sorted(open_docs):
+            doc = open_docs[fid]
+            shadow = ShadowRequest(
+                fid=fid,
+                prompt=tuple(int(t) for t in doc["prompt"]),
+                params=params_from_doc(doc["params"]),
+                metadata=doc["metadata"],
+                submit_s=now,
+                replica=doc.get("replica") or "",
+                req_id=(
+                    int(doc["req_id"])
+                    if doc.get("req_id") is not None
+                    else fid
+                ),
+                tenant_id=doc.get("tenant") or "anon",
+                mods=(
+                    Mods.from_spec(doc["mods"])
+                    if doc.get("mods")
+                    else None
+                ),
+                trace_id=doc.get("trace_id"),
+                delivered=int(doc.get("delivered", 0)),
+            )
+            router._shadows[fid] = shadow
+            if doc["finished"]:
+                # Finished-but-undelivered tail: the finish record holds
+                # the full stream, so it drains with no engine at all.
+                shadow.finished = True
+                shadow.generated = list(doc["gen"] or [])
+                shadow.tokens = (
+                    list(shadow.prompt) + list(shadow.generated)
+                )
+                summary["finished_tails"] += 1
+                continue
+            replica = router._by_name.get(shadow.replica)
+            adopted = False
+            if replica is not None and replica.state == "live":
+                try:
+                    status = replica.client.poll(shadow.req_id)
+                except (KeyError, ReplicaError):
+                    status = None
+                if status is not None:
+                    # Worker wins on committed tokens.
+                    adopted = True
+                    shadow.generated = list(status.generated)
+                    router._by_owner[(replica.name, shadow.req_id)] = fid
+                    if status.finished:
+                        shadow.finished = True
+                        shadow.tokens = (
+                            list(shadow.prompt) + list(shadow.generated)
+                        )
+                    summary["re_adopted"] += 1
+            if not adopted:
+                # Dead worker: journal progress marks are only a lower
+                # bound, and regeneration is token-identical from the
+                # fold index — re-admit from scratch.
+                shadow.generated = []
+                orphans.append(shadow)
+        if orphans:
+            if router._eligible():
+                router._rehome(orphans, from_name="<crashed router>")
+                summary["re_admitted"] = len(orphans)
+            else:
+                for shadow in orphans:
+                    shadow.finished = True
+                    shadow.cancelled = True
+                    shadow.tokens = (
+                        list(shadow.prompt) + list(shadow.generated)
+                    )
+                summary["lost"] = len(orphans)
+        if router.tracer.enabled:
+            # Re-open the router span for every in-flight request so the
+            # old incarnation's trace ids thread through this one and
+            # _finalize's span_end balances.
+            for shadow in router._shadows.values():
+                if shadow.finished:
+                    continue
+                router.tracer.span_begin(
+                    _PID_ROUTER, shadow.fid, "route",
+                    trace_id=shadow.trace_id,
+                    replica=shadow.replica,
+                    routed_by="recovered",
+                    tenant=shadow.tenant_id,
+                )
+        router.last_recovery = summary
+        router.flight.record(
+            "router_recover",
+            re_adopted=summary["re_adopted"],
+            re_admitted=summary["re_admitted"],
+            lost=summary["lost"],
+            finished_tails=summary["finished_tails"],
+            workers=list(summary["re_adopted_workers"]),
+        )
+        if router.flight.enabled:
+            router.flight.dump(
+                "router_recovery",
+                path=os.path.join(
+                    journal_dir, "router_recovery_flight.json"
+                ),
+                extra={"reconciliation": summary},
+            )
+        # The recovered truth becomes the new journal's compaction base;
+        # the dead incarnation's segments are deleted once captured.
+        router.journal = Journal(
+            journal_dir,
+            segment_max_records=segment_max_records,
+            state=router._journal_state(),
+        )
+        router._progress_marked = {
+            fid: len(s.generated)
+            for fid, s in router._shadows.items()
+            if not s.finished
+        }
+        router.journal.append_recovery(summary)
+        return router
 
     def close(self) -> None:
         """Close every live/draining replica (leak-checked, like a single
@@ -1359,12 +1784,16 @@ class FleetRouter:
         ones whose quiescence the drill asserts — but their residue
         (router-side server threads, child pipes, an unreaped zombie) is
         torn down via :meth:`~.replica.ReplicaClient.abandon`."""
+        if self.journal is not None:
+            self._flush_journal_marks()
         for replica in self._replicas:
             if replica.state in ("live", "draining"):
                 replica.client.close()
                 replica.state = "removed"
             elif replica.state == "dead":
                 replica.client.abandon()
+        if self.journal is not None:
+            self.journal.close()
 
 
 __all__ = [

@@ -846,6 +846,11 @@ class FrontDoor:
                     ),
                 }
             doc["tenants"] = tenants
+            router = getattr(self._backend, "router", None)
+            if router is not None:
+                # Fleet block: route table, shadow census, and (after a
+                # crash restart) the recovery reconciliation summary.
+                doc["fleet"] = router.describe()
             if self.sampler is not None:
                 sampler_doc = dict(self.sampler.counters())
                 sampler_doc["kept"] = len(self.sampler.kept_ids())
@@ -932,14 +937,19 @@ class _RouterBackend:
         self.router = router
 
     def slots_hint(self) -> int:
-        return max(
-            1,
-            sum(
-                r.engine.max_slots
-                for r in self.router.replicas()
-                if r.state == "live"
-            ),
-        )
+        total = 0
+        for r in self.router.replicas():
+            if r.state != "live":
+                continue
+            if r.engine is not None:
+                total += r.engine.max_slots
+            else:
+                # Process replica: no in-process engine, read the spec.
+                spec = getattr(r.client, "spec", None) or {}
+                total += int(
+                    (spec.get("engine") or {}).get("max_slots", 1) or 1
+                )
+        return max(1, total)
 
     def submit(
         self, prompt, params, metadata, *, tenant_id, mods, trace_id=None
@@ -967,24 +977,24 @@ class _RouterBackend:
         self.router.cancel(fid)
 
     def note_delivered(self, fid: int, n: int) -> None:
-        # Best-effort: propagate the high-water mark to the owning
-        # engine request so a drain snapshot taken on that replica
-        # carries it. The shadow's committed view already bounds what a
-        # failover can lose.
-        shadow = self.router._shadows.get(fid)
-        if shadow is None or shadow.finished:
-            return
-        replica = self.router._by_name.get(shadow.replica)
-        if replica is None or replica.state in ("dead", "removed"):
-            return
-        req = replica.engine.requests.get(shadow.req_id)
-        if req is not None:
-            req.delivered = min(n, len(req.generated))
+        # The router records the mark on the shadow (journaled when a
+        # journal is attached — the recovery resume point) and
+        # propagates it to the owning in-process engine for drain
+        # snapshots.
+        self.router.note_delivered(fid, n)
 
     def live_requests(self):
+        # Finished-but-undelivered shadows are included: after a router
+        # recovery their tails drain from the journaled finish record,
+        # and the stream must resume at the journaled high-water mark.
         for fid, shadow in sorted(self.router._shadows.items()):
-            if not shadow.finished:
-                yield fid, shadow.tenant_id, 0
+            if shadow.cancelled:
+                continue
+            if shadow.finished and shadow.delivered >= len(
+                shadow.generated
+            ):
+                continue
+            yield fid, shadow.tenant_id, shadow.delivered
 
     def failovers(self, fid: int) -> int:
         shadow = self.router._shadows.get(fid)

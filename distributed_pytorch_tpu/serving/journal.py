@@ -1,0 +1,568 @@
+"""Write-ahead journal + worker registry: the durable control plane.
+
+PR 16 made replicas crash-isolated processes; this module makes the
+*router* restartable. Every control-plane decision that matters for
+exactly-once serving is journaled to disk before (or immediately after)
+it takes effect, so a SIGKILLed ``FleetRouter`` can be rebuilt by
+``FleetRouter.recover`` from the journal plus the still-running workers:
+
+* **submits** — prompt, sampling params, tenant, mods spec, trace_id,
+  and the (replica, req_id) placement;
+* **assigns** — re-placements after failover / hedge promotion;
+* **deliver marks** — batched per-stream delivered-token high-water
+  marks (flushed once per router step, not per token);
+* **progress marks** — batched per-request committed-token high-water
+  marks (observability + recovery sanity, never authoritative: the
+  worker wins on committed tokens);
+* **finish / cancel acks** — terminal transitions, with the full
+  generated token list on finish so a finished-but-undelivered stream
+  can drain after recovery even if its worker is gone;
+* **replica spawn / death events** — which workers existed, where their
+  control servers listen, and which ones the old router already
+  declared dead (those are never re-adopted).
+
+Format — CRC-per-record JSONL segments, the same checksum/quarantine
+discipline as ``checkpoint.py`` (crc32c when a native impl exists,
+stdlib crc32 otherwise; the record tags which algorithm wrote it via the
+segment meta record). One record per line::
+
+    <crc32-hex-8> <compact-json>\n
+
+A record whose line is truncated (torn write at SIGKILL) or whose CRC
+mismatches (bit rot, chaos ``corrupt_file``) is *quarantined*: the bad
+tail is copied to ``<segment>.corrupt`` (``.corrupt.N`` on collision),
+the segment is truncated back to the last good record, and replay
+resumes from there — corruption costs the torn record, never the run.
+
+Disk use is bounded by **segment rotation + compaction**: when the live
+segment exceeds ``segment_max_records`` the journal rotates to a fresh
+segment whose head is a condensed re-statement of live state only —
+open requests, undelivered finished tails, and live replicas — and the
+older segments are deleted. Closed, fully-delivered requests vanish at
+the first rotation after they close.
+
+Durability model: records are flushed to the OS page cache after every
+append (``flush()``, no fsync). That survives any *process* crash —
+SIGKILL included, which is the failure mode this journal exists for. A
+kernel panic or power loss can lose the last marks, which degrades
+exactly-once to at-least-once: streams re-deliver a suffix and the door
+dedups by token index (see ``FrontDoor.adopt_streams``).
+
+The **worker registry** lives next to the segments in
+``<dir>/workers/<name>.json``: each ``ProcessReplicaClient`` spawn
+records pid + control/obs URLs + spec fingerprint there, and removes the
+file on clean shutdown. ``FleetRouter.recover`` re-adopts workers whose
+registry entry still points at a live pid that answers ``/adopt`` with a
+matching fingerprint.
+
+Stdlib-only on purpose: replaying a journal or listing orphaned workers
+must not require JAX.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+JOURNAL_VERSION = 1
+SEGMENT_PREFIX = "journal-"
+SEGMENT_SUFFIX = ".jsonl"
+WORKERS_SUBDIR = "workers"
+
+try:  # Same CRC ladder as checkpoint.py: Castagnoli if native, else crc32.
+    import crc32c as _crc32c_mod
+
+    _CRC_ALGO = "crc32c"
+
+    def _crc(data: bytes) -> int:
+        return _crc32c_mod.crc32c(data)
+
+except ImportError:
+    _CRC_ALGO = "crc32"
+
+    def _crc(data: bytes) -> int:
+        return zlib.crc32(data) & 0xFFFFFFFF
+
+
+class JournalError(RuntimeError):
+    """The journal directory is unusable (not a directory, unwritable,
+    or a segment could not be opened). Per-record corruption is NOT an
+    error — it is quarantined and replay continues."""
+
+
+# --------------------------------------------------------------------------
+# Replayed state
+
+
+@dataclass
+class JournalState:
+    """The fold of every surviving record: what the dead router knew.
+
+    ``requests`` maps fid -> a mutable doc with keys ``prompt``,
+    ``params``, ``metadata``, ``tenant``, ``mods``, ``trace_id``,
+    ``replica``, ``req_id``, ``delivered``, ``committed``, ``finished``,
+    ``gen`` (generated tokens, only once finished), ``cancelled``.
+    ``replicas`` maps name -> its last spawn doc plus ``alive`` (False
+    once a death/removal record was journaled — recovery never re-adopts
+    those). ``corrupt`` lists quarantine paths written during replay.
+    """
+
+    requests: Dict[int, Dict[str, Any]] = field(default_factory=dict)
+    replicas: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    next_fid: int = 0
+    records: int = 0
+    segments: int = 0
+    corrupt: List[str] = field(default_factory=list)
+
+    def apply(self, rec: Dict[str, Any]) -> None:
+        kind = rec.get("k")
+        if kind == "meta":
+            self.next_fid = max(self.next_fid, int(rec.get("next_fid", 0)))
+        elif kind == "submit":
+            fid = int(rec["fid"])
+            self.requests[fid] = {
+                "prompt": list(rec["prompt"]),
+                "params": dict(rec["params"]),
+                "metadata": rec.get("metadata"),
+                "tenant": rec.get("tenant", "anon"),
+                "mods": rec.get("mods"),
+                "trace_id": rec.get("trace_id"),
+                "replica": rec.get("replica"),
+                "req_id": rec.get("req_id"),
+                "delivered": int(rec.get("delivered", 0)),
+                "committed": int(rec.get("committed", 0)),
+                "finished": False,
+                "gen": None,
+                "cancelled": False,
+            }
+            self.next_fid = max(self.next_fid, fid + 1)
+        elif kind == "assign":
+            doc = self.requests.get(int(rec["fid"]))
+            if doc is not None:
+                doc["replica"] = rec.get("replica")
+                doc["req_id"] = rec.get("req_id")
+        elif kind == "deliver":
+            for fid_s, n in rec.get("marks", {}).items():
+                doc = self.requests.get(int(fid_s))
+                if doc is not None:
+                    doc["delivered"] = max(doc["delivered"], int(n))
+        elif kind == "progress":
+            for fid_s, n in rec.get("marks", {}).items():
+                doc = self.requests.get(int(fid_s))
+                if doc is not None:
+                    doc["committed"] = max(doc["committed"], int(n))
+        elif kind == "finish":
+            doc = self.requests.get(int(rec["fid"]))
+            if doc is not None:
+                doc["finished"] = True
+                doc["gen"] = list(rec.get("gen", []))
+                doc["committed"] = len(doc["gen"])
+        elif kind == "cancel":
+            doc = self.requests.get(int(rec["fid"]))
+            if doc is not None:
+                doc["cancelled"] = True
+        elif kind == "replica":
+            name = rec["name"]
+            ev = rec.get("ev")
+            if ev == "spawn":
+                doc = {
+                    key: rec.get(key)
+                    for key in (
+                        "kind", "index", "pid", "control_url", "obs_url",
+                        "fingerprint",
+                    )
+                }
+                doc["alive"] = True
+                self.replicas[name] = doc
+            else:  # dead / removed
+                doc = self.replicas.setdefault(name, {"alive": False})
+                doc["alive"] = False
+                doc["reason"] = rec.get("reason")
+        # "recovery" records are informational; unknown kinds from a
+        # newer writer are skipped rather than fatal.
+        self.records += 1
+
+    def open_requests(self) -> Dict[int, Dict[str, Any]]:
+        """Requests recovery must still care about: not cancelled, and
+        either unfinished or finished with an undelivered tail."""
+        out = {}
+        for fid, doc in self.requests.items():
+            if doc["cancelled"]:
+                continue
+            if doc["finished"] and doc["delivered"] >= len(doc["gen"] or ()):
+                continue
+            out[fid] = doc
+        return out
+
+
+# --------------------------------------------------------------------------
+# Segment I/O
+
+
+def _segment_path(dir_path: str, index: int) -> str:
+    return os.path.join(
+        dir_path, f"{SEGMENT_PREFIX}{index:06d}{SEGMENT_SUFFIX}"
+    )
+
+
+def journal_segments(dir_path: str) -> List[str]:
+    """Segment files in replay order (by index)."""
+    if not os.path.isdir(dir_path):
+        return []
+    out = []
+    for name in os.listdir(dir_path):
+        if name.startswith(SEGMENT_PREFIX) and name.endswith(SEGMENT_SUFFIX):
+            stem = name[len(SEGMENT_PREFIX):-len(SEGMENT_SUFFIX)]
+            if stem.isdigit():
+                out.append((int(stem), os.path.join(dir_path, name)))
+    return [path for _, path in sorted(out)]
+
+
+def _segment_index(path: str) -> int:
+    stem = os.path.basename(path)[len(SEGMENT_PREFIX):-len(SEGMENT_SUFFIX)]
+    return int(stem)
+
+
+def encode_record(rec: Dict[str, Any]) -> bytes:
+    payload = json.dumps(
+        rec, separators=(",", ":"), sort_keys=True
+    ).encode("utf-8")
+    return b"%08x " % _crc(payload) + payload + b"\n"
+
+
+def decode_record(line: bytes) -> Optional[Dict[str, Any]]:
+    """One journal line -> record dict, or None if torn/corrupt."""
+    if not line.endswith(b"\n"):
+        return None  # torn tail: the writer died mid-append
+    body = line[:-1]
+    if len(body) < 10 or body[8:9] != b" ":
+        return None
+    try:
+        want = int(body[:8], 16)
+    except ValueError:
+        return None
+    payload = body[9:]
+    if _crc(payload) != want:
+        return None
+    try:
+        rec = json.loads(payload)
+    except ValueError:
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+def quarantine_tail(path: str, good_len: int) -> Optional[str]:
+    """Copy everything past ``good_len`` to ``<path>.corrupt`` (checkpoint's
+    collision-suffix naming) and truncate the segment back to the last good
+    record. Returns the quarantine path, or None if nothing was written."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(good_len)
+            tail = f.read()
+        if not tail:
+            return None
+        dest = path + ".corrupt"
+        n = 0
+        while os.path.exists(dest):
+            n += 1
+            dest = f"{path}.corrupt.{n}"
+        with open(dest, "wb") as f:
+            f.write(tail)
+        with open(path, "r+b") as f:
+            f.truncate(good_len)
+    except OSError:
+        return None
+    print(
+        f"[journal] quarantined torn/corrupt tail of "
+        f"{os.path.basename(path)} -> {os.path.basename(dest)}"
+    )
+    return dest
+
+
+def _replay_segment(path: str, state: JournalState) -> None:
+    with open(path, "rb") as f:
+        data = f.read()
+    offset = 0
+    while offset < len(data):
+        end = data.find(b"\n", offset)
+        line = data[offset:] if end < 0 else data[offset:end + 1]
+        rec = decode_record(line)
+        if rec is None:
+            dest = quarantine_tail(path, offset)
+            if dest is not None:
+                state.corrupt.append(dest)
+            return
+        state.apply(rec)
+        offset = end + 1
+
+
+def replay_journal(dir_path: str) -> JournalState:
+    """Fold every segment (in order) into a :class:`JournalState`,
+    quarantining any torn or CRC-corrupt tail and resuming from the last
+    good record."""
+    state = JournalState()
+    for path in journal_segments(dir_path):
+        _replay_segment(path, state)
+        state.segments += 1
+    return state
+
+
+# --------------------------------------------------------------------------
+# Writer
+
+
+class Journal:
+    """Append-only writer over CRC'd JSONL segments with rotation +
+    compaction. One instance per router incarnation; never shared.
+
+    Opening always starts a *fresh* segment (a dead incarnation's torn
+    tail is someone else's replay problem, handled by
+    :func:`replay_journal` before the new writer is built). Pass the
+    replayed ``state`` to seed the live-state mirror — the constructor
+    then writes a compacted base segment and deletes the old ones.
+    """
+
+    def __init__(
+        self,
+        dir_path: str,
+        *,
+        segment_max_records: int = 4096,
+        state: Optional[JournalState] = None,
+    ):
+        self.dir = dir_path
+        self.segment_max_records = max(8, int(segment_max_records))
+        os.makedirs(dir_path, exist_ok=True)
+        if not os.path.isdir(dir_path):
+            raise JournalError(f"journal dir {dir_path!r} is not a directory")
+        self._state = state if state is not None else JournalState()
+        existing = journal_segments(dir_path)
+        self._seg_index = (
+            _segment_index(existing[-1]) + 1 if existing else 1
+        )
+        self._fh = None
+        self._seg_records = 0
+        self.records_written = 0
+        self.rotations = 0
+        self.compacted_away = 0
+        self._open_segment()
+        if state is not None:
+            # Recovery path: re-state live truth compactly, then drop the
+            # old incarnation's segments — they are fully captured.
+            self._write_compaction_base()
+            for path in existing:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _open_segment(self) -> None:
+        path = _segment_path(self.dir, self._seg_index)
+        try:
+            self._fh = open(path, "ab")
+        except OSError as exc:
+            raise JournalError(f"cannot open segment {path!r}: {exc}")
+        self._seg_records = 0
+        self.append({
+            "k": "meta",
+            "version": JOURNAL_VERSION,
+            "crc": _CRC_ALGO,
+            "segment": self._seg_index,
+            "next_fid": self._state.next_fid,
+        })
+
+    def append(self, rec: Dict[str, Any]) -> None:
+        if self._fh is None:
+            raise JournalError("journal is closed")
+        self._fh.write(encode_record(rec))
+        # flush() pushes to the OS page cache: survives SIGKILL of this
+        # process, which is the crash model. No fsync — power loss only
+        # degrades exactly-once to at-least-once (door dedups by index).
+        self._fh.flush()
+        self._state.apply(rec)
+        self._seg_records += 1
+        self.records_written += 1
+        if self._seg_records >= self.segment_max_records:
+            self.rotate()
+
+    def rotate(self) -> None:
+        """Close the live segment, open the next one with a compacted
+        base, and delete everything older — bounded disk."""
+        old = journal_segments(self.dir)
+        self._fh.close()
+        self._seg_index += 1
+        self.rotations += 1
+        self._open_segment()
+        self._write_compaction_base()
+        for path in old:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def _write_compaction_base(self) -> None:
+        """Condense live state into the head of the current segment:
+        live replicas, open requests (with their current placement and
+        high-water marks), and undelivered finished tails. Closed,
+        fully-delivered requests are dropped here — this is the
+        compaction that bounds disk use."""
+        live = self._state.open_requests()
+        self.compacted_away += len(self._state.requests) - len(live)
+        for name, doc in sorted(self._state.replicas.items()):
+            if not doc.get("alive"):
+                continue
+            self.append({
+                "k": "replica", "ev": "spawn", "name": name,
+                **{key: doc.get(key) for key in (
+                    "kind", "index", "pid", "control_url", "obs_url",
+                    "fingerprint",
+                )},
+            })
+        for fid in sorted(live):
+            doc = live[fid]
+            self.append({
+                "k": "submit", "fid": fid,
+                "prompt": doc["prompt"], "params": doc["params"],
+                "metadata": doc["metadata"], "tenant": doc["tenant"],
+                "mods": doc["mods"], "trace_id": doc["trace_id"],
+                "replica": doc["replica"], "req_id": doc["req_id"],
+                "delivered": doc["delivered"],
+                "committed": doc["committed"],
+            })
+            if doc["finished"]:
+                self.append({"k": "finish", "fid": fid, "gen": doc["gen"]})
+        # Drop closed requests from the mirror too, or they re-survive
+        # every future rotation.
+        self._state.requests = dict(live)
+
+    # -- record helpers ----------------------------------------------------
+
+    def append_submit(
+        self, fid: int, *, prompt, params: Dict[str, Any], metadata,
+        tenant: str, mods, trace_id, replica: Optional[str],
+        req_id: Optional[int],
+    ) -> None:
+        self.append({
+            "k": "submit", "fid": int(fid), "prompt": list(prompt),
+            "params": params, "metadata": metadata, "tenant": tenant,
+            "mods": mods, "trace_id": trace_id, "replica": replica,
+            "req_id": req_id,
+        })
+
+    def append_assign(self, fid: int, replica: str, req_id: int) -> None:
+        self.append({
+            "k": "assign", "fid": int(fid), "replica": replica,
+            "req_id": int(req_id),
+        })
+
+    def append_deliver(self, marks: Dict[int, int]) -> None:
+        if marks:
+            self.append({
+                "k": "deliver",
+                "marks": {str(fid): int(n) for fid, n in marks.items()},
+            })
+
+    def append_progress(self, marks: Dict[int, int]) -> None:
+        if marks:
+            self.append({
+                "k": "progress",
+                "marks": {str(fid): int(n) for fid, n in marks.items()},
+            })
+
+    def append_finish(self, fid: int, gen) -> None:
+        self.append({
+            "k": "finish", "fid": int(fid), "gen": [int(t) for t in gen],
+        })
+
+    def append_cancel(self, fid: int) -> None:
+        self.append({"k": "cancel", "fid": int(fid)})
+
+    def append_replica(self, ev: str, name: str, **info: Any) -> None:
+        self.append({"k": "replica", "ev": ev, "name": name, **info})
+
+    def append_recovery(self, summary: Dict[str, Any]) -> None:
+        self.append({"k": "recovery", **summary})
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def state(self) -> JournalState:
+        return self._state
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.flush()
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+
+# --------------------------------------------------------------------------
+# Worker registry
+
+
+def registry_dir(run_dir: str) -> str:
+    return os.path.join(run_dir, WORKERS_SUBDIR)
+
+
+def write_worker_entry(run_dir: str, entry: Dict[str, Any]) -> str:
+    """Atomically record a spawned worker (pid, control/obs URLs, spec
+    fingerprint) under ``<run_dir>/workers/<name>.json``."""
+    name = entry["name"]
+    dir_path = registry_dir(run_dir)
+    os.makedirs(dir_path, exist_ok=True)
+    path = os.path.join(dir_path, f"{name}.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(entry, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def remove_worker_entry(run_dir: str, name: str) -> None:
+    try:
+        os.unlink(os.path.join(registry_dir(run_dir), f"{name}.json"))
+    except OSError:
+        pass
+
+
+def read_worker_registry(run_dir: str) -> Dict[str, Dict[str, Any]]:
+    """name -> registry entry for every recorded worker (dead or alive —
+    callers probe the pid)."""
+    dir_path = registry_dir(run_dir)
+    out: Dict[str, Dict[str, Any]] = {}
+    if not os.path.isdir(dir_path):
+        return out
+    for fname in sorted(os.listdir(dir_path)):
+        if not fname.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(dir_path, fname)) as f:
+                entry = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(entry, dict) and "name" in entry:
+            out[entry["name"]] = entry
+    return out
+
+
+def pid_alive(pid: Optional[int]) -> bool:
+    """Signal-0 liveness probe (same-user processes only, which is the
+    only kind this control plane spawns)."""
+    if not pid or pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    except OSError:
+        return False
+    return True

@@ -48,7 +48,6 @@ Failure taxonomy the router keys off:
 from __future__ import annotations
 
 import collections
-import dataclasses
 import itertools
 import json
 import os
@@ -71,7 +70,12 @@ from distributed_pytorch_tpu.serving.elastic import (
     adopt_snapshot,
     drain_engine,
     fetch_snapshot_text,
+    params_to_doc,
     restore_engine,
+)
+from distributed_pytorch_tpu.serving.journal import (
+    remove_worker_entry,
+    write_worker_entry,
 )
 from distributed_pytorch_tpu.serving.engine import RequestStatus
 from distributed_pytorch_tpu.serving.scheduler import SamplingParams
@@ -459,10 +463,12 @@ class LocalReplicaClient(ReplicaClient):
 #: Control-plane ops safe to retry on transport failure. ``submit`` and
 #: ``cancel`` qualify because the worker dedups them through a replay map
 #: keyed by a client-minted request id; ``poll``/``health``/``describe``
-#: are read-only. ``step`` is deliberately absent (see module docstring).
+#: are read-only; ``adopt`` converges (claiming an already-claimed worker
+#: is a no-op answer). ``step`` is deliberately absent (see module
+#: docstring).
 _IDEMPOTENT = frozenset({
     "/submit", "/cancel", "/poll", "/health", "/describe", "/gauge",
-    "/reserve_ids",
+    "/reserve_ids", "/adopt",
 })
 
 _HELLO_KEY = "replica_hello"
@@ -480,11 +486,79 @@ def _status_from_doc(doc: dict) -> RequestStatus:
 
 
 def _params_to_doc(params: SamplingParams) -> dict:
-    doc = dataclasses.asdict(params)
-    doc["stop_sequences"] = [
-        [int(t) for t in seq] for seq in params.stop_sequences
-    ]
-    return doc
+    # One canonical codec (elastic.params_to_doc) serves the control-plane
+    # wire AND the router's write-ahead journal, so a journaled submit can
+    # be re-submitted byte-identically after a router crash.
+    return params_to_doc(params)
+
+
+class _PidProcess:
+    """``Popen`` look-alike over a bare pid, for ATTACHING to a worker
+    this process never spawned (router crash recovery re-adopts workers
+    the DEAD router's registry points at). Implements exactly the surface
+    :class:`ProcessReplicaClient` touches — ``poll``/``wait``/
+    ``terminate``/``kill``, ``.pid``/``.returncode``, ``None`` pipes.
+    A non-child cannot be ``waitpid``-ed, so liveness is probed with
+    ``kill(pid, 0)`` and death reported as returncode ``-1`` (the true
+    exit code belongs to whoever reaped it)."""
+
+    def __init__(self, pid: int):
+        self.pid = int(pid)
+        self.returncode: Optional[int] = None
+        self.stdin = None
+        self.stdout = None
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        try:
+            os.kill(self.pid, 0)
+        except ProcessLookupError:
+            self.returncode = -1
+        except PermissionError:
+            pass  # exists, owned by someone else: alive
+        except OSError:
+            self.returncode = -1
+        else:
+            # ``kill(pid, 0)`` succeeds on a ZOMBIE — an exited worker
+            # whose (still-living) spawner has not reaped it yet. That
+            # worker is gone for every purpose this shim serves.
+            if self._is_zombie():
+                self.returncode = -1
+        return self.returncode
+
+    def _is_zombie(self) -> bool:
+        try:
+            with open(f"/proc/{self.pid}/stat", "rb") as f:
+                stat = f.read()
+            # Field 3, after the parenthesized (possibly space-laden) comm.
+            return stat.rpartition(b")")[2].split()[0] == b"Z"
+        except (OSError, IndexError):
+            return False  # no procfs: fall back to kill(0) semantics
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise subprocess.TimeoutExpired(
+                    f"pid {self.pid}", timeout
+                )
+            time.sleep(0.02)
+        return self.returncode
+
+    def terminate(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGTERM)
+        except OSError:
+            pass
+
+    def kill(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except OSError:
+            pass
 
 
 class ProcessReplicaClient(ReplicaClient):
@@ -524,8 +598,13 @@ class ProcessReplicaClient(ReplicaClient):
         breaker_reset_s: float = 1.0,
         env: Optional[Dict[str, str]] = None,
         clock: Callable[[], float] = time.perf_counter,
+        run_dir: Optional[str] = None,
+        attach_entry: Optional[dict] = None,
     ):
+        if attach_entry is not None and name is None:
+            name = attach_entry.get("name")
         self.name = name or spec.get("name") or "replica"
+        self.run_dir = run_dir
         self.spec = spec
         self.call_timeout_s = call_timeout_s
         self.step_timeout_s = step_timeout_s or call_timeout_s
@@ -555,6 +634,14 @@ class ProcessReplicaClient(ReplicaClient):
         self._log_tail: collections.deque = collections.deque(maxlen=100)
         self._hello: Optional[dict] = None
         self._hello_event = threading.Event()
+        #: True when this client ATTACHED to an orphaned worker (router
+        #: recovery) rather than spawning it; the recovery summary counts
+        #: these as re-adoptions.
+        self.adopted = False
+
+        if attach_entry is not None:
+            self._attach(attach_entry)
+            return
 
         child_env = dict(os.environ if env is None else env)
         # Chaos plans are delivered by the ROUTER through this client —
@@ -595,6 +682,65 @@ class ProcessReplicaClient(ReplicaClient):
         self.obs_url: str = self._hello["obs_url"]
         self.pid: int = int(self._hello["pid"])
         self._fingerprint: dict = dict(self._hello["fingerprint"])
+        self._write_registry_entry()
+
+    @classmethod
+    def attach(cls, entry: dict, **kwargs) -> "ProcessReplicaClient":
+        """Re-adopt a LIVE worker from its registry entry instead of
+        spawning one — the router-recovery path. The entry must carry
+        ``pid``/``control_url``/``obs_url``/``fingerprint`` (what
+        :meth:`_write_registry_entry` persists); the worker is claimed
+        and identity-checked via ``POST /adopt``, which refuses (409 →
+        ``ValueError`` here) if the pid was reborn as a different
+        process or the spec fingerprint disagrees."""
+        return cls(
+            dict(entry.get("spec") or {}), attach_entry=entry, **kwargs
+        )
+
+    def _attach(self, entry: dict) -> None:
+        self._proc = _PidProcess(int(entry["pid"]))
+        self._pump = None
+        self._hello = dict(entry)
+        self._hello_event.set()
+        self.control_url = entry["control_url"]
+        self.obs_url = entry["obs_url"]
+        self.pid = int(entry["pid"])
+        self._fingerprint = dict(entry.get("fingerprint") or {})
+        self._check_alive()  # pid already gone: ReplicaDead, not a probe
+        doc = self._call("/adopt", {
+            "name": self.name,
+            "pid": self.pid,
+            "fingerprint": self._fingerprint or None,
+        })
+        self.adopted = True
+        self.adopted_orphan = bool(doc.get("orphaned"))
+        self._write_registry_entry()
+
+    # ------------------------------------------------------------ registry
+
+    def _write_registry_entry(self) -> None:
+        """Persist this worker's coordinates for a successor router.
+
+        The entry is the recovery bootstrap: everything
+        :meth:`attach` needs to re-adopt the worker after THIS router
+        process is gone. Written on spawn and refreshed on attach; removed
+        on deliberate teardown (:meth:`close` / :meth:`abandon`) so the
+        registry only ever lists workers somebody should re-adopt."""
+        if self.run_dir is None:
+            return
+        write_worker_entry(self.run_dir, {
+            "name": self.name,
+            "pid": self.pid,
+            "control_url": self.control_url,
+            "obs_url": self.obs_url,
+            "fingerprint": self._fingerprint,
+            "spec": self.spec,
+            "written_s": time.time(),
+        })
+
+    def _remove_registry_entry(self) -> None:
+        if self.run_dir is not None:
+            remove_worker_entry(self.run_dir, self.name)
 
     # ------------------------------------------------------------ plumbing
 
@@ -886,13 +1032,21 @@ class ProcessReplicaClient(ReplicaClient):
                 self._proc.kill()
                 self._proc.wait(timeout=5.0)
         self._release_pipes()
+        self._remove_registry_entry()
         code = self._proc.returncode
         if err is not None:
             raise ReplicaError(
                 f"replica worker {self.name} failed to close cleanly "
                 f"(exit {code}): {err}"
             ) from err
-        if code not in (0, None) and self._chaos_kind is None:
+        if (
+            code not in (0, None)
+            and self._chaos_kind is None
+            # An attached (non-child) worker cannot be reaped, so its
+            # true exit code is unknowable; -1 there means "gone", not
+            # "failed".
+            and not isinstance(self._proc, _PidProcess)
+        ):
             tail = "\n".join(self._log_tail)
             raise ReplicaError(
                 f"replica worker {self.name} exited {code} on close; "
@@ -913,6 +1067,7 @@ class ProcessReplicaClient(ReplicaClient):
         except Exception:
             pass
         self._release_pipes()
+        self._remove_registry_entry()
 
     def _release_pipes(self) -> None:
         for stream in (self._proc.stdin, self._proc.stdout):

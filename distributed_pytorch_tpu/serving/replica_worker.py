@@ -15,7 +15,23 @@ kernel-assigned ports —
 
 then announces both in ONE hello line on stdout and serves until told to
 shut down (or until stdin hits EOF: the parent died, so exit rather than
-orphan).
+orphan — unless ``TPURUN_ORPHAN_GRACE`` grants a re-adoption window, see
+below).
+
+Orphan grace: by default stdin EOF is death (``os._exit(3)``), which is
+the right call when the parent's crash means nobody will ever route to
+this worker again. But when the parent is a *recoverable* router (its
+journal + the worker registry let a successor re-attach), killing healthy
+workers turns one control-plane crash into a whole-fleet outage. Setting
+``TPURUN_ORPHAN_GRACE=<seconds>`` makes the watchdog enter an ORPHANED
+state on EOF instead: the worker keeps serving its control port, records
+``orphan_enter`` in the flight recorder, and waits for a successor router
+to claim it via ``POST /adopt``. Adoption clears the state
+(``orphan_exit``); if the grace deadline passes unclaimed the worker
+records ``orphan_suicide`` and dies exactly as before — true orphans
+still die, just later. Note the worker is effectively FROZEN while
+orphaned: the control plane only advances the engine on ``/step``, and
+nobody is calling it.
 
 Control-plane wire format (all JSON over localhost HTTP):
 
@@ -43,6 +59,15 @@ endpoint            semantics
 ``POST /restore``   ``{snapshot, rebase_ids}`` -> ``{restored}`` —
                     fingerprint refusals come back as 409 ValueError.
 ``POST /reserve_ids``  ``{base}`` -> ``{next_id}`` (id-space namespacing).
+``POST /adopt``     ``{name?, pid?, fingerprint?}`` -> ``{name, pid,
+                    fingerprint, orphaned}`` — a successor router claims
+                    this worker after the original parent died. Any
+                    provided field that mismatches the worker's identity
+                    is refused with 409 (the PID-reuse guard: a registry
+                    entry whose pid now belongs to a different process
+                    must not be adopted). Idempotent; also answers the
+                    identity probe for a router that merely wants to
+                    verify a registry entry.
 ``GET /health``     ``{status: live|draining|closed}`` (always 200 — the
                     verdict is the payload; transport failure is the
                     signal the breaker consumes).
@@ -62,6 +87,7 @@ import json
 import os
 import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
@@ -156,6 +182,13 @@ class ReplicaControlServer:
         self._unacked = set()  # finished ids not yet acked by the client
         self._steps = 0
         self.shutdown_event = threading.Event()
+        # Orphan-grace state (see module docstring): the stdin watchdog
+        # flips `orphaned` on parent EOF and waits on `adopted_event`; a
+        # successor router's POST /adopt sets it. `identity` is what the
+        # adopter must match — main() fills it from the hello document.
+        self.adopted_event = threading.Event()
+        self.orphaned = False
+        self.identity: dict = {}
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -243,6 +276,8 @@ class ReplicaControlServer:
                         self.engine._next_id, int(body["base"])
                     )
                     doc = {"next_id": self.engine._next_id}
+            elif op == "/adopt":
+                doc = self._adopt(body or {})
             elif op == "/health":
                 doc = {"status": self.engine.health()}
             elif op == "/gauge":
@@ -289,20 +324,15 @@ class ReplicaControlServer:
     # ------------------------------------------------------------ handlers
 
     def _submit(self, body: dict) -> dict:
+        from distributed_pytorch_tpu.serving.elastic import params_from_doc
         from distributed_pytorch_tpu.serving.mods import Mods
-        from distributed_pytorch_tpu.serving.scheduler import SamplingParams
 
         rid = body.get("rid")
         with self._lock:
             if rid is not None and rid in self._replay:
                 # Idempotent replay: the first attempt's admission stands.
                 return {"req_id": self._replay[rid], "replayed": True}
-            pdoc = dict(body.get("params") or {})
-            pdoc["stop_sequences"] = tuple(
-                tuple(int(t) for t in seq)
-                for seq in pdoc.get("stop_sequences", ())
-            )
-            params = SamplingParams(**pdoc)
+            params = params_from_doc(body.get("params"))
             mods = (
                 Mods.from_spec(body["mods"]) if body.get("mods") else None
             )
@@ -393,6 +423,27 @@ class ReplicaControlServer:
             )
         return {"restored": ids}
 
+    def _adopt(self, body: dict) -> dict:
+        """Claim (or identity-probe) this worker for a successor router.
+
+        Refuses with ValueError -> 409 on any identity mismatch: a
+        registry entry can outlive its worker, and its recorded pid can
+        be reborn as an unrelated process — adoption must never succeed
+        against the wrong engine."""
+        for key in ("name", "pid", "fingerprint"):
+            want = body.get(key)
+            if want is not None and want != self.identity.get(key):
+                raise ValueError(
+                    f"adopt refused: {key} mismatch "
+                    f"(want {want!r}, have {self.identity.get(key)!r})"
+                )
+        was_orphaned = self.orphaned
+        self.orphaned = False
+        self.adopted_event.set()
+        doc = dict(self.identity)
+        doc["orphaned"] = was_orphaned
+        return doc
+
     def _shutdown(self) -> dict:
         with self._lock:
             # Leak asserts (debug engines) raise HERE: the client sees a
@@ -443,13 +494,15 @@ def main() -> int:
         "speculative": engine.speculative,
         "mesh": engine.mesh_fingerprint,
     }
-    print(json.dumps({"replica_hello": {
+    hello = {
         "pid": os.getpid(),
         "name": os.environ.get("TPURUN_REPLICA_NAME", spec.get("name")),
         "control_url": control.url,
         "obs_url": obs.url,
         "fingerprint": fp,
-    }}), flush=True)
+    }
+    control.identity = dict(hello)
+    print(json.dumps({"replica_hello": hello}), flush=True)
 
     def _watch_stdin():
         # Orphan prevention: stdin EOF means the parent is gone. os._exit
@@ -462,8 +515,61 @@ def main() -> int:
                 pass
         except OSError:
             pass
-        if not control.shutdown_event.is_set():
+        if control.shutdown_event.is_set():
+            return
+        try:
+            grace = float(os.environ.get("TPURUN_ORPHAN_GRACE", "0") or 0.0)
+        except ValueError:
+            grace = 0.0
+        if grace <= 0:
             os._exit(3)
+        # Re-adoption window: survive the parent's death for `grace`
+        # seconds so a recovered router can claim us via /adopt. The
+        # deadline is HARD — grace is not re-armed by near-miss adopters,
+        # and a second parent death after adoption gets no second window
+        # (the event stays set); true orphans die, just late enough for
+        # recovery to happen.
+        flight = getattr(engine, "flight", None)
+
+        def _say(msg):
+            # Our stdout pipe's reader just died; writing to it raises
+            # BrokenPipeError, which would kill this thread before the
+            # grace machinery runs. Best-effort only, past this point.
+            try:
+                print(msg, flush=True)
+            except (OSError, ValueError):
+                pass
+
+        control.orphaned = True
+        if flight is not None and flight.enabled:
+            flight.record(
+                "orphan_enter", grace_s=grace, pid=os.getpid(),
+            )
+        _say(
+            f"[worker] parent EOF; orphaned, serving {grace:.1f}s "
+            f"awaiting re-adoption (pid {os.getpid()})"
+        )
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline:
+            if control.adopted_event.wait(timeout=0.05):
+                break
+            if control.shutdown_event.is_set():
+                return
+        if control.adopted_event.is_set():
+            if flight is not None and flight.enabled:
+                flight.record("orphan_exit", adopted=True)
+            _say("[worker] re-adopted; resuming service")
+            return
+        if control.shutdown_event.is_set():
+            return
+        if flight is not None and flight.enabled:
+            flight.record("orphan_suicide", grace_s=grace)
+            try:
+                engine._dump_postmortem("orphan_suicide")
+            except Exception:
+                pass
+        _say(f"[worker] orphan grace expired after {grace:.1f}s; exiting")
+        os._exit(3)
 
     threading.Thread(
         target=_watch_stdin, name="parent-watch", daemon=True

@@ -1,0 +1,347 @@
+"""Write-ahead journal tests: record codec, torn-tail quarantine,
+segment rotation + compaction, replayed-state folding, the worker
+registry, and the randomized kill-point torture drill.
+
+Everything here is stdlib-only — the journal is control-plane plumbing
+and must import (and be testable) without JAX. The torture drill is the
+property the recovery story leans on: truncate the byte stream at ANY
+point, or corrupt any tail, and replay yields a clean prefix of the
+appended records with the damage quarantined to ``*.corrupt`` — never an
+exception, never a record invented from garbage.
+"""
+
+import json
+import os
+import random
+
+import pytest
+
+from distributed_pytorch_tpu.serving.journal import (
+    Journal,
+    JournalState,
+    decode_record,
+    encode_record,
+    journal_segments,
+    pid_alive,
+    read_worker_registry,
+    remove_worker_entry,
+    replay_journal,
+    write_worker_entry,
+)
+
+# ------------------------------------------------------------------ codec
+
+
+def test_record_roundtrip():
+    rec = {"k": "submit", "fid": 7, "prompt": [1, 2, 3], "tenant": "t"}
+    line = encode_record(rec)
+    assert line.endswith(b"\n")
+    assert decode_record(line) == rec
+
+
+def test_decode_rejects_corruption():
+    line = encode_record({"k": "cancel", "fid": 3})
+    assert decode_record(line) is not None
+    # Flip one payload byte: CRC mismatch.
+    bad = line[:10] + bytes([line[10] ^ 0x01]) + line[11:]
+    assert decode_record(bad) is None
+    # Torn writes: any strict prefix (no trailing newline) fails cleanly.
+    for cut in (0, 1, 5, 9, len(line) - 1):
+        assert decode_record(line[:cut]) is None
+    # Garbage that never was a record.
+    assert decode_record(b"deadbeef not-json\n") is None
+
+
+# ---------------------------------------------------------------- replay
+
+
+def _submit(j, fid, replica="r0"):
+    j.append_submit(
+        fid,
+        prompt=[1, 2, fid],
+        params={"max_new_tokens": 4},
+        metadata=None,
+        tenant="anon",
+        mods=None,
+        trace_id=f"d{fid:06x}",
+        replica=replica,
+        req_id=fid,
+    )
+
+
+def test_replay_folds_lifecycle(tmp_path):
+    d = str(tmp_path / "j")
+    j = Journal(d)
+    j.append_replica("spawn", "r0", kind="process", index=0, pid=123)
+    _submit(j, 0)
+    _submit(j, 1)
+    j.append_progress({0: 2, 1: 1})
+    j.append_deliver({0: 1})
+    j.append_finish(0, [10, 11, 12])
+    j.append_cancel(1)
+    j.close()
+
+    state = replay_journal(d)
+    assert state.corrupt == []
+    assert state.replicas["r0"]["alive"] and state.replicas["r0"]["pid"] == 123
+    assert state.next_fid == 2
+    r0 = state.requests[0]
+    assert r0["finished"] and r0["gen"] == [10, 11, 12]
+    assert r0["delivered"] == 1
+    assert state.requests[1]["cancelled"]
+    # Open set: fid 0 is finished but has an undelivered tail; fid 1 is
+    # cancelled and drops out.
+    assert set(state.open_requests()) == {0}
+
+
+def test_replica_death_is_final_in_replay(tmp_path):
+    d = str(tmp_path / "j")
+    j = Journal(d)
+    j.append_replica("spawn", "r0", kind="process", index=0, pid=1)
+    j.append_replica("dead", "r0", reason="kill_replica_process")
+    j.close()
+    state = replay_journal(d)
+    assert state.replicas["r0"]["alive"] is False
+    assert state.replicas["r0"]["reason"] == "kill_replica_process"
+
+
+def test_rotation_compacts_and_bounds_segments(tmp_path):
+    d = str(tmp_path / "j")
+    j = Journal(d, segment_max_records=16)
+    j.append_replica("spawn", "r0", kind="local", index=0)
+    for fid in range(40):
+        _submit(j, fid)
+        j.append_finish(fid, [7])
+        j.append_deliver({fid: 1})  # fully delivered -> compacted away
+    assert j.rotations >= 1
+    assert j.compacted_away > 0
+    # Rotation deletes captured segments: only the live one remains.
+    assert len(journal_segments(d)) == 1
+    # And replay of the compacted journal still knows the live truth.
+    state = replay_journal(d)
+    assert state.replicas["r0"]["alive"]
+    assert state.open_requests() == {}
+    assert state.next_fid == 40
+    j.close()
+
+
+def test_compaction_base_preserves_open_requests(tmp_path):
+    d = str(tmp_path / "j")
+    j = Journal(d)
+    j.append_replica("spawn", "r1", kind="process", index=1, pid=9)
+    _submit(j, 5, replica="r1")
+    j.append_progress({5: 3})
+    j.append_deliver({5: 2})
+    j.rotate()
+    j.close()
+    state = replay_journal(d)
+    doc = state.requests[5]
+    assert doc["committed"] == 3 and doc["delivered"] == 2
+    assert doc["replica"] == "r1" and not doc["finished"]
+
+
+# ------------------------------------------------------------- torn tails
+
+
+def test_torn_tail_is_quarantined(tmp_path):
+    d = str(tmp_path / "j")
+    j = Journal(d)
+    _submit(j, 0)
+    _submit(j, 1)
+    j.close()
+    seg = journal_segments(d)[0]
+    whole = open(seg, "rb").read()
+    # Tear mid-way through the LAST record.
+    open(seg, "wb").write(whole[: len(whole) - 4])
+    state = replay_journal(d)
+    assert 0 in state.requests and 1 not in state.requests
+    assert len(state.corrupt) == 1
+    quarantined = state.corrupt[0]
+    assert quarantined.endswith(".corrupt") and os.path.exists(quarantined)
+    # The damaged bytes moved aside, the good prefix stays replayable.
+    assert replay_journal(d).corrupt == []
+    assert 0 in replay_journal(d).requests
+
+
+def test_corrupt_middle_record_quarantines_rest_of_segment(tmp_path):
+    d = str(tmp_path / "j")
+    j = Journal(d)
+    for fid in range(3):
+        _submit(j, fid)
+    j.close()
+    seg = journal_segments(d)[0]
+    lines = open(seg, "rb").read().splitlines(keepends=True)
+    # Corrupt the middle submit's CRC: everything after the last good
+    # record is suspect and quarantined with it.
+    lines[2] = b"00000000" + lines[2][8:]
+    open(seg, "wb").write(b"".join(lines))
+    state = replay_journal(d)
+    assert 0 in state.requests
+    assert 1 not in state.requests and 2 not in state.requests
+    assert len(state.corrupt) == 1
+
+
+def test_quarantine_names_never_collide(tmp_path):
+    d = str(tmp_path / "j")
+    for round_ in range(3):
+        j = Journal(d)
+        _submit(j, round_)
+        j.close()
+        seg = journal_segments(d)[-1]
+        with open(seg, "ab") as f:
+            f.write(b"garbage tail\n")
+        replay_journal(d)
+    corrupts = [p for p in os.listdir(d) if ".corrupt" in p]
+    assert len(corrupts) == 3
+    assert len(set(corrupts)) == 3
+
+
+# ------------------------------------------------- kill-point torture drill
+
+
+def _apply_script(j, script):
+    """Replay a deterministic op script into a journal; returns the op
+    count actually journaled."""
+    for op in script:
+        kind = op[0]
+        if kind == "submit":
+            _submit(j, op[1])
+        elif kind == "progress":
+            j.append_progress({op[1]: op[2]})
+        elif kind == "deliver":
+            j.append_deliver({op[1]: op[2]})
+        elif kind == "finish":
+            j.append_finish(op[1], list(range(op[2])))
+        elif kind == "cancel":
+            j.append_cancel(op[1])
+
+
+def _make_script(rng, n_ops):
+    script = []
+    fid = 0
+    live = []
+    for _ in range(n_ops):
+        roll = rng.random()
+        if roll < 0.35 or not live:
+            script.append(("submit", fid))
+            live.append(fid)
+            fid += 1
+        elif roll < 0.55:
+            script.append(("progress", rng.choice(live), rng.randint(1, 6)))
+        elif roll < 0.75:
+            script.append(("deliver", rng.choice(live), rng.randint(1, 6)))
+        elif roll < 0.9:
+            victim = live.pop(rng.randrange(len(live)))
+            script.append(("finish", victim, rng.randint(1, 6)))
+        else:
+            victim = live.pop(rng.randrange(len(live)))
+            script.append(("cancel", victim))
+    return script
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_truncation_torture_replays_clean_prefix(tmp_path, seed):
+    """SIGKILL model: the journal byte stream may stop ANYWHERE. For
+    randomized op scripts and randomized kill offsets, replay must (a)
+    never raise, (b) produce exactly the fold of some record prefix, and
+    (c) quarantine at most one tail."""
+    rng = random.Random(seed)
+    d = str(tmp_path / f"j{seed}")
+    j = Journal(d, segment_max_records=64)
+    script = _make_script(rng, 60)
+    _apply_script(j, script)
+    j.close()
+
+    seg = journal_segments(d)[-1]
+    whole = open(seg, "rb").read()
+    lines = whole.splitlines(keepends=True)
+    # Reference folds: state after each whole-record prefix of the
+    # surviving segment (earlier segments were compacted into its base).
+    folds = []
+    st = JournalState()
+    folds.append({fid: dict(doc) for fid, doc in st.requests.items()})
+    for line in lines:
+        rec = decode_record(line)
+        assert rec is not None
+        st.apply(rec)
+        folds.append({fid: dict(doc) for fid, doc in st.requests.items()})
+
+    for _ in range(6):
+        cut = rng.randrange(len(whole) + 1)
+        open(seg, "wb").write(whole[:cut])
+        state = replay_journal(d)
+        got = {fid: dict(doc) for fid, doc in state.requests.items()}
+        assert got in folds, f"cut at {cut}: not a prefix fold"
+        assert len(state.corrupt) <= 1
+        # Restore the pristine segment (quarantine moved the tail off).
+        for leftover in os.listdir(d):
+            if ".corrupt" in leftover:
+                os.unlink(os.path.join(d, leftover))
+        open(seg, "wb").write(whole)
+
+
+def test_recovery_journal_survives_its_own_kill(tmp_path):
+    """The compaction-base write itself can be torn: a journal opened
+    with a recovered state must leave the directory replayable at every
+    byte prefix of its base segment."""
+    d = str(tmp_path / "j")
+    j = Journal(d)
+    j.append_replica("spawn", "r0", kind="process", index=0, pid=44)
+    _submit(j, 0)
+    j.append_progress({0: 2})
+    j.close()
+    state = replay_journal(d)
+    j2 = Journal(d, state=state)  # compacts, unlinks the old segment
+    j2.close()
+    seg = journal_segments(d)[-1]
+    whole = open(seg, "rb").read()
+    for cut in range(0, len(whole) + 1, 7):
+        open(seg, "wb").write(whole[:cut])
+        replay_journal(d)  # must never raise
+        for leftover in os.listdir(d):
+            if ".corrupt" in leftover:
+                os.unlink(os.path.join(d, leftover))
+    open(seg, "wb").write(whole)
+    final = replay_journal(d)
+    assert final.requests[0]["committed"] == 2
+    assert final.replicas["r0"]["alive"]
+
+
+# --------------------------------------------------------- worker registry
+
+
+def test_worker_registry_roundtrip(tmp_path):
+    run = str(tmp_path)
+    write_worker_entry(run, {
+        "name": "r0", "pid": os.getpid(), "control_url": "http://x",
+        "fingerprint": "abc", "spec": {"name": "r0"},
+    })
+    write_worker_entry(run, {"name": "r1", "pid": 1, "control_url": None})
+    reg = read_worker_registry(run)
+    assert set(reg) == {"r0", "r1"}
+    assert reg["r0"]["pid"] == os.getpid()
+    remove_worker_entry(run, "r0")
+    assert set(read_worker_registry(run)) == {"r1"}
+    # Unreadable entries are skipped, not fatal.
+    junk = os.path.join(run, "workers", "r2.json")
+    open(junk, "w").write("{not json")
+    assert set(read_worker_registry(run)) == {"r1"}
+
+
+def test_pid_alive():
+    assert pid_alive(os.getpid())
+    assert not pid_alive(None)
+    # Allocate-and-reap a child so the pid is known-dead.
+    pid = os.fork()
+    if pid == 0:
+        os._exit(0)
+    os.waitpid(pid, 0)
+    assert not pid_alive(pid)
+
+
+def test_registry_entry_is_json_on_disk(tmp_path):
+    run = str(tmp_path)
+    write_worker_entry(run, {"name": "r9", "pid": 7})
+    path = os.path.join(run, "workers", "r9.json")
+    doc = json.load(open(path))
+    assert doc["name"] == "r9" and doc["pid"] == 7

@@ -1,0 +1,370 @@
+"""Durable-control-plane drills against REAL processes.
+
+The headline: a ROUTER process is SIGKILLed mid-decode over three live
+worker subprocesses under seeded Poisson load. The workers — spawned
+with ``TPURUN_ORPHAN_GRACE`` — notice the parent's death (stdin EOF),
+freeze in the orphan state instead of dying, and a SECOND router built
+by ``FleetRouter.recover`` in the test process re-adopts them from the
+write-ahead journal plus the worker registry. Acceptance: union greedy
+token parity with an uninterrupted single-engine reference, zero
+duplicate or missing tokens, zero page leaks on every worker, the
+orphan state machine visible in the worker flight recorder, and trace
+ids minted by the dead router threading through the recovered one.
+
+Also here: the orphan-grace suicide deadline (an unclaimed orphan still
+dies, exit 3, just late enough for re-adoption to win the race) and the
+``/adopt`` identity guard (PID reuse / wrong-name claims are refused
+with 409).
+
+All slow (each spawns JAX subprocesses); the fleet-chaos CI job runs
+them alongside ``tools/fleet_smoke.sh router``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_pytorch_tpu import chaos
+from distributed_pytorch_tpu.models.transformer import TransformerLM
+from distributed_pytorch_tpu.obs import Tracer
+from distributed_pytorch_tpu.serving import (
+    FleetRouter,
+    InferenceEngine,
+    ProcessReplicaClient,
+    SamplingParams,
+    pid_alive,
+    read_worker_registry,
+)
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+MODEL_KW = dict(
+    vocab_size=48, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+)
+ENGINE_KW = dict(
+    max_slots=2, max_seq_len=32, page_size=4, token_budget=16,
+    max_prefill_chunk=8, debug=True,
+)
+MAX_NEW = 6
+
+PREFIX = [5, 7, 11, 2]
+AFFINITY_PROMPTS = [PREFIX + [t, t + 1] for t in (1, 9, 17, 25, 33)]
+OTHER_PROMPTS = [[2, 2, 3, 17, 40], [6, 1, 9], [40, 41], [3, 3, 3, 3, 8]]
+DRILL_PROMPTS = AFFINITY_PROMPTS + OTHER_PROMPTS
+
+
+def worker_spec(name, **extra):
+    spec = {
+        "name": name,
+        "model": dict(MODEL_KW, dtype="float32"),
+        "init_seed": 0,
+        "engine": ENGINE_KW,
+        "trace": True,
+        "trace_every": 1,
+        # Large enough that post-recovery decode traffic (step/admit
+        # events) cannot push the orphan_enter/orphan_exit marks out of
+        # the bounded ring before the drill inspects /postmortem.
+        "flight": {"capacity": 8192},
+    }
+    spec.update(extra)
+    return spec
+
+
+def params_for(i):
+    return SamplingParams(max_new_tokens=MAX_NEW)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_chaos_plan():
+    chaos._reset()
+    yield
+    os.environ.pop(chaos.ENV_VAR, None)
+    chaos._reset()
+
+
+@pytest.fixture(scope="module")
+def ref_outputs():
+    model = TransformerLM(**MODEL_KW, dtype=jnp.float32)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    eng = InferenceEngine(model, params, **ENGINE_KW)
+    ids = [
+        eng.submit(p, params_for(i)) for i, p in enumerate(DRILL_PROMPTS)
+    ]
+    eng.run()
+    out = {i: eng.poll(rid).generated for i, rid in enumerate(ids)}
+    eng.close()
+    return out
+
+
+# The incarnation-1 router. It arms a hard-mode ``kill_router`` fault —
+# a REAL SIGKILL of its own process at a step boundary — spawns three
+# registry-tracked workers with an orphan-grace window, journals into
+# the run dir, and pumps seeded Poisson load until the fault lands.
+DRIVER = """
+import json, os, random, sys
+
+jdir = sys.argv[1]
+cfg = json.load(open(os.path.join(jdir, "drill_cfg.json")))
+
+from distributed_pytorch_tpu import chaos
+
+os.environ[chaos.ENV_VAR] = json.dumps({
+    "seed": 1234,
+    "faults": [{"kind": "kill_router", "at_step": cfg["kill_step"]}],
+})
+chaos._reset()
+
+from distributed_pytorch_tpu.serving import (
+    FleetRouter, SamplingParams, spawn_replica_clients,
+)
+
+env = dict(os.environ)
+env["TPURUN_ORPHAN_GRACE"] = str(cfg["orphan_grace_s"])
+clients = spawn_replica_clients(cfg["specs"], run_dir=jdir, env=env)
+router = FleetRouter(clients, journal_dir=jdir)
+
+rng = random.Random(1234)
+schedule = {}
+rnd = 0
+for idx in range(len(cfg["prompts"])):
+    schedule.setdefault(rnd, []).append(idx)
+    while rng.random() < 0.5:
+        rnd += 1
+
+fids = {}
+rounds = 0
+while True:
+    for idx in schedule.pop(rounds, []):
+        fids[idx] = router.submit(
+            cfg["prompts"][idx],
+            SamplingParams(max_new_tokens=cfg["max_new"]),
+        )
+        tmp = os.path.join(jdir, "fids.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(fids, f)
+        os.replace(tmp, os.path.join(jdir, "fids.json"))
+    router.step()  # the armed kill_router SIGKILLs this process here
+    rounds += 1
+    if rounds > 200:
+        print("kill_router never fired", flush=True)
+        sys.exit(1)
+"""
+
+
+def test_router_sigkill_recovery_drill(tmp_path, ref_outputs):
+    """The headline drill: SIGKILL the router process mid-decode over 3
+    live workers, recover in THIS process, re-adopt all three, finish
+    everything with union parity and no leaks."""
+    jdir = str(tmp_path)
+    cfg = {
+        "specs": [worker_spec(f"r{i}") for i in range(3)],
+        "prompts": DRILL_PROMPTS,
+        "max_new": MAX_NEW,
+        "kill_step": 4,
+        "orphan_grace_s": 300,
+    }
+    json.dump(cfg, open(os.path.join(jdir, "drill_cfg.json"), "w"))
+    driver = os.path.join(jdir, "driver.py")
+    open(driver, "w").write(DRIVER)
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop(chaos.ENV_VAR, None)
+    proc = subprocess.run(
+        [sys.executable, driver, jdir],
+        capture_output=True, text=True, timeout=570, env=env,
+    )
+    # The kill was real: the router died by SIGKILL, not sys.exit.
+    assert proc.returncode == -9, (
+        f"driver exited {proc.returncode}:\n{proc.stdout}\n{proc.stderr}"
+    )
+    fids = {
+        int(k): int(v)
+        for k, v in json.load(
+            open(os.path.join(jdir, "fids.json"))
+        ).items()
+    }
+    assert fids, "kill landed before any submit"
+
+    registry = read_worker_registry(jdir)
+    assert sorted(registry) == ["r0", "r1", "r2"]
+    for entry in registry.values():
+        assert pid_alive(entry["pid"]), (
+            "worker died with the router despite the orphan grace"
+        )
+    time.sleep(1.0)  # let every worker notice the EOF, enter orphan state
+
+    recovered = FleetRouter.recover(jdir, tracer=Tracer())
+    try:
+        summary = recovered.last_recovery
+        assert sorted(summary["re_adopted_workers"]) == ["r0", "r1", "r2"]
+        assert summary["lost_workers"] == []
+        assert summary["lost"] == 0
+        for rep in recovered.replicas():
+            assert rep.client.adopted
+            assert rep.client.adopted_orphan, (
+                f"{rep.name} was claimed but never saw the orphan state"
+            )
+
+        # Clients whose submits the dead router never admitted retry
+        # against the restarted one; journaled fids are never reissued.
+        for idx in range(len(DRILL_PROMPTS)):
+            if idx not in fids:
+                new_fid = recovered.submit(
+                    DRILL_PROMPTS[idx], params_for(idx)
+                )
+                assert new_fid not in fids.values()
+                fids[idx] = new_fid
+        rounds = 0
+        while not all(
+            s.finished for s in recovered._shadows.values()
+        ):
+            recovered.step()
+            rounds += 1
+            assert rounds < 500, "post-recovery drill did not converge"
+
+        # Union parity: every prompt, across both incarnations.
+        for idx, fid in fids.items():
+            st = recovered.poll(fid)
+            assert st.finished, f"prompt {idx} never finished"
+            assert list(st.generated) == list(ref_outputs[idx]), (
+                f"prompt {idx}: fleet produced {st.generated}, "
+                f"reference {ref_outputs[idx]}"
+            )
+        # Zero page leaks on every re-adopted worker.
+        for rep in recovered.replicas():
+            assert rep.client.read_gauge("pages_referenced") == 0, (
+                f"{rep.name} leaked referenced pages"
+            )
+
+        # The orphan state machine left its marks in the worker flight
+        # recorder: enter on EOF, exit on /adopt.
+        with urllib.request.urlopen(
+            recovered.replicas()[0].client.obs_url + "/postmortem",
+            timeout=10,
+        ) as resp:
+            doc = json.loads(resp.read().decode("utf-8"))
+        kinds = [e["kind"] for e in doc["events"]]
+        assert "orphan_enter" in kinds
+        assert "orphan_exit" in kinds
+        exit_ev = next(
+            e for e in doc["events"] if e["kind"] == "orphan_exit"
+        )
+        assert exit_ev["adopted"] is True
+
+        # Incarnation-1 trace ids thread through incarnation 2: the
+        # recovery re-opened router spans under the journaled ids.
+        trace = recovered.tracer.to_perfetto()
+        recovered_spans = [
+            e
+            for e in trace["traceEvents"]
+            if e.get("ph") == "b"
+            and e.get("args", {}).get("routed_by") == "recovered"
+        ]
+        assert recovered_spans, "recovery opened no router spans"
+        shadow_tids = {
+            s.trace_id for s in recovered._shadows.values()
+        }
+        for ev in recovered_spans:
+            assert ev["args"]["trace_id"] in shadow_tids
+
+        # The recovery dump is on disk next to the journal (a CI
+        # artifact in the smoke drill) when a flight recorder rides the
+        # recovered router; the reconciliation summary is journaled.
+        assert summary == recovered.describe()["recovery"]
+    finally:
+        recovered.close()
+
+    # Clean close through the ATTACHED clients: every worker got the
+    # polite /shutdown and actually exited.
+    for name, entry in registry.items():
+        deadline = time.time() + 15
+        while pid_alive(entry["pid"]) and time.time() < deadline:
+            time.sleep(0.1)
+        assert not pid_alive(entry["pid"]), f"{name} still running"
+
+
+def test_orphan_grace_suicide_without_adoption():
+    """An unclaimed orphan still dies — exit 3, same as the default
+    die-on-EOF, just delayed by the grace window."""
+    env = dict(os.environ)
+    env["TPURUN_ORPHAN_GRACE"] = "1.5"
+    client = ProcessReplicaClient(worker_spec("lone"), env=env)
+    try:
+        t0 = time.monotonic()
+        client._proc.stdin.close()  # the "router" dies
+        code = client._proc.wait(30)
+        elapsed = time.monotonic() - t0
+        assert code == 3
+        assert elapsed >= 1.0, "suicide fired before the grace elapsed"
+    finally:
+        client.abandon()
+
+
+def test_orphan_default_dies_immediately():
+    """Without the grace env the EOF contract is unchanged: immediate
+    exit 3 (no drill can leak an orphan worker by accident)."""
+    env = dict(os.environ)
+    env.pop("TPURUN_ORPHAN_GRACE", None)
+    client = ProcessReplicaClient(worker_spec("nograce"), env=env)
+    try:
+        client._proc.stdin.close()
+        assert client._proc.wait(15) == 3
+    finally:
+        client.abandon()
+
+
+def test_adopt_identity_guard_and_resume(tmp_path):
+    """``/adopt`` is the PID-reuse guard: a claim with the wrong name is
+    refused 409; the rightful claim succeeds, un-freezes the worker, and
+    decode resumes over the new client."""
+    run = str(tmp_path)
+    env = dict(os.environ)
+    env["TPURUN_ORPHAN_GRACE"] = "300"
+    spawner = ProcessReplicaClient(worker_spec("r0"), env=env, run_dir=run)
+    adopted = None
+    try:
+        entry = read_worker_registry(run)["r0"]
+        spawner._proc.stdin.close()  # orphan it
+        time.sleep(0.5)
+
+        imposter = dict(entry, name="imposter")
+        with pytest.raises(ValueError):
+            ProcessReplicaClient.attach(imposter, run_dir=run)
+
+        adopted = ProcessReplicaClient.attach(entry, run_dir=run)
+        assert adopted.adopted and adopted.adopted_orphan
+        # The worker is live again under the new client: submit + step.
+        rid = adopted.submit([1, 2, 3], SamplingParams(max_new_tokens=2))
+        done = set()
+        for _ in range(100):
+            done.update(adopted.step())
+            if rid in done:
+                break
+        assert rid in done
+        # Re-adoption is idempotent (a retried claim converges) and the
+        # second claim reports the worker is NOT orphaned anymore.
+        again = ProcessReplicaClient.attach(entry, run_dir=run)
+        assert again.adopted and not again.adopted_orphan
+
+        adopted.close()  # polite /shutdown over the attached client
+        # The spawning parent can still reap: clean exit, leak asserts
+        # passed INSIDE the worker.
+        assert spawner._proc.wait(15) == 0
+        # Deliberate teardown removed the registry entry.
+        assert "r0" not in read_worker_registry(run)
+        adopted = None
+    finally:
+        if adopted is not None:
+            adopted.abandon()
+        spawner.abandon()

@@ -1,0 +1,464 @@
+"""Router crash-recovery drills, in-process edition.
+
+The journal unit tests (``test_journal.py``) prove the byte-level WAL
+properties without JAX; the process drills (``test_router_procs.py``)
+SIGKILL a real router process. This file covers the middle layer on CPU
+in one process: a journaled :class:`FleetRouter` is dropped without
+``close()`` (the in-process stand-in for SIGKILL — nothing it held is
+consulted again) and ``FleetRouter.recover`` rebuilds a new router from
+the journal alone, re-attaching the surviving replica clients the way
+the process path re-adopts live workers.
+
+The acceptance bar matches the fleet story everywhere else: greedy
+tokens identical to an uninterrupted single-engine reference, exactly
+once, across the crash.
+"""
+
+import json
+import os
+import random
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_pytorch_tpu import chaos
+from distributed_pytorch_tpu.chaos import InjectedFault
+from distributed_pytorch_tpu.models.transformer import TransformerLM
+from distributed_pytorch_tpu.obs import Tracer
+from distributed_pytorch_tpu.serving import (
+    FleetRouter,
+    FrontDoor,
+    InferenceEngine,
+    LocalReplicaClient,
+    SamplingParams,
+    replay_journal,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _fresh_chaos_plan():
+    chaos._reset()
+    yield
+    os.environ.pop(chaos.ENV_VAR, None)
+    chaos._reset()
+
+
+def tiny_lm():
+    return TransformerLM(
+        vocab_size=48, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+        dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = tiny_lm()
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+ENGINE_KW = dict(
+    max_slots=2, max_seq_len=32, page_size=4, token_budget=16,
+    max_prefill_chunk=8, debug=True,
+)
+MAX_NEW = 6
+
+PREFIX = [5, 7, 11, 2]
+AFFINITY_PROMPTS = [PREFIX + [t, t + 1] for t in (1, 9, 17, 25, 33)]
+OTHER_PROMPTS = [[2, 2, 3, 17, 40], [6, 1, 9], [40, 41], [3, 3, 3, 3, 8]]
+DRILL_PROMPTS = AFFINITY_PROMPTS + OTHER_PROMPTS
+
+
+def params_for(i):
+    return SamplingParams(max_new_tokens=MAX_NEW)
+
+
+def make_clients(model, params, n=3):
+    return [
+        LocalReplicaClient(InferenceEngine(model, params, **ENGINE_KW))
+        for _ in range(n)
+    ]
+
+
+@pytest.fixture(scope="module")
+def ref_outputs(model_and_params):
+    model, params = model_and_params
+    eng = InferenceEngine(model, params, **ENGINE_KW)
+    ids = [
+        eng.submit(p, params_for(i)) for i, p in enumerate(DRILL_PROMPTS)
+    ]
+    eng.run()
+    out = {i: eng.poll(rid).generated for i, rid in enumerate(ids)}
+    eng.close()
+    return out
+
+
+def submit_all(router):
+    return {
+        idx: router.submit(DRILL_PROMPTS[idx], params_for(idx))
+        for idx in range(len(DRILL_PROMPTS))
+    }
+
+
+def run_to_completion(router, limit=500):
+    rounds = 0
+    while not all(s.finished for s in router._shadows.values()):
+        router.step()
+        rounds += 1
+        assert rounds < limit, "drill did not converge"
+
+
+def assert_parity(router, fids, ref_outputs):
+    for idx, fid in fids.items():
+        st = router.poll(fid)
+        assert st.finished, f"prompt {idx} (fid {fid}) never finished"
+        assert list(st.generated) == list(ref_outputs[idx]), (
+            f"prompt {idx}: fleet produced {st.generated}, "
+            f"reference {ref_outputs[idx]}"
+        )
+
+
+# --------------------------------------------------------- re-adoption
+
+
+def test_recover_readopts_live_workers(
+    tmp_path, model_and_params, ref_outputs
+):
+    """Router crashes mid-decode; every worker survives. Recovery
+    re-attaches all three from the journal, reconciles committed tokens
+    from the workers (worker wins), and finishes with exact parity."""
+    model, params = model_and_params
+    jdir = str(tmp_path / "journal")
+    clients = make_clients(model, params)
+    router = FleetRouter(clients, journal_dir=jdir)
+    fids = submit_all(router)
+    for _ in range(3):
+        router.step()
+    unfinished = sum(
+        1 for s in router._shadows.values() if not s.finished
+    )
+    finished = len(fids) - unfinished
+    assert unfinished, "crash must land mid-decode"
+    del router  # SIGKILL stand-in: no close(), no flush, nothing reused
+
+    recovered = FleetRouter.recover(
+        jdir, replicas={f"r{i}": c for i, c in enumerate(clients)}
+    )
+    try:
+        summary = recovered.last_recovery
+        assert summary is not None
+        assert sorted(summary["re_adopted_workers"]) == ["r0", "r1", "r2"]
+        assert summary["lost_workers"] == []
+        assert summary["re_adopted"] == unfinished
+        assert summary["re_admitted"] == 0 and summary["lost"] == 0
+        assert summary["corrupt_segments"] == []
+        # Finished requests replay from their journaled finish records.
+        done_now = sum(
+            1 for s in recovered._shadows.values() if s.finished
+        )
+        assert done_now >= finished
+        # The reconciliation summary is the /statusz recovery block.
+        assert recovered.describe()["recovery"] == summary
+
+        run_to_completion(recovered)
+        assert_parity(recovered, fids, ref_outputs)
+        # Same fid namespace continues: no id reuse after recovery.
+        new_fid = recovered.submit([1, 2, 3], params_for(0))
+        assert new_fid not in fids.values()
+    finally:
+        recovered.close()
+
+
+def test_recover_readmits_dead_workers_requests(
+    tmp_path, model_and_params, ref_outputs
+):
+    """Router AND one worker die together. The dead worker's requests
+    re-admit on survivors through the same token-identical re-prefill
+    as failover; parity holds for every request."""
+    model, params = model_and_params
+    jdir = str(tmp_path / "journal")
+    clients = make_clients(model, params)
+    router = FleetRouter(clients, journal_dir=jdir)
+    fids = submit_all(router)
+    for _ in range(3):
+        router.step()
+    lost_name = "r2"
+    orphaned = sum(
+        1
+        for s in router._shadows.values()
+        if not s.finished and s.replica == lost_name
+    )
+    assert orphaned, "the dead worker must hold live work"
+    del router
+
+    # r2 is not offered back (its "process" died with the router and no
+    # registry entry points at a live pid).
+    recovered = FleetRouter.recover(
+        jdir,
+        replicas={"r0": clients[0], "r1": clients[1]},
+    )
+    try:
+        summary = recovered.last_recovery
+        assert summary["lost_workers"] == [lost_name]
+        assert sorted(summary["re_adopted_workers"]) == ["r0", "r1"]
+        assert summary["re_admitted"] == orphaned
+        assert summary["lost"] == 0
+        moved = [
+            s for s in recovered._shadows.values() if s.failovers > 0
+        ]
+        assert len(moved) == orphaned
+        assert all(s.replica != lost_name for s in moved)
+
+        run_to_completion(recovered)
+        assert_parity(recovered, fids, ref_outputs)
+    finally:
+        recovered.close()
+
+
+def test_recover_with_no_workers_declares_lost(tmp_path, model_and_params):
+    """Everything died: no worker to re-adopt, no survivor to re-admit
+    on. Unfinished requests are declared lost (terminal, cancelled) —
+    never silently dropped, never resurrected from garbage."""
+    model, params = model_and_params
+    jdir = str(tmp_path / "journal")
+    clients = make_clients(model, params, n=2)
+    router = FleetRouter(clients, journal_dir=jdir)
+    fids = submit_all(router)
+    router.step()
+    inflight = sum(
+        1 for s in router._shadows.values() if not s.finished
+    )
+    del router
+
+    recovered = FleetRouter.recover(jdir, replicas={})
+    try:
+        summary = recovered.last_recovery
+        assert sorted(summary["lost_workers"]) == ["r0", "r1"]
+        assert summary["lost"] == inflight
+        assert summary["re_adopted"] == 0 and summary["re_admitted"] == 0
+        for fid in fids.values():
+            st = recovered.poll(fid)
+            assert st.finished  # terminal either way: finished or lost
+    finally:
+        recovered.close()
+
+
+def test_recover_quarantines_torn_journal_tail(
+    tmp_path, model_and_params, ref_outputs
+):
+    """A torn record at the journal tail (the router died mid-append)
+    quarantines to ``*.corrupt``, recovery proceeds from the last good
+    record, and the drill still converges with parity."""
+    from distributed_pytorch_tpu.serving.journal import journal_segments
+
+    model, params = model_and_params
+    jdir = str(tmp_path / "journal")
+    clients = make_clients(model, params)
+    router = FleetRouter(clients, journal_dir=jdir)
+    fids = submit_all(router)
+    for _ in range(3):
+        router.step()
+    del router
+
+    seg = journal_segments(jdir)[-1]
+    whole = open(seg, "rb").read()
+    open(seg, "wb").write(whole[:-9])  # tear mid-record
+
+    recovered = FleetRouter.recover(
+        jdir, replicas={f"r{i}": c for i, c in enumerate(clients)}
+    )
+    try:
+        summary = recovered.last_recovery
+        assert len(summary["corrupt_segments"]) == 1
+        assert summary["corrupt_segments"][0].endswith(".corrupt")
+        assert os.path.exists(summary["corrupt_segments"][0])
+        run_to_completion(recovered)
+        assert_parity(recovered, fids, ref_outputs)
+    finally:
+        recovered.close()
+
+
+# ------------------------------------------------------ chaos router kill
+
+
+def test_chaos_kill_router_fault_then_recover(
+    tmp_path, model_and_params, ref_outputs
+):
+    """The armed ``kill_router`` fault (raise mode — the in-process
+    drill form of SIGKILL) fires at the step boundary AFTER the batched
+    journal flush, so recovery sees every delivered mark; the drill then
+    recovers and converges with parity."""
+    model, params = model_and_params
+    jdir = str(tmp_path / "journal")
+    os.environ[chaos.ENV_VAR] = json.dumps({
+        "seed": 7,
+        "faults": [
+            {"kind": "kill_router", "at_step": 3, "mode": "raise"}
+        ],
+    })
+    chaos._reset()
+    clients = make_clients(model, params)
+    router = FleetRouter(clients, journal_dir=jdir)
+    fids = submit_all(router)
+    killed_at = None
+    for rnd in range(10):
+        try:
+            router.step()
+        except InjectedFault as exc:
+            assert exc.kind == "kill_router"
+            killed_at = rnd
+            break
+    assert killed_at is not None, "armed kill_router never fired"
+    del router
+    chaos._reset()
+    os.environ.pop(chaos.ENV_VAR, None)
+
+    recovered = FleetRouter.recover(
+        jdir, replicas={f"r{i}": c for i, c in enumerate(clients)}
+    )
+    try:
+        run_to_completion(recovered)
+        assert_parity(recovered, fids, ref_outputs)
+    finally:
+        recovered.close()
+
+
+def test_restart_router_under_load_gates_on_queue(
+    tmp_path, model_and_params
+):
+    """``restart_router_under_load`` holds fire until the router holds
+    at least ``min_queue`` in-flight requests."""
+    model, params = model_and_params
+    os.environ[chaos.ENV_VAR] = json.dumps({
+        "faults": [
+            {"kind": "restart_router_under_load", "at_step": 1,
+             "min_queue": 4, "mode": "raise"}
+        ],
+    })
+    chaos._reset()
+    clients = make_clients(model, params, n=2)
+    router = FleetRouter(clients, journal_dir=str(tmp_path / "j"))
+    try:
+        router.submit(DRILL_PROMPTS[0], params_for(0))
+        router.step()  # 1 in flight < min_queue 4: no fire
+        for idx in range(1, 5):
+            router.submit(DRILL_PROMPTS[idx], params_for(idx))
+        with pytest.raises(InjectedFault) as exc_info:
+            for _ in range(10):
+                router.step()
+        assert exc_info.value.kind == "restart_router_under_load"
+    finally:
+        chaos._reset()
+        os.environ.pop(chaos.ENV_VAR, None)
+        router.close()
+
+
+# ------------------------------------------- exactly-once streaming
+
+
+def test_exactly_once_streaming_across_restart(
+    tmp_path, model_and_params, ref_outputs
+):
+    """The headline delivery guarantee: streams interrupted by a router
+    crash resume at the journaled delivered high-water mark — across
+    both incarnations each client sees its reference token sequence
+    exactly once (no duplicate, no gap), under one trace_id."""
+    model, params = model_and_params
+    jdir = str(tmp_path / "journal")
+    clients = make_clients(model, params)
+    router = FleetRouter(clients, journal_dir=jdir)
+    door = FrontDoor(router)
+    streams = {
+        idx: door.open_stream(DRILL_PROMPTS[idx], params=params_for(idx))
+        for idx in range(len(DRILL_PROMPTS))
+    }
+    # Deliver a PARTIAL prefix of some streams: uneven high-waters make
+    # duplicate-vs-gap failures distinguishable after the restart.
+    taken = {idx: [] for idx in streams}
+    for _ in range(4):
+        door.pump()
+    for idx, want in ((0, 3), (1, 1), (5, 2)):
+        stream = streams[idx]
+        for _ in range(want):
+            taken[idx].append(next(stream))
+    # One more pump: the next router step's leading flush journals the
+    # delivered marks noted above (the crash model is a kill at a step
+    # boundary, exactly where chaos injects it).
+    door.pump()
+    fid_of = {idx: s.req_id for idx, s in streams.items()}
+    trace_of = {idx: s.trace_id for idx, s in streams.items()}
+    pre_delivered = {idx: len(t) for idx, t in taken.items()}
+    del door
+    del router  # crash
+
+    recovered = FleetRouter.recover(
+        jdir, replicas={f"r{i}": c for i, c in enumerate(clients)}
+    )
+    door2 = FrontDoor(recovered)
+    try:
+        adopted = door2.adopt_streams()
+        # Every stream with an undelivered remainder is re-adopted at
+        # its journaled high-water mark.
+        for idx, fid in fid_of.items():
+            if fid is None:
+                continue
+            assert fid in adopted, f"stream {idx} (fid {fid}) not adopted"
+            assert adopted[fid].delivered == pre_delivered[idx]
+            # One trace identity spans both router incarnations.
+            shadow = recovered._shadows[fid]
+            assert shadow.trace_id == trace_of[idx]
+        for idx, fid in fid_of.items():
+            taken[idx].extend(adopted[fid].drain())
+        for idx in streams:
+            assert taken[idx] == list(ref_outputs[idx]), (
+                f"stream {idx}: delivered {taken[idx]}, "
+                f"reference {ref_outputs[idx]}"
+            )
+        # The recovery block rides the door's /statusz document.
+        assert door2.status()["fleet"]["recovery"] is not None
+    finally:
+        door2 = None
+        recovered.close()
+
+
+def test_recovery_journal_is_compacted_and_reusable(
+    tmp_path, model_and_params, ref_outputs
+):
+    """After recovery the journal directory holds ONE fresh segment
+    (the compacted base — old incarnation segments deleted once
+    captured) and it can seed a SECOND recovery: crash-of-the-recovered
+    -router works the same as crash-of-the-original."""
+    from distributed_pytorch_tpu.serving.journal import journal_segments
+
+    model, params = model_and_params
+    jdir = str(tmp_path / "journal")
+    clients = make_clients(model, params)
+    router = FleetRouter(clients, journal_dir=jdir)
+    fids = submit_all(router)
+    for _ in range(2):
+        router.step()
+    del router
+
+    second = FleetRouter.recover(
+        jdir, replicas={f"r{i}": c for i, c in enumerate(clients)}
+    )
+    assert len(journal_segments(jdir)) == 1
+    second.step()
+    del second  # crash again
+
+    third = FleetRouter.recover(
+        jdir, replicas={f"r{i}": c for i, c in enumerate(clients)}
+    )
+    try:
+        assert third.last_recovery["records_replayed"] > 0
+        run_to_completion(third)
+        assert_parity(third, fids, ref_outputs)
+        state = replay_journal(jdir)  # live journal stays replayable
+        assert state.corrupt == []
+    finally:
+        third.close()

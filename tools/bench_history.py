@@ -140,6 +140,31 @@ def extract_row(bench: dict) -> dict:
             )
             if key in fleet_procs
         }
+    fleet_router = bench.get("fleet_router")
+    if fleet_router:
+        # Un-gated like the other drill rows (a mid-run router crash
+        # makes the wall-clock numbers drill-shaped), but recorded: the
+        # control-plane-dark-time trajectory — recovery wall time,
+        # resume-TTFT spike, reconciliation counts — is what the row
+        # exists to track.
+        out["fleet_router"] = {
+            key: fleet_router.get(key)
+            for key in (
+                "transport",
+                "n_replicas",
+                "recovery_s",
+                "re_adopted",
+                "re_admitted",
+                "lost",
+                "finished_tails",
+                "resume_ttft_s_p50",
+                "resume_ttft_spike_x",
+                "aggregate_tokens_per_sec",
+                "greedy_tokens_match_single_engine",
+                "pages_leaked",
+            )
+            if key in fleet_router
+        }
     frontdoor = bench.get("frontdoor")
     if frontdoor:
         # Un-gated like the fleet section (open-loop streaming wall time

@@ -43,6 +43,22 @@
 # process by definition cannot dump at fault time, so the rolling dump IS
 # the recovery artifact. The pytest version is
 # tests/test_fleet_procs.py::test_process_fleet_kill_drill.
+#
+#   bash tools/fleet_smoke.sh router
+#
+# runs the DURABLE-CONTROL-PLANE variant: the ROUTER is the victim. Phase 1
+# spawns three registry-tracked worker subprocesses with an orphan-grace
+# window (TPURUN_ORPHAN_GRACE), journals every submit into a write-ahead
+# request journal, pumps seeded Poisson load, and takes a REAL SIGKILL from
+# an armed kill_router fault at a step boundary. Phase 2 — a fresh process,
+# the successor router — replays the journal, re-adopts all three orphaned
+# workers from the on-disk registry (worker-wins reconciliation on
+# committed tokens), resubmits whatever the journal proves was never
+# admitted, and drains the union to completion with greedy output
+# token-identical to one uninterrupted single-engine reference. The journal
+# segments and the recovery flight dump (with the reconciliation summary)
+# are preserved under traces/ for the CI artifact upload. The pytest
+# version is tests/test_router_procs.py::test_router_sigkill_recovery_drill.
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -219,6 +235,225 @@ mkdir -p "$REPO/traces"
 cp "$POSTMORTEM" "$REPO/traces/fleet_procs_postmortem.json"
 
 echo "[fleet_smoke] PASS (procs)"
+exit 0
+fi
+
+if [ "$SCENARIO" = "router" ]; then
+JDIR="$WORK/journal"
+mkdir -p "$JDIR"
+
+cat > "$WORK/router_phase1.py" <<'EOF'
+"""Durable-control-plane drill, phase 1: the doomed router incarnation.
+Spawns three registry-tracked orphan-grace workers, journals every submit,
+pumps seeded Poisson load, and SIGKILLs ITSELF via an armed kill_router
+fault at a step boundary (see fleet_smoke.sh for the full scenario)."""
+import json
+import os
+import random
+import sys
+
+jdir = sys.argv[1]
+
+from distributed_pytorch_tpu import chaos
+
+os.environ[chaos.ENV_VAR] = json.dumps({
+    "seed": 1234,
+    "faults": [{"kind": "kill_router", "at_step": 4}],
+})
+chaos._reset()
+
+from distributed_pytorch_tpu.serving import (
+    FleetRouter,
+    SamplingParams,
+    spawn_replica_clients,
+)
+
+PREFIX = [5, 7, 11, 2]  # one full page -> a routable affinity key
+PROMPTS = (
+    [PREFIX + [t, t + 1] for t in (1, 9, 17, 25)]  # shared-prefix herd
+    + [[3, 3, 7], [6, 1, 9, 9, 2], [2, 40, 17], [8, 8, 8, 1]]
+)
+MAX_NEW = 8
+MODEL_KW = dict(vocab_size=48, d_model=16, n_layers=2, n_heads=2, d_ff=32)
+ENGINE_KW = dict(max_slots=2, max_seq_len=32, page_size=4,
+                 token_budget=16, max_prefill_chunk=8, debug=True)
+
+env = dict(os.environ)
+env["TPURUN_ORPHAN_GRACE"] = "300"
+clients = spawn_replica_clients([
+    {
+        "name": f"r{i}",
+        "model": dict(MODEL_KW, dtype="float32"),
+        "init_seed": 0,
+        "engine": ENGINE_KW,
+        "flight": {"capacity": 8192},
+    }
+    for i in range(3)
+], run_dir=jdir, env=env)
+router = FleetRouter(clients, journal_dir=jdir)
+
+# Seeded Poisson arrivals: the kill lands mid-decode with work queued.
+rng = random.Random(1234)
+schedule = {}
+rnd = 0
+for idx in range(len(PROMPTS)):
+    schedule.setdefault(rnd, []).append(idx)
+    while rng.random() < 0.5:
+        rnd += 1
+
+fids = {}
+rounds = 0
+while True:
+    for idx in schedule.pop(rounds, []):
+        fids[idx] = router.submit(
+            PROMPTS[idx], SamplingParams(max_new_tokens=MAX_NEW)
+        )
+        tmp = os.path.join(jdir, "fids.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(fids, f)
+        os.replace(tmp, os.path.join(jdir, "fids.json"))
+    router.step()  # the armed kill_router SIGKILLs this process here
+    rounds += 1
+    if rounds > 200:
+        print("kill_router never fired", flush=True)
+        sys.exit(1)
+EOF
+
+cat > "$WORK/router_phase2.py" <<'EOF'
+"""Durable-control-plane drill, phase 2: the successor router. Replays
+the journal, re-adopts the orphaned workers, drains the union, and proves
+token parity against one uninterrupted reference run."""
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from distributed_pytorch_tpu.models.transformer import TransformerLM
+from distributed_pytorch_tpu.obs import FlightRecorder
+from distributed_pytorch_tpu.serving import (
+    FleetRouter,
+    InferenceEngine,
+    SamplingParams,
+    pid_alive,
+    read_worker_registry,
+)
+
+jdir = sys.argv[1]
+
+PREFIX = [5, 7, 11, 2]
+PROMPTS = (
+    [PREFIX + [t, t + 1] for t in (1, 9, 17, 25)]
+    + [[3, 3, 7], [6, 1, 9, 9, 2], [2, 40, 17], [8, 8, 8, 1]]
+)
+MAX_NEW = 8
+MODEL_KW = dict(vocab_size=48, d_model=16, n_layers=2, n_heads=2, d_ff=32)
+ENGINE_KW = dict(max_slots=2, max_seq_len=32, page_size=4,
+                 token_budget=16, max_prefill_chunk=8, debug=True)
+
+# Uninterrupted single-engine reference: the token-parity oracle.
+model = TransformerLM(**MODEL_KW, dtype=jnp.float32)
+params = model.init(
+    jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+)["params"]
+ref = InferenceEngine(model, params, **ENGINE_KW)
+ref_ids = [ref.submit(p, SamplingParams(max_new_tokens=MAX_NEW))
+           for p in PROMPTS]
+ref.run()
+REF = [ref.poll(i).generated for i in ref_ids]
+ref.close()
+
+fids = {
+    int(k): int(v)
+    for k, v in json.load(open(os.path.join(jdir, "fids.json"))).items()
+}
+assert fids, "phase 1 died before any submit"
+
+registry = read_worker_registry(jdir)
+assert sorted(registry) == ["r0", "r1", "r2"], sorted(registry)
+for name, entry in registry.items():
+    assert pid_alive(entry["pid"]), f"{name} (pid {entry['pid']}) died"
+
+router = FleetRouter.recover(jdir, flight=FlightRecorder(capacity=4096))
+summary = router.last_recovery
+assert sorted(summary["re_adopted_workers"]) == ["r0", "r1", "r2"], summary
+assert summary["lost_workers"] == [], summary
+for rep in router.replicas():
+    assert rep.client.adopted and rep.client.adopted_orphan, rep.name
+
+# The journal proves which prompts were never admitted: resubmit them.
+resubmitted = 0
+for idx in range(len(PROMPTS)):
+    if idx not in fids:
+        fids[idx] = router.submit(
+            PROMPTS[idx], SamplingParams(max_new_tokens=MAX_NEW)
+        )
+        resubmitted += 1
+
+rounds = 0
+while any(not s.finished for s in router._shadows.values()):
+    router.step()
+    rounds += 1
+    assert rounds < 500, "recovered fleet never drained"
+
+outs = [router.poll(fids[i]).generated for i in range(len(PROMPTS))]
+for i, (got, want) in enumerate(zip(outs, REF)):
+    assert list(got) == list(want), (
+        f"request {i} diverged across the router restart: {got} != {want}"
+    )
+
+# Zero leaked pages fleet-wide: the workers survived the router, so every
+# allocator must read clean (no SIGKILL exemption in this drill).
+for rep in router.replicas():
+    held = rep.client.read_gauge("pages_referenced")
+    assert held == 0, f"{rep.name} leaked {held} page(s)"
+router.close()
+
+print(json.dumps({
+    "re_adopted": summary["re_adopted"],
+    "re_admitted": summary["re_admitted"],
+    "finished_tails": summary["finished_tails"],
+    "lost": summary["lost"],
+    "records_replayed": summary["records_replayed"],
+    "resubmitted": resubmitted,
+    "rounds": rounds,
+}))
+print("FLEET-ROUTER-DRILL-OK")
+EOF
+
+cd "$WORK"
+fail() { echo "[fleet_smoke] FAIL: $1"; exit 1; }
+
+rc=0
+env PYTHONPATH="$REPO" JAX_PLATFORMS=cpu \
+    python router_phase1.py "$JDIR" > phase1.log 2>&1 || rc=$?
+echo "--- phase1.log"
+cat phase1.log
+# 137 = 128 + SIGKILL: the armed fault really killed the router process.
+[ "$rc" -eq 137 ] || fail "phase 1 exited $rc, expected SIGKILL (137)"
+ls "$JDIR"/journal-*.jsonl >/dev/null 2>&1 || fail "no journal segments on disk"
+[ -e "$JDIR/fids.json" ] || fail "phase 1 never journaled a submit"
+
+rc=0
+env PYTHONPATH="$REPO" JAX_PLATFORMS=cpu \
+    python router_phase2.py "$JDIR" > phase2.log 2>&1 || rc=$?
+echo "--- phase2.log"
+cat phase2.log
+[ "$rc" -eq 0 ] || fail "phase 2 exited with $rc"
+grep -q "FLEET-ROUTER-DRILL-OK" phase2.log || fail "recovery drill never reached the final assertion"
+[ -e "$JDIR/router_recovery_flight.json" ] || fail "no recovery flight dump"
+
+# Preserve the durable-control-plane artifacts for the CI upload: the
+# (recompacted) journal and the recovery flight dump with its
+# reconciliation summary.
+mkdir -p "$REPO/traces"
+cp "$JDIR/router_recovery_flight.json" "$REPO/traces/fleet_router_recovery.json"
+tar -czf "$REPO/traces/fleet_router_journal.tar.gz" -C "$JDIR" \
+    $(cd "$JDIR" && ls journal-*.jsonl) 2>/dev/null \
+    || fail "could not archive journal segments"
+
+echo "[fleet_smoke] PASS (router)"
 exit 0
 fi
 

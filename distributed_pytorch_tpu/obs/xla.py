@@ -209,6 +209,13 @@ class ProgramLedger:
         # tracer counter tracks.
         self.bytes_h2d_total = 0
         self.bytes_d2h_total = 0
+        # Optional per-source attribution: callers passing ``tag=`` to
+        # count_h2d/count_d2h (e.g. the host KV tier's "hostkv_spill" /
+        # "hostkv_fetch") get their bytes double-entry booked here, so a
+        # subsystem's own byte counter can be cross-checked against the
+        # device-truth ledger exactly.
+        self.bytes_h2d_by_tag: Dict[str, int] = {}
+        self.bytes_d2h_by_tag: Dict[str, int] = {}
         self._step_mark_h2d = 0
         self._step_mark_d2h = 0
         # Live-buffer HBM watermark.
@@ -270,11 +277,19 @@ class ProgramLedger:
 
     # ------------------------------------------------------ transfer ledger
 
-    def count_h2d(self, nbytes: int) -> None:
+    def count_h2d(self, nbytes: int, tag: Optional[str] = None) -> None:
         self.bytes_h2d_total += int(nbytes)
+        if tag is not None:
+            self.bytes_h2d_by_tag[tag] = (
+                self.bytes_h2d_by_tag.get(tag, 0) + int(nbytes)
+            )
 
-    def count_d2h(self, nbytes: int) -> None:
+    def count_d2h(self, nbytes: int, tag: Optional[str] = None) -> None:
         self.bytes_d2h_total += int(nbytes)
+        if tag is not None:
+            self.bytes_d2h_by_tag[tag] = (
+                self.bytes_d2h_by_tag.get(tag, 0) + int(nbytes)
+            )
 
     def step_transfer_deltas(self) -> Tuple[int, int]:
         """Bytes moved since the previous call — the per-step numbers the
@@ -332,6 +347,8 @@ class ProgramLedger:
             "analysis_failures": self.analysis_failures,
             "bytes_h2d_total": self.bytes_h2d_total,
             "bytes_d2h_total": self.bytes_d2h_total,
+            "bytes_h2d_by_tag": dict(self.bytes_h2d_by_tag),
+            "bytes_d2h_by_tag": dict(self.bytes_d2h_by_tag),
             "live_buffer_bytes": self.live_bytes,
             "live_buffer_peak_bytes": self.live_peak_bytes,
         }

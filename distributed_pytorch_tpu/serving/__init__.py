@@ -54,6 +54,7 @@ from distributed_pytorch_tpu.serving.grammar import (
     TokenDFA,
     compile_grammar,
 )
+from distributed_pytorch_tpu.serving.hostkv import HostPageTier
 from distributed_pytorch_tpu.serving.journal import (
     Journal,
     JournalError,
@@ -109,6 +110,7 @@ __all__ = [
     "EngineSnapshot",
     "FleetRouter",
     "FrontDoor",
+    "HostPageTier",
     "InferenceEngine",
     "Journal",
     "JournalError",

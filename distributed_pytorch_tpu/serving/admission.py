@@ -255,10 +255,13 @@ class ServingMetrics:
         self.ttft = ReservoirHistogram(reservoir_capacity, seed=1)
         self.tpot = ReservoirHistogram(reservoir_capacity, seed=2)
         self.e2e = ReservoirHistogram(reservoir_capacity, seed=3)
-        # TTFT by prefix-cache outcome: "hit" iff any prompt tokens were
-        # served from cache at the request's FIRST admission.
+        # TTFT by prefix-cache outcome at the request's FIRST admission:
+        # "hit" iff any prompt tokens came from device-resident trie
+        # pages, else "host" iff any were staged up from the host page
+        # tier, else "miss". Device wins ties — a request served by both
+        # tiers already had the cheaper device hit.
         self.ttft_by_source = ReservoirGroup(
-            ("hit", "miss"), reservoir_capacity, seed=4
+            ("hit", "host", "miss"), reservoir_capacity, seed=4
         )
         # Speculative-verify quality: per-round acceptance fraction (of
         # gamma proposals) and tokens emitted per verify (1..gamma).
@@ -299,10 +302,13 @@ class ServingMetrics:
         if req.first_token_time is not None:
             ttft = req.first_token_time - req.submit_time
             self.ttft.record(ttft)
-            self.ttft_by_source.record(
-                "hit" if (req.cached_prompt_tokens or 0) > 0 else "miss",
-                ttft,
-            )
+            if (req.cached_prompt_tokens or 0) > 0:
+                source = "hit"
+            elif (req.host_prompt_tokens or 0) > 0:
+                source = "host"
+            else:
+                source = "miss"
+            self.ttft_by_source.record(source, ttft)
             if req.finish_time is not None:
                 self.e2e.record(req.finish_time - req.submit_time)
                 if req.n_generated > 1:

@@ -113,6 +113,15 @@ class RequestSnapshot:
     # id-rebasing (req_ids are engine-local; this string is not).
     # Defaulted so snapshots written before distributed tracing decode.
     trace_id: Optional[str] = None
+    # Content-addressed keys of the pages HOST-resident in the source
+    # engine's hostkv tier beyond the device chain (``trie_keys``
+    # continues into ``host_keys``). Purely informational to the codec —
+    # an adopter whose own host tier holds these keys recovers the
+    # request by h2d fetch instead of re-prefill (the scheduler's
+    # admission-time host continuation does the matching) — but it lets
+    # a restore target predict its fetch-vs-reprefill bill up front.
+    # Defaulted so snapshots written before the host tier decode.
+    host_keys: Tuple[str, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -161,6 +170,7 @@ class EngineSnapshot:
             entry["prompt"] = tuple(entry["prompt"])
             entry["generated"] = tuple(entry["generated"])
             entry["trie_keys"] = tuple(entry["trie_keys"])
+            entry["host_keys"] = tuple(entry.get("host_keys", ()))
             entry["stop_sequences"] = tuple(
                 tuple(int(t) for t in seq)
                 for seq in entry.get("stop_sequences", ())
@@ -248,10 +258,15 @@ def snapshot_engine(engine) -> EngineSnapshot:
         )
         kv_committed = 0
         trie_keys: Tuple[str, ...] = ()
+        host_keys: Tuple[str, ...] = ()
         if req.slot is not None:
             kv_committed = min(req.len_cached, len(tokens))
         if engine.prefix_cache is not None:
-            trie_keys = tuple(engine.prefix_cache.key_chain(tokens))
+            device_keys, beyond = engine.prefix_cache.key_chain_tiered(
+                tokens
+            )
+            trie_keys = tuple(device_keys)
+            host_keys = tuple(beyond)
         recs.append(
             RequestSnapshot(
                 req_id=req.req_id,
@@ -272,6 +287,7 @@ def snapshot_engine(engine) -> EngineSnapshot:
                 ),
                 kv_committed=kv_committed,
                 trie_keys=trie_keys,
+                host_keys=host_keys,
                 tenant_id=req.tenant_id,
                 # Delivery can never outrun commitment: the stream hands
                 # out ``generated`` entries, and those are committed.
@@ -429,8 +445,11 @@ def restore_engine(
             if rec.ttft_s is not None:
                 req.first_token_time = req.submit_time + rec.ttft_s
             # Goodput: positions the dead engine had K/V for must be
-            # re-prefilled here — charge them to restore_reprefill (a
-            # prefix-cache re-match on re-admission shrinks the charge).
+            # re-prefilled here — charge them to restore_reprefill. A
+            # prefix-cache re-match on re-admission shrinks the charge,
+            # and when the snapshot's key_chain pages are host-resident
+            # in the adopter, the host-tier fetch in _admit recovers
+            # them without prefill at all.
             req.rework_until = rec.kv_committed
             req.rework_kind = "restore_reprefill"
             engine.requests[req_id] = req

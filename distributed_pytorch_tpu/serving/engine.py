@@ -107,7 +107,9 @@ from distributed_pytorch_tpu.serving.admission import (
     AdmissionController,
     ServingMetrics,
 )
+from distributed_pytorch_tpu.serving.hostkv import HostPageTier
 from distributed_pytorch_tpu.serving.kv_cache import (
+    NULL_PAGE,
     PagedBlockAllocator,
     PagePoolGroup,
     PrefixCache,
@@ -247,6 +249,7 @@ class InferenceEngine:
         xla_ledger=None,
         timeseries=None,
         max_live_adapters: int = 4,
+        host_pages: Optional[int] = None,
     ):
         if max_seq_len % page_size:
             raise ValueError(
@@ -363,6 +366,29 @@ class InferenceEngine:
         self.prefix_cache = (
             PrefixCache(self.allocator, page_size) if prefix_cache else None
         )
+        # Host-memory page tier (serving/hostkv.py): ``host_pages`` > 0
+        # preallocates that many host pages per pool and attaches the
+        # tier behind the prefix trie — evicted full pages spill d2h
+        # instead of being lost, and a later prefix hit on a spilled
+        # chain fetches h2d during admission, overlapped with decode.
+        # Token outputs are bitwise-identical tier on or off (the
+        # fetched K/V is the same content a re-prefill would recompute).
+        if host_pages:
+            if self.prefix_cache is None:
+                raise ValueError(
+                    "host_pages requires prefix_cache=True — host pages "
+                    "are named by the prefix trie's content-addressed "
+                    "key chain"
+                )
+            self.hostkv = HostPageTier(
+                {name: self.pools[name] for name in self.pools.names},
+                num_host_pages=int(host_pages),
+                page_size=page_size,
+                gather_fn=self._gather_page,
+            )
+            self.prefix_cache.host = self.hostkv
+        else:
+            self.hostkv = None
         self.scheduler = Scheduler(
             self.allocator,
             max_slots=max_slots,
@@ -649,7 +675,27 @@ class InferenceEngine:
             reg.counter_fn(
                 "prefix_tokens_missed_total", lambda: pc.tokens_missed
             )
+            reg.counter_fn(
+                "prefix_tokens_hit_host_total", lambda: pc.tokens_hit_host
+            )
             reg.gauge_fn("prefix_nodes", lambda: pc.num_nodes)
+        if self.hostkv is not None:
+            hk = self.hostkv
+            reg.counter_fn("hostkv_spills_total", lambda: hk.spills)
+            reg.counter_fn("hostkv_fetches_total", lambda: hk.fetches)
+            reg.counter_fn(
+                "hostkv_spill_bytes_total", lambda: hk.spill_bytes_total
+            )
+            reg.counter_fn(
+                "hostkv_fetch_bytes_total", lambda: hk.fetch_bytes_total
+            )
+            reg.counter_fn(
+                "hostkv_evictions_total", lambda: hk.host_evictions
+            )
+            reg.gauge_fn(
+                "hostkv_pages_resident", lambda: hk.pages_resident
+            )
+            reg.gauge_fn("hostkv_pages_capacity", lambda: hk.capacity)
         # Mesh geometry. The registry has no label support, so the shape
         # label rides an info-style gauge (value pinned to 1.0, shape in
         # the name) next to the numeric per-axis gauges; an unsharded
@@ -866,6 +912,132 @@ class InferenceEngine:
             )
             for name in self.pools.names
         }
+
+    @functools.cached_property
+    def _spill_page(self):
+        """Gather one physical page across every layer of a pool — the
+        device half of a host-tier spill. The cache is NOT donated (the
+        pools live on); the gathered page materializes host-side later,
+        in :meth:`HostPageTier.drain_spills`, so eviction never blocks
+        on a d2h sync. Meshed engines replicate the gathered page so the
+        host drain reads one contiguous buffer per leaf."""
+
+        def run(cache, src):
+            return jax.tree_util.tree_map(lambda pool: pool[src], cache)
+
+        if self.mesh is None:
+            return self._ledgered("spill_page", jax.jit(run))
+        rep = self._replicated
+        return {
+            name: self._ledgered(
+                f"spill_page_{name}",
+                self._sharded_jit(
+                    run,
+                    donate=(),
+                    in_shardings=(self._pool_shardings[name], rep),
+                    out_shardings=rep,
+                ),
+            )
+            for name in self.pools.names
+        }
+
+    @functools.cached_property
+    def _fetch_pages(self):
+        """Write a BATCH of spilled pages' host K/V back into every
+        layer of a pool — ONE program dispatch per pool per step, never
+        per page (per-page dispatch overhead would eat the saved
+        prefill on small pages). Same device-resident dispatch trick as
+        the overlapped step loop: the write is dispatched before the
+        step's prefill/decode, and the cache data dependency orders it
+        ahead of any program that reads the destination pages, so the
+        fetch overlaps ongoing decode instead of stalling it. Callers
+        pad the batch to power-of-two buckets with NULL-page writes
+        (zeros to page 0, which no real sequence reads) so jit retraces
+        stay bounded."""
+
+        def run(cache, chunks, dsts):
+            return jax.tree_util.tree_map(
+                lambda pool, c: pool.at[dsts].set(c), cache, chunks
+            )
+
+        if self.mesh is None:
+            return self._ledgered(
+                "fetch_pages", jax.jit(run, donate_argnums=(0,))
+            )
+        rep = self._replicated
+        return {
+            name: self._ledgered(
+                f"fetch_pages_{name}",
+                self._sharded_jit(
+                    run,
+                    donate=(0,),
+                    in_shardings=(self._pool_shardings[name], rep, rep),
+                    out_shardings=self._pool_shardings[name],
+                ),
+            )
+            for name in self.pools.names
+        }
+
+    def _gather_page(self, page: int):
+        """HostPageTier's gather hook: slice ``page`` out of every pool
+        as device arrays (async — materialized at drain time)."""
+        src = jnp.asarray(page, jnp.int32)
+        fn = self._spill_page
+        per_pool = isinstance(fn, dict)
+        return {
+            name: (fn[name] if per_pool else fn)(self.pools[name], src)
+            for name in self.pools.names
+        }
+
+    def _execute_fetches(self, fetches) -> None:
+        """Stage every planned host-tier fetch h2d — batched into one
+        program dispatch per pool — and unpin the host entries. Byte
+        accounting mirrors the spill side: the tier counts the REAL
+        fetched bytes in :meth:`HostPageTier.chunks` (bucket padding is
+        excluded), and the same sum lands in the transfer ledger under
+        the ``hostkv_fetch`` tag, so the two ledgers cross-check
+        exactly."""
+        tier = self.hostkv
+        fn = self._fetch_pages
+        per_pool = isinstance(fn, dict)
+        staged = 0
+        dsts: list = []
+        per_pool_chunks = {name: [] for name in self.pools.names}
+        for key, page, _parent, _tokens, _node in fetches:
+            chunks = tier.chunks(key)
+            dsts.append(page)
+            for name, chunk in chunks.items():
+                staged += sum(
+                    c.nbytes
+                    for c in jax.tree_util.tree_leaves(chunk)
+                )
+                per_pool_chunks[name].append(chunk)
+            tier.unpin(key)
+            self.prefix_cache.fetch_pending.discard(page)
+        # Pad to the next power-of-two bucket: the padding rows write
+        # zeros to the NULL page (reserved, never read by a live
+        # sequence), so every batch size in a bucket shares one compile.
+        bucket = 1
+        while bucket < len(dsts):
+            bucket *= 2
+        pad = bucket - len(dsts)
+        dst_arr = jnp.asarray(dsts + [NULL_PAGE] * pad, jnp.int32)
+        for name in self.pools.names:
+            stacked = jax.tree_util.tree_map(
+                lambda *leaves: np.stack(leaves),
+                *per_pool_chunks[name],
+            )
+            if pad:
+                stacked = jax.tree_util.tree_map(
+                    lambda s: np.concatenate(
+                        [s, np.zeros((pad,) + s.shape[1:], s.dtype)]
+                    ),
+                    stacked,
+                )
+            run = fn[name] if per_pool else fn
+            self.pools[name] = run(self.pools[name], stacked, dst_arr)
+        if staged and self.xla is not None:
+            self.xla.count_h2d(staged, tag="hostkv_fetch")
 
     @functools.lru_cache(maxsize=16)
     def _draft_prefill_step(self, chunk: int):
@@ -1465,6 +1637,23 @@ class InferenceEngine:
                         jnp.asarray(dst, jnp.int32),
                     )
 
+        if self.hostkv is not None:
+            # Drain spills the schedule phase dispatched (evictions under
+            # allocation pressure) into the host buffers, then stage this
+            # plan's host-tier fetches. Both run BEFORE the empty-plan
+            # early return: a fetch whose request was preempted in the
+            # same schedule must still land (its trie entry is live), and
+            # fetched pages must be written before any prefill/decode
+            # below reads them — the cache data dependency orders that.
+            if self.hostkv.pending_spills:
+                with self._phase("spill"):
+                    spilled = self.hostkv.drain_spills()
+                if spilled and self.xla is not None:
+                    self.xla.count_d2h(spilled, tag="hostkv_spill")
+            if plan.fetches:
+                with self._phase("fetch"):
+                    self._execute_fetches(plan.fetches)
+
         if plan.empty:
             # Nothing to dispatch — drain the outstanding readback (e.g.
             # the final token of the last request) before reporting idle.
@@ -1827,6 +2016,8 @@ class InferenceEngine:
             }
             if self.prefix_cache is not None:
                 out["prefix_cache"] = self.prefix_cache.stats()
+            if self.hostkv is not None:
+                out["hostkv"] = self.hostkv.status()
             if self.slo is not None:
                 slo_state = self.slo.state()
                 out["slo"] = {
@@ -1953,7 +2144,16 @@ class InferenceEngine:
             ):
                 self.scheduler.cancel(req)
             self._closed = True
+            if self.hostkv is not None:
+                # Spills dispatched by the cancellation sweep above (or a
+                # final step) must reach the host buffers and the ledger
+                # before the leak gates run.
+                spilled = self.hostkv.drain_spills()
+                if spilled and self.xla is not None:
+                    self.xla.count_d2h(spilled, tag="hostkv_spill")
             self.allocator.assert_quiescent()
+            if self.hostkv is not None:
+                self.hostkv.assert_quiescent()
             if self.flight.enabled:
                 chaos.remove_fault_observer(self._on_chaos_fault)
                 self._dump_postmortem("close")
@@ -2013,6 +2213,8 @@ class InferenceEngine:
         out["page_evictions"] = self.allocator.evictions
         if self.prefix_cache is not None:
             out.update(self.prefix_cache.stats())
+        if self.hostkv is not None:
+            out.update(self.hostkv.counters())
         if self.goodput is not None:
             gp = self.goodput.report()
             out["goodput_fraction"] = gp["goodput_fraction"]

@@ -1,0 +1,346 @@
+"""Host-memory KV page tier: spilled prefix pages, content-addressed.
+
+The device pools (:class:`~.kv_cache.PagePoolGroup`) hold a hard-capped
+number of KV pages; under ``OutOfPages`` pressure the allocator's LRU
+simply recycled cached-idle pages, so any workload whose warm-prefix
+working set exceeds device HBM paid full re-prefill. This module adds the
+tier below: preallocated host buffers mirroring every device pool's page
+geometry, filled by asynchronous d2h spills when the prefix trie loses a
+page to eviction and drained back h2d when a later prompt hits the
+spilled chain.
+
+Design points, in the order they matter:
+
+* **Content-addressed identity.** A host page is named by the same
+  hash-chained sha256 ``key_chain`` key the elastic snapshot codec uses
+  (:meth:`~.kv_cache.PrefixCache.key_chain`): key ``i`` commits to the
+  entire page-aligned prefix, not just its own page. That makes host
+  pages nameable across tiers AND across processes — a restore target
+  can match a snapshot's ``trie_keys`` against its own host tier without
+  any device state crossing the wire. Keys are verified against the
+  stored token window on every :meth:`match` (exact compare, no
+  hash-collision corruption — same rule as the device trie).
+* **Per-pool buffers in lockstep.** One host slot spans EVERY pool
+  (target, and draft under speculative decoding), exactly like one
+  device page id does: a spill gathers the page from all pools, a fetch
+  writes it back to all pools, so draft K/V stays as valid as target
+  K/V through a tier round-trip.
+* **Asynchronous spill.** :meth:`note_evict` dispatches per-pool device
+  gathers (``pool[page]``) and returns immediately — device dispatch
+  order guarantees the gather reads the page BEFORE any later program
+  overwrites it, so eviction never blocks the scheduler on a d2h sync.
+  The engine drains the staged gathers into the host buffers once per
+  step (:meth:`drain_spills`), off the device path, and charges the
+  bytes to the ``obs/xla.py`` transfer ledger under the
+  ``hostkv_spill`` tag.
+* **Entry states.** ``PENDING`` (spill dispatched, host bytes not yet
+  materialized) -> ``RESIDENT`` (host buffer holds the page). A fetch
+  may only read a ``RESIDENT`` entry; the engine drains pending spills
+  before executing any step's fetches. Entries referenced by a planned
+  fetch are PINNED against the host LRU until the fetch stages them.
+* **Leak-proof like the device tier.** O(1) resident/free gauges are
+  cross-checked against a full O(n) sweep in :meth:`check_invariants`
+  (driven by the same randomized property tests as the allocator), and
+  :meth:`assert_quiescent` is part of ``engine.close()``: no pinned
+  entry and no undrained spill may survive teardown.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+__all__ = ["HostPageTier"]
+
+
+class _HostEntry:
+    """One spilled page: its content key, host slot, exact token window,
+    residency state, and pin count (planned fetches not yet staged)."""
+
+    __slots__ = ("key", "slot", "tokens", "resident", "pins")
+
+    def __init__(self, key: str, slot: int, tokens: Tuple[int, ...]):
+        self.key = key
+        self.slot = slot
+        self.tokens = tokens
+        self.resident = False
+        self.pins = 0
+
+
+class HostPageTier:
+    """Preallocated host page buffers behind the device prefix trie.
+
+    ``template`` maps pool name -> the pool's device pytree (used for
+    per-page leaf shapes/dtypes only); ``gather_fn(page)`` returns the
+    same mapping sliced to one page — device arrays whose materialization
+    is deferred to :meth:`drain_spills`. The engine binds ``gather_fn``
+    to its live :class:`~.kv_cache.PagePoolGroup` so spills always read
+    the current cache arrays; tests may bind plain numpy pools.
+    """
+
+    def __init__(
+        self,
+        template: Dict[str, object],
+        *,
+        num_host_pages: int,
+        page_size: int,
+        gather_fn: Callable[[int], Dict[str, object]],
+    ):
+        if num_host_pages < 1:
+            raise ValueError(
+                f"need >= 1 host page, got {num_host_pages}"
+            )
+        self.capacity = int(num_host_pages)
+        self.page_size = int(page_size)
+        self._gather = gather_fn
+        # Pinned-in-the-OS-sense host mirrors of every pool, page dim
+        # replaced by the host capacity: [num_host_pages, page_size, ...].
+        self._buffers = {
+            name: jax.tree_util.tree_map(
+                lambda leaf: np.zeros(
+                    (self.capacity,) + tuple(leaf.shape[1:]),
+                    dtype=leaf.dtype,
+                ),
+                pool,
+            )
+            for name, pool in template.items()
+        }
+        self.pool_names: Tuple[str, ...] = tuple(self._buffers)
+        # LIFO free-slot stack + LRU entry map (oldest first), mirroring
+        # the device allocator's free/_idle split.
+        self._free_slots: List[int] = list(range(self.capacity - 1, -1, -1))
+        self._entries: "OrderedDict[str, _HostEntry]" = OrderedDict()
+        # key -> dispatched-but-undrained per-pool gathers.
+        self._staged: Dict[str, Dict[str, object]] = {}
+        # O(1) gauges, cross-checked against the sweep in
+        # check_invariants() — a drifted counter is a bug, same contract
+        # as the device allocator's _n_free/_n_referenced/_n_idle.
+        self._n_resident = 0
+        self._n_free = self.capacity
+        # Lifetime counters (registry/bench surface).
+        self.spills = 0
+        self.fetches = 0
+        self.spill_bytes_total = 0
+        self.fetch_bytes_total = 0
+        self.host_evictions = 0
+        self.spill_drops = 0  # evictions lost because every slot was pinned
+
+    # ------------------------------------------------------------- queries
+
+    @property
+    def pages_resident(self) -> int:
+        """Host slots holding an entry (RESIDENT or spill-PENDING)."""
+        return self._n_resident
+
+    @property
+    def pages_free(self) -> int:
+        return self._n_free
+
+    @property
+    def pending_spills(self) -> int:
+        """Spills dispatched but not yet drained into the host buffers."""
+        return len(self._staged)
+
+    def match(self, key: str, tokens: Sequence[int]) -> bool:
+        """True when ``key`` is held with EXACTLY this token window —
+        hash identity proposed, token content verified (the same
+        no-collision-corruption rule as the device trie). PENDING
+        entries match: the engine drains spills before any fetch reads
+        them. Takes no pins and does not touch the LRU."""
+        entry = self._entries.get(key)
+        return entry is not None and entry.tokens == tuple(tokens)
+
+    # ------------------------------------------------------------ spilling
+
+    def note_evict(self, page: int, key: str, tokens: Sequence[int]) -> bool:
+        """Device eviction is recycling ``page``, the trie entry for
+        ``key``: spill it host-side instead of losing it. Dispatches the
+        per-pool device gathers and returns immediately (True iff a spill
+        was staged). A key already held is a clean write-back — content
+        is immutable under its content address, so only the LRU moves.
+        When every slot is pinned the spill is dropped, counted, never
+        blocked on."""
+        tokens = tuple(tokens)
+        existing = self._entries.get(key)
+        if existing is not None:
+            # Same chain key => same prefix content; refresh recency only.
+            self._entries.move_to_end(key)
+            return False
+        if not self._free_slots and not self._evict_host_lru():
+            self.spill_drops += 1
+            return False
+        slot = self._free_slots.pop()
+        self._n_free -= 1
+        entry = _HostEntry(key, slot, tokens)
+        self._entries[key] = entry
+        self._n_resident += 1
+        # The gather reads the page's pre-recycle content because device
+        # programs execute in dispatch order: this dispatch lands before
+        # any later prefill/decode that overwrites the page.
+        self._staged[key] = self._gather(page)
+        self.spills += 1
+        return True
+
+    def _evict_host_lru(self) -> bool:
+        """Free the oldest unpinned host entry; False when all pinned."""
+        for key, entry in self._entries.items():
+            if entry.pins == 0:
+                self._drop(key)
+                self.host_evictions += 1
+                return True
+        return False
+
+    def _drop(self, key: str) -> None:
+        entry = self._entries.pop(key)
+        self._staged.pop(key, None)
+        self._free_slots.append(entry.slot)
+        self._n_free += 1
+        self._n_resident -= 1
+
+    def drain_spills(self) -> int:
+        """Materialize every staged gather into the host buffers (the
+        one host sync of the spill path — the engine runs it once per
+        step, overlapped work already dispatched). Returns the d2h bytes
+        moved, which the engine charges to the transfer ledger under the
+        ``hostkv_spill`` tag; the tier's own ``spill_bytes_total``
+        counts the same bytes so the two ledgers cross-check exactly."""
+        if not self._staged:
+            return 0
+        moved = 0
+        for key, gathered in list(self._staged.items()):
+            entry = self._entries.get(key)
+            assert entry is not None and not entry.resident, (
+                f"staged spill for unknown or resident key {key}"
+            )
+            slot = entry.slot
+            for name, chunk in gathered.items():
+                bufs = jax.tree_util.tree_leaves(self._buffers[name])
+                vals = jax.tree_util.tree_leaves(chunk)
+                for buf, val in zip(bufs, vals):
+                    arr = np.asarray(val)
+                    buf[slot] = arr
+                    moved += arr.nbytes
+            entry.resident = True
+            del self._staged[key]
+        self.spill_bytes_total += moved
+        return moved
+
+    # ------------------------------------------------------------ fetching
+
+    def pin(self, key: str) -> None:
+        """Protect ``key`` from the host LRU until its planned fetch
+        stages it (or the scheduler drops the fetch and unpins)."""
+        self._entries[key].pins += 1
+
+    def unpin(self, key: str) -> None:
+        entry = self._entries.get(key)
+        if entry is None:
+            return  # dropped fetch raced a host eviction of an unpinned twin
+        entry.pins -= 1
+        assert entry.pins >= 0, f"unpin underflow on host key {key}"
+
+    def chunks(self, key: str) -> Dict[str, object]:
+        """Per-pool host views of ``key``'s page for the h2d fetch
+        program. Requires residency (the engine drains spills first);
+        counts the fetch and its bytes, and touches the LRU. The views
+        alias the host buffers — the engine's jit dispatch copies them
+        h2d synchronously, before any later spill could reuse the slot."""
+        entry = self._entries[key]
+        assert entry.resident, (
+            f"fetch of host key {key} before its spill drained"
+        )
+        self._entries.move_to_end(key)
+        out: Dict[str, object] = {}
+        nbytes = 0
+        for name, bufs in self._buffers.items():
+            views = jax.tree_util.tree_map(
+                lambda buf: buf[entry.slot], bufs
+            )
+            nbytes += sum(
+                v.nbytes for v in jax.tree_util.tree_leaves(views)
+            )
+            out[name] = views
+        self.fetches += 1
+        self.fetch_bytes_total += nbytes
+        return out
+
+    # --------------------------------------------------------- diagnostics
+
+    def counters(self) -> Dict[str, int]:
+        """Flat counter/gauge snapshot (``engine.stats()`` merge)."""
+        return {
+            "hostkv_pages_resident": self._n_resident,
+            "hostkv_pages_capacity": self.capacity,
+            "hostkv_spills": self.spills,
+            "hostkv_fetches": self.fetches,
+            "hostkv_spill_bytes": self.spill_bytes_total,
+            "hostkv_fetch_bytes": self.fetch_bytes_total,
+            "hostkv_evictions": self.host_evictions,
+            "hostkv_spill_drops": self.spill_drops,
+        }
+
+    def status(self) -> Dict[str, object]:
+        """The ``/statusz`` block (obs_top reads the resident gauge)."""
+        doc: Dict[str, object] = dict(self.counters())
+        doc["pools"] = list(self.pool_names)
+        doc["pending_spills"] = len(self._staged)
+        doc["pinned"] = sum(
+            1 for e in self._entries.values() if e.pins > 0
+        )
+        return doc
+
+    def check_invariants(self) -> None:
+        """Full O(n) sweep: slots partition exactly into free + entries,
+        no duplicates, staged keys are known and non-resident, pins are
+        non-negative — and the O(1) gauges agree with the sweep."""
+        free_set = set(self._free_slots)
+        used = {e.slot for e in self._entries.values()}
+        assert len(free_set) == len(self._free_slots), (
+            "duplicate slot in host free stack"
+        )
+        assert len(used) == len(self._entries), (
+            "two host entries share a slot"
+        )
+        assert not (free_set & used), (
+            f"host slots both free and resident: {free_set & used}"
+        )
+        assert free_set | used == set(range(self.capacity)), (
+            f"host slot leak: {len(free_set)} free + {len(used)} "
+            f"resident != {self.capacity} slots"
+        )
+        assert all(e.pins >= 0 for e in self._entries.values()), (
+            "negative pin count on a host entry"
+        )
+        for key in self._staged:
+            entry = self._entries.get(key)
+            assert entry is not None and not entry.resident, (
+                f"staged spill for unknown or resident key {key}"
+            )
+        for key, entry in self._entries.items():
+            assert entry.resident or key in self._staged, (
+                f"non-resident host entry {key} with no staged spill"
+            )
+        assert self._n_resident == len(self._entries), (
+            f"hostkv resident gauge drifted: "
+            f"{self._n_resident} != {len(self._entries)}"
+        )
+        assert self._n_free == len(free_set), (
+            f"hostkv free gauge drifted: {self._n_free} != {len(free_set)}"
+        )
+
+    def assert_quiescent(self) -> None:
+        """Teardown gate (``engine.close()``): a pinned entry is a
+        planned fetch that never executed, an undrained spill is d2h
+        bytes the ledger never saw — both are leaks here."""
+        pinned = [k for k, e in self._entries.items() if e.pins > 0]
+        assert not pinned, (
+            f"teardown leaked {len(pinned)} pinned host page(s): {pinned}"
+        )
+        assert not self._staged, (
+            f"teardown with {len(self._staged)} undrained spill(s)"
+        )
+        self.check_invariants()

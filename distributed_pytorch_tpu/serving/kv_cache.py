@@ -49,6 +49,19 @@ import numpy as np
 NULL_PAGE = 0
 
 
+def chain_next(prev: str, chunk: Sequence[int]) -> str:
+    """One link of the content-addressed page chain: the key of a full
+    page holding ``chunk`` whose predecessor page hashed to ``prev``
+    (``"root"`` for the first page). Hash-chained, so each key commits to
+    the ENTIRE page-aligned prefix — the identity shared by
+    :meth:`PrefixCache.key_chain`, the elastic snapshot's ``trie_keys``,
+    and the host page tier (``serving/hostkv.py``), which is what makes a
+    page nameable across tiers and across processes."""
+    return hashlib.sha256(
+        (prev + "|" + ",".join(map(str, chunk))).encode()
+    ).hexdigest()[:16]
+
+
 class OutOfPages(RuntimeError):
     """Raised when an allocation cannot be satisfied even after evicting
     every cached-idle page — the scheduler's cue to preempt the
@@ -452,9 +465,24 @@ class PrefixCache:
         # partial node and later the full node that extends it in place).
         self._by_page: Dict[int, List[tuple]] = {}
         allocator.evict_hook = self._on_evict
+        # Second trie level: a HostPageTier (serving/hostkv.py) catches
+        # full-page evictions d2h and serves them back on later prefix
+        # hits. None keeps the classic single-tier behavior bit-for-bit.
+        self.host = None
+        # Device pages whose h2d fetch is planned but not yet executed —
+        # their device content is garbage until the engine's fetch
+        # program lands, so an eviction racing the plan must NOT spill
+        # them (the host tier already holds the key).
+        self.fetch_pending: set = set()
+        # node id -> its content-addressed chain key (ROOT = "root").
+        # Maintained incrementally at registration so the scheduler can
+        # extend a device match into the host tier in O(pages), not by
+        # re-hashing the whole prefix.
+        self._node_key: Dict[int, str] = {self.ROOT: "root"}
         self.lookups = 0
         self.hits = 0  # lookups that matched at least one token
         self.tokens_hit = 0
+        self.tokens_hit_host = 0
         self.tokens_missed = 0
 
     # ------------------------------------------------------------- queries
@@ -514,19 +542,74 @@ class PrefixCache:
             entry = self._full.get((node, chunk))
             if entry is None:
                 break
-            prev = hashlib.sha256(
-                (prev + "|" + ",".join(map(str, chunk))).encode()
-            ).hexdigest()[:16]
+            prev = chain_next(prev, chunk)
             keys.append(prev)
             node = entry[0]
             matched += page_size
         return keys
 
+    def key_chain_tiered(
+        self, tokens: Sequence[int]
+    ) -> Tuple[List[str], List[str]]:
+        """:meth:`key_chain` split by tier: the device chain, then the
+        host-resident continuation beyond it — the residency record the
+        elastic snapshot persists so a restore target knows which pages
+        the adopter can re-serve by h2d fetch instead of re-prefill."""
+        keys = self.key_chain(tokens)
+        host_keys: List[str] = []
+        if self.host is not None:
+            matched = len(keys) * self.page_size
+            prev = keys[-1] if keys else "root"
+            while matched + self.page_size <= len(tokens):
+                chunk = tuple(tokens[matched : matched + self.page_size])
+                key = chain_next(prev, chunk)
+                if not self.host.match(key, chunk):
+                    break
+                host_keys.append(key)
+                prev = key
+                matched += self.page_size
+        return keys, host_keys
+
+    def node_key(self, node: int) -> Optional[str]:
+        """The content-addressed chain key of ``node`` (``"root"`` for
+        ROOT); None for a node that was evicted out from under its id."""
+        return self._node_key.get(node)
+
+    def host_continuation(
+        self, tokens: Sequence[int], matched: int, node: int, limit: int
+    ):
+        """Full-page windows of ``tokens[matched:limit]`` the HOST tier
+        can serve, continuing the chain from device node ``node`` —
+        ``[(key, chunk), ...]`` in order. Empty when no host tier is
+        attached, when the device match ended mid-page (a partial page
+        breaks the full-page chain), or at the first window the host
+        cannot serve. Pure query: no refs, pins, or LRU motion."""
+        out: List[Tuple[str, Tuple[int, ...]]] = []
+        if self.host is None or matched % self.page_size:
+            return out
+        prev = self._node_key.get(node)
+        if prev is None:
+            return out
+        while matched + self.page_size <= limit:
+            chunk = tuple(tokens[matched : matched + self.page_size])
+            key = chain_next(prev, chunk)
+            if not self.host.match(key, chunk):
+                break
+            out.append((key, chunk))
+            prev = key
+            matched += self.page_size
+        return out
+
     def peek(self, tokens: Sequence[int]) -> int:
         """How many leading tokens of ``tokens`` (capped at ``len - 1``)
-        are cached right now — admission's feasibility estimate. Takes no
-        refs and does not touch the LRU."""
-        _, matched, _ = self._walk(tokens, max(0, len(tokens) - 1))
+        are cached right now in EITHER tier — admission's feasibility
+        estimate. Takes no refs and does not touch the LRU."""
+        limit = max(0, len(tokens) - 1)
+        _, matched, node = self._walk(tokens, limit)
+        if self.host is not None:
+            matched += self.page_size * len(
+                self.host_continuation(tokens, matched, node, limit)
+            )
         return matched
 
     def lookup(self, tokens: Sequence[int]):
@@ -543,6 +626,13 @@ class PrefixCache:
         self.tokens_hit += matched
         self.tokens_missed += limit - matched
         return pages, matched, node
+
+    def note_host_hit(self, n_tokens: int) -> None:
+        """The scheduler extended the last :meth:`lookup` by ``n_tokens``
+        served from the host tier: reclassify them from missed (where
+        lookup counted them) to host-hit, keeping the totals exact."""
+        self.tokens_hit_host += n_tokens
+        self.tokens_missed -= n_tokens
 
     # ---------------------------------------------------------- mutation
 
@@ -564,6 +654,9 @@ class PrefixCache:
         self._next_id += 1
         self._full[key] = (node_id, page)
         self._by_page.setdefault(page, []).append(("full", key))
+        parent_key = self._node_key.get(parent)
+        if parent_key is not None:
+            self._node_key[node_id] = chain_next(parent_key, tokens)
         self.allocator.mark_cached(page)
         return node_id, True
 
@@ -588,10 +681,27 @@ class PrefixCache:
 
     def _on_evict(self, page: int) -> None:
         """Allocation pressure recycled ``page``: forget every trie entry
-        pointing at it before its contents are overwritten."""
-        for entry in self._by_page.pop(page, []):
+        pointing at it before its contents are overwritten — but first,
+        when a host tier is attached, spill full-page entries d2h so the
+        prefix survives demotion instead of costing a re-prefill. A page
+        whose h2d fetch is still pending holds garbage and is NEVER
+        spilled (the host tier already owns the key); partial pages are
+        not spilled either — the content-addressed chain names full
+        pages only."""
+        entries = self._by_page.pop(page, [])
+        pending = page in self.fetch_pending
+        self.fetch_pending.discard(page)
+        for entry in entries:
             if entry[0] == "full":
-                self._full.pop(entry[1], None)
+                full = self._full.pop(entry[1], None)
+                if full is None:
+                    continue
+                key = self._node_key.pop(full[0], None)
+                if self.host is not None and key is not None and not pending:
+                    # Dispatches the d2h gather; the engine drains it
+                    # into the host buffers before the page's new
+                    # content could be read back.
+                    self.host.note_evict(page, key, entry[1][1])
             else:
                 children = self._partial.get(entry[1])
                 if children is not None:
@@ -600,12 +710,20 @@ class PrefixCache:
                         del self._partial[entry[1]]
 
     def stats(self) -> Dict[str, float]:
-        looked = self.tokens_hit + self.tokens_missed
+        # Host-served tokens were reclassified out of tokens_missed by
+        # note_host_hit, so the three buckets partition every looked-up
+        # token: device hit / host hit / miss.
+        looked = self.tokens_hit + self.tokens_hit_host + self.tokens_missed
         return {
             "prefix_lookups": self.lookups,
             "prefix_hits": self.hits,
             "prefix_tokens_hit": self.tokens_hit,
+            "prefix_tokens_hit_host": self.tokens_hit_host,
             "prefix_tokens_missed": self.tokens_missed,
             "prefix_hit_rate": self.tokens_hit / looked if looked else 0.0,
+            "prefix_hit_rate_total": (
+                (self.tokens_hit + self.tokens_hit_host) / looked
+                if looked else 0.0
+            ),
             "prefix_nodes": self.num_nodes,
         }

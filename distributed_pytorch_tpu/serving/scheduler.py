@@ -187,6 +187,10 @@ class Request:
     # Tokens served from the prefix cache at FIRST admission (None until
     # then; 0 = a clean miss) — the TTFT hit/miss split keys off this.
     cached_prompt_tokens: Optional[int] = None
+    # Tokens staged from the HOST page tier at first admission (planned
+    # h2d fetches instead of re-prefill). The TTFT source split labels
+    # device hits first, then host, then miss.
+    host_prompt_tokens: Optional[int] = None
     # Admission-time estimate of uncached prefill work (queue backpressure).
     est_uncached: int = 0
     # Tenant-opaque payload carried through scheduling untouched — and
@@ -243,12 +247,20 @@ class Request:
 @dataclasses.dataclass
 class StepPlan:
     """One engine step's worth of device work: copy-on-write page copies
-    (``(slot, src_page, dst_page)``, executed first), prefill chunks
-    (executed in order, each ``(slot, chunk_len)``), then one batched decode
-    over ``decode_slots``."""
+    (``(slot, src_page, dst_page)``, executed first), host-tier page
+    fetches (``(key, dst_page, parent_node, tokens, node_id)``, h2d
+    stages executed before any prefill/decode that could read them),
+    prefill chunks (executed in order, each ``(slot, chunk_len)``), then
+    one batched decode over ``decode_slots``. ``empty`` deliberately
+    ignores ``fetches``: the engine executes them BEFORE its empty-plan
+    early return, so a fetch planned for a request that was preempted in
+    the same schedule still lands (the trie entry stays valid)."""
 
     copies: List[Tuple[int, int, int]] = dataclasses.field(
         default_factory=list
+    )
+    fetches: List[Tuple[str, int, int, Tuple[int, ...], int]] = (
+        dataclasses.field(default_factory=list)
     )
     prefill: List[Tuple[int, int]] = dataclasses.field(default_factory=list)
     decode_slots: List[int] = dataclasses.field(default_factory=list)
@@ -375,11 +387,14 @@ class Scheduler:
         if req.params.deadline_s is not None:
             self._any_deadlines = True
 
-    def _admit(self, req: Request, slot: int) -> None:
+    def _admit(
+        self, req: Request, slot: int, plan: Optional[StepPlan] = None
+    ) -> None:
         req.slot = slot
         req.len_cached = 0
         req.trie_node = PrefixCache.ROOT
         req.trie_pages = 0
+        host_served = 0
         if self.prefix_cache is not None and not _adapter_bound(req):
             assert not req.table.pages, "admitting a request holding pages"
             pages, matched, node = self.prefix_cache.lookup(req.tokens)
@@ -387,10 +402,14 @@ class Scheduler:
             req.len_cached = matched
             req.trie_node = node
             req.trie_pages = matched // self.page_size
+            if plan is not None:
+                host_served = self._admit_host_pages(req, plan)
             if req.cached_prompt_tokens is None:
                 req.cached_prompt_tokens = matched
+                req.host_prompt_tokens = host_served
         elif req.cached_prompt_tokens is None:
             req.cached_prompt_tokens = 0
+            req.host_prompt_tokens = 0
         req.state = (
             RequestState.DECODE if req.remaining_prefill == 0
             else RequestState.PREFILL
@@ -410,9 +429,60 @@ class Scheduler:
                 req_id=req.req_id,
                 slot=slot,
                 cached_tokens=req.len_cached,
+                host_tokens=host_served,
                 readmission=req.preempt_count > 0,
                 **_flight_trace(req),
             )
+
+    def _admit_host_pages(self, req: Request, plan: StepPlan) -> int:
+        """Extend ``req``'s device prefix match into the HOST tier: for
+        every consecutive full-page window the host holds, allocate a
+        device page, register it in the trie (making the chain a device
+        hit for any later request), pin the host entry, and plan an h2d
+        fetch — so chunked prefill starts at the first token covered by
+        NEITHER tier. Stops at the first page the allocator cannot grant
+        without preempting (a fetch is a cache optimization, never worth
+        evicting live work for). Returns the host-served token count."""
+        pc = self.prefix_cache
+        if pc is None or pc.host is None:
+            return 0
+        limit = max(0, len(req.tokens) - 1)
+        wanted = pc.host_continuation(
+            req.tokens, req.len_cached, req.trie_node, limit
+        )
+        served = 0
+        for key, chunk in wanted:
+            try:
+                (page,) = self.allocator.allocate(1)
+            except OutOfPages:
+                break
+            # allocate() may itself evict a cached-idle device page, whose
+            # host-side spill can LRU-drop an unpinned host entry — even
+            # this very key. Re-verify before pinning; a vanished entry
+            # ends the continuation (the chain is broken past it).
+            if not pc.host.match(key, chunk):
+                self.allocator.free([page])
+                break
+            node, registered = pc.register_full(req.trie_node, chunk, page)
+            # The device walk just failed at (trie_node, chunk) in this
+            # same schedule pass, so the registration cannot be a dupe.
+            assert registered, "host continuation raced a device node"
+            req.table.pages.append(page)
+            pc.host.pin(key)
+            pc.fetch_pending.add(page)
+            plan.fetches.append((key, page, req.trie_node, chunk, node))
+            req.trie_node = node
+            req.trie_pages += 1
+            req.len_cached += self.page_size
+            served += self.page_size
+        if served:
+            pc.note_host_hit(served)
+            if self.tracer.enabled:
+                self.tracer.request_event(
+                    req.req_id, "host_fetch_planned",
+                    pages=served // self.page_size, tokens=served,
+                )
+        return served
 
     def _preempt(self, req: Request) -> None:
         """Evict ``req`` back to the waiting queue: page refs dropped
@@ -635,7 +705,7 @@ class Scheduler:
             if not self.waiting:
                 break
             if self.slots[slot] is None:
-                self._admit(self.waiting.pop(0), slot)
+                self._admit(self.waiting.pop(0), slot, plan)
 
         # 2. Decode set reserves budget first: each running sequence
         # charges its full device write — one token, or a gamma-wide
@@ -713,6 +783,24 @@ class Scheduler:
             (s, src, dst) for (s, src, dst) in plan.copies
             if self.slots[s] is not None
         ]
+        # Validate planned host fetches against the trie: a fetch whose
+        # request was preempted mid-schedule is KEPT as long as its trie
+        # entry survived (the page idles with to-be-valid content and
+        # re-serves the prefix), but one whose destination page was
+        # recycled by later allocation pressure has nowhere valid to
+        # land — _on_evict already dropped the entry and the
+        # fetch-pending mark, so only the host pin needs releasing.
+        if plan.fetches:
+            pc = self.prefix_cache
+            kept = []
+            for fetch in plan.fetches:
+                key, page, parent, toks, node = fetch
+                if pc._full.get((parent, toks)) == (node, page):
+                    kept.append(fetch)
+                else:
+                    pc.fetch_pending.discard(page)
+                    pc.host.unpin(key)
+            plan.fetches = kept
         if self.debug:
             self.allocator.check_invariants()
         return plan

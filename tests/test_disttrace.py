@@ -425,6 +425,7 @@ def test_request_snapshot_json_carries_trace_id():
     entry["prompt"] = tuple(entry["prompt"])
     entry["generated"] = tuple(entry["generated"])
     entry["trie_keys"] = tuple(entry["trie_keys"])
+    entry["host_keys"] = tuple(entry["host_keys"])
     entry["stop_sequences"] = tuple(
         tuple(s) for s in entry["stop_sequences"]
     )

@@ -497,6 +497,46 @@ class TestObsTop:
         frame = render_frame([("http://e:80", doc)], color=False)
         assert "live" in frame
 
+    def test_render_frame_host_tier_columns(self):
+        from tools.obs_top import render_frame
+
+        doc = dict(self.STATUS)
+        doc["hostkv"] = {
+            "hostkv_pages_resident": 7,
+            "hostkv_pages_capacity": 48,
+        }
+        # Cumulative spill counter climbing 4096 B/s; no fetch series yet
+        # (the fetch cell must degrade to '-' like any missing series).
+        ts = {
+            "series": {
+                "serving_hostkv_spill_bytes_total": {
+                    "kind": "counter",
+                    "points": [[0.0, 0.0], [1.0, 4096.0], [2.0, 12288.0]],
+                },
+            }
+        }
+        frame = render_frame(
+            [("http://e1:80", doc)],
+            color=False,
+            timeseries={"http://e1:80": ts},
+        )
+        assert "HOST r/c" in frame and "7/48" in frame
+        assert "SPILL B/s" in frame and "FETCH B/s" in frame
+        # The rate sparkline renders deltas, so the climbing counter shows
+        # two cells (4096 then 8192 B/s), not a monotone ramp of totals.
+        lines = frame.splitlines()
+        row = next(ln for ln in lines if "e1:80" in ln)
+        assert "▁" in row and "█" in row  # distinct rate levels rendered
+
+    def test_render_frame_without_host_tier_shows_dash(self):
+        from tools.obs_top import render_frame
+
+        frame = render_frame([("http://e1:80", self.STATUS)], color=False)
+        row = next(
+            ln for ln in frame.splitlines() if "e1:80" in ln
+        )
+        assert " - " in row  # HOST r/c cell degrades to '-'
+
 
 # ------------------------------------------------------- bench history gate
 

@@ -14,6 +14,7 @@ import pytest
 from distributed_pytorch_tpu.generation import generate
 from distributed_pytorch_tpu.models.transformer import TransformerLM
 from distributed_pytorch_tpu.serving import (
+    HostPageTier,
     InferenceEngine,
     OutOfPages,
     PagedBlockAllocator,
@@ -282,10 +283,20 @@ class TestCowAllocatorProperty:
         cycles over the refcounted CoW allocator with prefix caching on a
         deliberately tiny pool: after every cycle the allocator invariants
         hold AND every page's refcount equals the number of live block
-        tables holding it; at drain nothing leaked."""
+        tables holding it; at drain nothing leaked. A host page tier
+        (deliberately smaller than the churn needs) rides the same
+        cycles, so spills and fetches race device eviction — its O(1)
+        free/resident gauges are cross-asserted against the O(n) sweep
+        after every cycle too, and it must be quiescent at drain."""
         rng = random.Random(99)
         alloc = PagedBlockAllocator(21)
         cache = PrefixCache(alloc, page_size=2)
+        pool = np.zeros((21, 2, 1, 2), np.float32)
+        tier = HostPageTier(
+            {"target": pool}, num_host_pages=6, page_size=2,
+            gather_fn=lambda page: {"target": pool[page]},
+        )
+        cache.host = tier
         sched = Scheduler(
             alloc, max_slots=4, page_size=2, pages_per_seq=8,
             token_budget=8, max_prefill_chunk=4,
@@ -307,6 +318,14 @@ class TestCowAllocatorProperty:
 
         def drive_one():
             plan = sched.schedule()
+            # Mirror the engine's step order for the host tier: drain the
+            # spills this schedule staged, then execute its fetches
+            # (stage chunks, unpin, clear the fetch-pending guard).
+            tier.drain_spills()
+            for key, page, _parent, _toks, _node in plan.fetches:
+                tier.chunks(key)
+                tier.unpin(key)
+                cache.fetch_pending.discard(page)
             for slot, chunk in plan.prefill:
                 sched.note_prefilled(slot, chunk)
             for slot in plan.decode_slots:
@@ -318,6 +337,14 @@ class TestCowAllocatorProperty:
                 if done is not None:
                     sched.retire(done, now=0.0)
                     del live[done.req_id]
+
+        def check_host_gauges():
+            # O(1) gauges vs an independent O(n) sweep, plus the tier's
+            # own partition invariants — same contract as the allocator.
+            assert tier.pages_resident == len(tier._entries)
+            assert tier.pages_free == len(tier._free_slots)
+            assert tier.pages_resident + tier.pages_free == tier.capacity
+            tier.check_invariants()
 
         for _ in range(1200):
             if rng.random() < 0.45 and len(live) < 40:
@@ -337,6 +364,7 @@ class TestCowAllocatorProperty:
             alloc.check_invariants()
             assert_gauges_match_sweep(alloc)
             check_refcounts()
+            check_host_gauges()
         for _ in range(4000):
             if not sched.has_work:
                 break
@@ -345,10 +373,20 @@ class TestCowAllocatorProperty:
         alloc.check_invariants()
         assert_gauges_match_sweep(alloc)
         check_refcounts()
+        check_host_gauges()
+        tier.assert_quiescent()
         assert alloc.num_allocated == 0
         assert alloc.num_free == 20, "pages leaked"
         assert cache.stats()["prefix_hit_rate"] > 0
         assert alloc.evictions > 0, "pool was sized to force eviction"
+        s = cache.stats()
+        assert tier.spills > 0, "churn was sized to force spills"
+        assert tier.fetches > 0 and s["prefix_tokens_hit_host"] > 0, (
+            "churn was sized so host fetches race device eviction"
+        )
+        assert tier.host_evictions > 0, (
+            "host tier was sized smaller than the spill stream"
+        )
 
 
 # ------------------------------------------------------------- engine parity

@@ -208,6 +208,34 @@ def extract_row(bench: dict) -> dict:
             )
             if key in perfwatch
         }
+    hostkv = bench.get("hostkv")
+    if hostkv:
+        # Un-gated like the fleet/frontdoor/perfwatch sections (open-loop
+        # TTFT percentiles are too arrival-jitter-noisy for the +/-10%
+        # gate) but recorded: the parity/hit-rate/byte-cross-check
+        # acceptance rows and the tier's TTFT trajectory are what the
+        # row is for.
+        out["hostkv"] = {
+            key: hostkv.get(key)
+            for key in (
+                "tokens_bitwise_identical",
+                "hit_rate_strictly_higher",
+                "prefix_hit_rate_off",
+                "prefix_hit_rate_on",
+                "host_hit_tokens",
+                "ttft_s_p50_off",
+                "ttft_s_p50_on",
+                "ttft_p50_speedup_hostkv",
+                "ttft_p50_lower_with_tier",
+                "hostkv_spills",
+                "hostkv_fetches",
+                "spill_bytes_match_ledger",
+                "fetch_bytes_match_ledger",
+                "device_pages_leaked",
+                "tokens_per_sec_on",
+            )
+            if key in hostkv
+        }
     return out
 
 

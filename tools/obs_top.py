@@ -6,9 +6,13 @@ redraws an ANSI dashboard: per-engine health, queue depth, running
 requests, page states, TTFT/TPOT percentiles, tokens/sec, SLO firing set,
 and the recompile-sentinel counter — plus the busiest in-flight requests
 of the first engine. Engines exposing the performance observatory's
-``/timeseries`` endpoint additionally get two sparkline columns
-(tokens/sec and per-step TPOT over the last minute); engines without it
-show ``-`` cells, nothing breaks. Stdlib only, one process, no curses
+``/timeseries`` endpoint additionally get sparkline columns
+(tokens/sec, per-step TPOT, and — when the hierarchical-KV host tier is
+on — d2h spill / h2d fetch byte rates over the last minute); engines
+without it show ``-`` cells, nothing breaks. Engines running with
+``host_pages`` also get a ``HOST r/c`` cell (host pages resident /
+capacity) next to the device-page gauges, read straight from the
+``hostkv`` block of ``/statusz``. Stdlib only, one process, no curses
 dependency (ANSI home+clear is enough and survives dumb terminals via
 ``--once``).
 
@@ -60,7 +64,11 @@ def poll_timeseries(
     url: str,
     timeout: float = 2.0,
     window_s: float = 60.0,
-    series: str = "tokens_per_sec,tpot_step_seconds",
+    series: str = (
+        "tokens_per_sec,tpot_step_seconds,"
+        "serving_hostkv_spill_bytes_total,"
+        "serving_hostkv_fetch_bytes_total"
+    ),
 ) -> Optional[dict]:
     """One ``/timeseries`` GET for the sparkline columns; None when the
     engine predates the performance observatory (404) or is down — the
@@ -106,6 +114,24 @@ def _series_spark(ts_doc: Optional[dict], name: str, width: int = 12) -> str:
     return _spark([p[1] for p in points if len(p) == 2], width)
 
 
+def _rate_spark(ts_doc: Optional[dict], name: str, width: int = 12) -> str:
+    """Sparkline of a CUMULATIVE counter series as a per-second rate:
+    successive deltas divided by their time gaps (the TSDB's documented
+    counter semantics — no extrapolation, no reset detection). The host
+    tier's spill/fetch byte counters render through this, so the cell
+    shows transfer *activity*, not the monotone lifetime total."""
+    if not ts_doc:
+        return "-" * width
+    points = (ts_doc.get("series", {}).get(name) or {}).get("points", [])
+    points = [p for p in points if len(p) == 2]
+    rates: List[float] = []
+    for (t0, v0), (t1, v1) in zip(points, points[1:]):
+        dt = t1 - t0
+        if dt > 0:
+            rates.append(max(0.0, v1 - v0) / dt)
+    return _spark(rates, width)
+
+
 def _ms(value) -> str:
     """Seconds -> fixed-width milliseconds, '-' for missing/NaN."""
     if not isinstance(value, (int, float)) or value != value:
@@ -136,9 +162,10 @@ def render_frame(
     reset = RESET if color else ""
     lines = [
         f"{bold}{'ENGINE':<28} {'HEALTH':<8} {'Q':>4} {'RUN':>4} "
-        f"{'PAGES f/r/i':>14} {'TTFT p50':>9} {'TPOT p50':>9} "
-        f"{'TPOT p95':>9} {'TOK/S':>8} {'TOK/S 60s':>12} "
-        f"{'TPOT 60s':>12} {'RECOMP':>7}  SLO{reset}"
+        f"{'PAGES f/r/i':>14} {'HOST r/c':>9} {'TTFT p50':>9} "
+        f"{'TPOT p50':>9} {'TPOT p95':>9} {'TOK/S':>8} "
+        f"{'TOK/S 60s':>12} {'TPOT 60s':>12} {'SPILL B/s':>12} "
+        f"{'FETCH B/s':>12} {'RECOMP':>7}  SLO{reset}"
     ]
     for url, doc in polled:
         name = url.replace("http://", "")[:28]
@@ -151,6 +178,13 @@ def render_frame(
             f"{pages.get('pages_free', 0)}/"
             f"{pages.get('pages_referenced', 0)}/"
             f"{pages.get('pages_cached_idle', 0)}"
+        )
+        hostkv = doc.get("hostkv") or {}
+        host_cell = (
+            f"{hostkv.get('hostkv_pages_resident', 0)}/"
+            f"{hostkv.get('hostkv_pages_capacity', 0)}"
+            if hostkv
+            else "-"
         )
         latency = doc.get("latency", {})
         sentinel = doc.get("recompile_sentinel") or {}
@@ -169,12 +203,15 @@ def render_frame(
             f"{doc.get('queue_depth', 0):>4} "
             f"{doc.get('running_requests', 0):>4} "
             f"{page_cell:>14} "
+            f"{host_cell:>9} "
             f"{_ms(latency.get('ttft_p50_s')):>9} "
             f"{_ms(latency.get('tpot_p50_s')):>9} "
             f"{_ms(latency.get('tpot_p95_s')):>9} "
             f"{latency.get('tokens_per_sec', 0) or 0:>8.1f} "
             f"{_series_spark(ts_doc, 'tokens_per_sec'):>12} "
             f"{_series_spark(ts_doc, 'tpot_step_seconds'):>12} "
+            f"{_rate_spark(ts_doc, 'serving_hostkv_spill_bytes_total'):>12} "
+            f"{_rate_spark(ts_doc, 'serving_hostkv_fetch_bytes_total'):>12} "
             f"{recomp_cell}  {slo_cell}"
         )
     first = next((doc for _u, doc in polled if doc), None)

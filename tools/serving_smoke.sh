@@ -27,6 +27,15 @@
 #   bash tools/serving_smoke.sh frontdoor  # front-door scenario only
 #   bash tools/serving_smoke.sh disttrace  # fleet-wide tracing scenario
 #   bash tools/serving_smoke.sh perfwatch  # performance observatory drill
+#   bash tools/serving_smoke.sh hostkv     # hierarchical-KV host tier
+#
+# The ``hostkv`` scenario drives the host-RAM page tier with a prefix
+# working set FOUR TIMES the device page pool: every re-used prompt's
+# pages must round-trip through a d2h spill and an h2d fetch, with a
+# nonzero host-tier hit rate, greedy tokens bitwise-identical to a
+# tier-off engine, the spill/fetch byte counters matching the XLA
+# transfer ledger exactly, and close()'s quiescence gate covering the
+# host buffers (no pinned entries, no undrained spills, zero leaks).
 #
 # The ``mesh`` scenario boots the engine on a (2,4) ("data","model") mesh
 # over 8 virtual CPU devices, replays a shared-prefix workload, and
@@ -582,6 +591,84 @@ print(
     f"{event['attributed_phase']!r}, "
     f"tsdb {n_series} series / "
     f"{mem_after} bytes -> traces/timeseries_dump.json"
+)
+EOF
+  exit 0
+fi
+
+if [ "$scenario" = "hostkv" ]; then
+  env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python - <<'EOF'
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_pytorch_tpu.models.transformer import TransformerLM
+from distributed_pytorch_tpu.serving import InferenceEngine, SamplingParams
+
+VOCAB = 128
+model = TransformerLM(
+    vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+    dtype=jnp.float32,
+)
+params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+# Device pool: 8 usable pages. Prefix working set: 16 disjoint two-page
+# prompts = 32 pages, FOUR TIMES the device pool — every prompt's pages
+# are long evicted by the time it recurs, so pass 2 can only hit through
+# the host tier.
+DEVICE_PAGES = 9
+PROMPTS = [
+    [(i * 8 + j) % VOCAB + 1 for j in range(8)] for i in range(16)
+]
+ENGINE_KW = dict(
+    max_slots=2, max_seq_len=32, page_size=4, num_pages=DEVICE_PAGES,
+    token_budget=16, max_prefill_chunk=8, debug=True,
+)
+sp = SamplingParams(max_new_tokens=4)
+
+def run_workload(host_pages):
+    eng = InferenceEngine(
+        model, params, host_pages=host_pages, xla_ledger=True, **ENGINE_KW
+    )
+    outs = []
+    for _ in range(2):
+        for p in PROMPTS:
+            rid = eng.submit(p, sp)
+            eng.run()
+            outs.append(eng.poll(rid).generated)
+    stats = eng.stats()
+    assert stats["pages_allocated"] == 0, "device pages leaked"
+    eng.allocator.check_invariants()
+    # close() drains trailing spills and runs BOTH quiescence gates:
+    # allocator (zero referenced pages) and host tier (no pinned
+    # entries, no undrained spills, slot partition exact).
+    eng.close()
+    return eng, outs, stats
+
+eng_off, outs_off, stats_off = run_workload(None)
+eng_on, outs_on, stats_on = run_workload(48)
+
+assert outs_on == outs_off, "host tier changed greedy tokens"
+assert stats_on["prefix_tokens_hit_host"] > 0, (
+    f"no host-tier hits over a 4x working set: {stats_on}"
+)
+assert stats_on["hostkv_spills"] > 0 and stats_on["hostkv_fetches"] > 0
+assert stats_on["prefix_hit_rate_total"] > stats_off["prefix_hit_rate_total"]
+md = eng_on.xla.metadata()
+assert md["bytes_d2h_by_tag"].get("hostkv_spill", 0) == \
+    eng_on.hostkv.spill_bytes_total, "spill bytes drifted from the ledger"
+assert md["bytes_h2d_by_tag"].get("hostkv_fetch", 0) == \
+    eng_on.hostkv.fetch_bytes_total, "fetch bytes drifted from the ledger"
+
+print(
+    "[serving_smoke] PASS: hostkv scenario, 32/32 requests over a "
+    f"4x-device working set, host hit tokens "
+    f"{stats_on['prefix_tokens_hit_host']}, "
+    f"hit rate {stats_off['prefix_hit_rate_total']:.3f} -> "
+    f"{stats_on['prefix_hit_rate_total']:.3f}, "
+    f"{stats_on['hostkv_spills']} spills / "
+    f"{stats_on['hostkv_fetches']} fetches, byte counters == ledger, "
+    "zero leaks, both tiers quiescent at close()"
 )
 EOF
   exit 0

@@ -135,6 +135,18 @@ class Attention(nn.Module):
     # __call__ every step.
     page_size: int = 0
     num_pages: int = 0
+    # Serving decode read path for the paged cache: "" keeps the inline XLA
+    # gather math below (the bitwise reference), anything else names an
+    # ops/paged_attention kernel mode ("auto" | "pallas" | "interpret" |
+    # "xla"). Only single-token decode steps (t_step == 1) dispatch to the
+    # kernel; prefill chunks and speculative verify always use the inline
+    # math, which the kernel's fp path matches bitwise by construction.
+    paged_kernel: str = ""
+    # "" = fp pages (pool dtype follows the activations); "int8" = symmetric
+    # absmax per-(token, head) int8 pages with [num_pages, page_size, Hkv]
+    # float32 scale pools, quantized at every page write, dequantized at
+    # read (inline gather or inside the kernel).
+    kv_quant: str = ""
 
     @nn.compact
     def __call__(
@@ -173,6 +185,24 @@ class Attention(nn.Module):
                     "paged decode does not compose with sliding-window "
                     "attention yet"
                 )
+            if self.kv_quant not in ("", "int8"):
+                raise ValueError(
+                    f"unknown kv_quant {self.kv_quant!r} "
+                    "(expected '' or 'int8')"
+                )
+            if self.paged_kernel:
+                # Same fail-fast rule as sequence_mode: a typo'd kernel
+                # mode dies on the cache-init forward, not mid-serve.
+                from distributed_pytorch_tpu.ops.paged_attention import (
+                    resolve_kernel,
+                )
+
+                resolve_kernel(self.paged_kernel)
+        elif self.paged_kernel or self.kv_quant:
+            raise ValueError(
+                "paged_kernel / kv_quant require the paged cache "
+                "(page_size > 0)"
+            )
         head_dim = self.d_model // self.n_heads
         kv_heads = self.n_kv_heads or self.n_heads
         if self.n_heads % kv_heads:
@@ -208,8 +238,19 @@ class Attention(nn.Module):
             # mode — then fall through to the normal causal forward.
             if self.page_size:
                 pool = (self.num_pages, self.page_size, kv_heads, head_dim)
-                self.variable("cache", "cached_key", jnp.zeros, pool, k_raw.dtype)
-                self.variable("cache", "cached_value", jnp.zeros, pool, v.dtype)
+                pool_dtype = jnp.int8 if self.kv_quant else k_raw.dtype
+                self.variable("cache", "cached_key", jnp.zeros, pool, pool_dtype)
+                self.variable("cache", "cached_value", jnp.zeros, pool, pool_dtype)
+                if self.kv_quant:
+                    # Per-(page-slot, head) float32 scales live alongside the
+                    # int8 pools; they ride every pool-shaped program (CoW
+                    # copy, spill/fetch) via the same tree_map genericity.
+                    self.variable(
+                        "cache", "key_scale", jnp.zeros, pool[:-1], jnp.float32
+                    )
+                    self.variable(
+                        "cache", "value_scale", jnp.zeros, pool[:-1], jnp.float32
+                    )
             else:
                 cache_dtype = jnp.int8 if self.quantized_cache else k_raw.dtype
                 self.variable("cache", "cached_key", jnp.zeros, k_raw.shape, cache_dtype)
@@ -400,6 +441,10 @@ class Attention(nn.Module):
         """
         cached_key = self.variable("cache", "cached_key", lambda: None)
         cached_value = self.variable("cache", "cached_value", lambda: None)
+        key_scale = value_scale = None
+        if self.kv_quant:
+            key_scale = self.variable("cache", "key_scale", lambda: None)
+            value_scale = self.variable("cache", "value_scale", lambda: None)
         s, t_step, h, d = q_raw.shape
         kv_heads = k_raw.shape[2]
         page = self.page_size
@@ -430,12 +475,49 @@ class Attention(nn.Module):
         phys = block_tables[rows, logical]  # [S*T_step]
         phys = jnp.where(flat_pos < pages_per_seq * page, phys, 0)
         offset = flat_pos % page
-        cached_key.value = cached_key.value.at[phys, offset].set(
-            k.astype(cached_key.value.dtype).reshape(-1, kv_heads, d)
-        )
-        cached_value.value = cached_value.value.at[phys, offset].set(
-            v.astype(cached_value.value.dtype).reshape(-1, kv_heads, d)
-        )
+        if self.kv_quant:
+            # Quantize at the write: symmetric absmax per-(token, head) over
+            # D — the pool holds int8, the [num_pages, page_size, Hkv] scale
+            # pool holds one float32 per written (page-slot, head).
+            from distributed_pytorch_tpu.ops.quant import quantize_int8
+
+            def write(cache, scale_var, x):
+                qt = quantize_int8(
+                    x.astype(jnp.float32).reshape(-1, kv_heads, d), (2,)
+                )
+                cache.value = cache.value.at[phys, offset].set(qt.q)
+                scale_var.value = scale_var.value.at[phys, offset].set(
+                    jnp.squeeze(qt.scale, -1)
+                )
+
+            write(cached_key, key_scale, k)
+            write(cached_value, value_scale, v)
+        else:
+            cached_key.value = cached_key.value.at[phys, offset].set(
+                k.astype(cached_key.value.dtype).reshape(-1, kv_heads, d)
+            )
+            cached_value.value = cached_value.value.at[phys, offset].set(
+                v.astype(cached_value.value.dtype).reshape(-1, kv_heads, d)
+            )
+
+        if self.paged_kernel and t_step == 1:
+            # Fused read path: the batched single-token decode step goes
+            # through ops/paged_attention (Pallas on TPU, its XLA reference
+            # elsewhere — which reproduces the inline math below bitwise).
+            # Prefill chunks and speculative verify (t_step > 1) keep the
+            # inline math: they are a tiny fraction of decode-step count and
+            # the kernel's one-query-row grid doesn't fit them.
+            from distributed_pytorch_tpu.ops.paged_attention import (
+                paged_attention,
+            )
+
+            return paged_attention(
+                q, cached_key.value, cached_value.value, block_tables,
+                seq_lens,
+                k_scale=None if key_scale is None else key_scale.value,
+                v_scale=None if value_scale is None else value_scale.value,
+                kernel=self.paged_kernel, mesh=self.mesh,
+            )
 
         # Gather each row's pages into its contiguous logical view. K below
         # is pages_per_seq * page_size — the row's maximum context, not the
@@ -446,6 +528,15 @@ class Attention(nn.Module):
         values = cached_value.value[block_tables].reshape(
             s, pages_per_seq * page, kv_heads, d
         )
+        if self.kv_quant:
+            ks = key_scale.value[block_tables].reshape(
+                s, pages_per_seq * page, kv_heads
+            )
+            vs = value_scale.value[block_tables].reshape(
+                s, pages_per_seq * page, kv_heads
+            )
+            keys = keys.astype(q.dtype) * ks[..., None].astype(q.dtype)
+            values = values.astype(q.dtype) * vs[..., None].astype(q.dtype)
         scale = d**-0.5
         k_abs = jnp.arange(pages_per_seq * page)[None, None, :]
         visible = k_abs <= positions[:, :, None]  # [S, T_step, K]
@@ -521,6 +612,8 @@ class TransformerBlock(nn.Module):
     quantized_cache: bool = False  # int8 KV cache in decode (see Attention)
     page_size: int = 0  # paged KV cache in decode (see Attention); 0 = contiguous
     num_pages: int = 0
+    paged_kernel: str = ""  # fused paged-decode read path (see Attention)
+    kv_quant: str = ""  # int8 KV pages + scale pools (see Attention)
 
     @nn.compact
     def __call__(
@@ -554,6 +647,7 @@ class TransformerBlock(nn.Module):
             sequence_mode=self.sequence_mode, decode=self.decode,
             quantized_cache=self.quantized_cache,
             page_size=self.page_size, num_pages=self.num_pages,
+            paged_kernel=self.paged_kernel, kv_quant=self.kv_quant,
             name="attention",
         )(nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x), **paged_kw))
         if self.n_experts > 0:
@@ -692,6 +786,8 @@ class TransformerLM(nn.Module):
     # num_pages=N and passes block_tables/seq_lens through __call__.
     page_size: int = 0
     num_pages: int = 0
+    paged_kernel: str = ""  # fused paged-decode read path (see Attention)
+    kv_quant: str = ""  # int8 KV pages + scale pools (see Attention)
 
     @nn.compact
     def __call__(
@@ -739,6 +835,7 @@ class TransformerLM(nn.Module):
                 decode=self.decode, remat_mlp=remat_mlp,
                 quantized_cache=self.quantized_cache,
                 page_size=self.page_size, num_pages=self.num_pages,
+                paged_kernel=self.paged_kernel, kv_quant=self.kv_quant,
                 name=f"block_{i}",
             )(x, **paged_kw)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)

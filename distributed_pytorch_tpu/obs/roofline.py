@@ -59,6 +59,14 @@ HBM_BYTES_PER_SEC = {
 }
 DEFAULT_HBM_BW = 819e9
 
+# Ledger-name prefixes of programs whose hot loop is a hand-written fused
+# kernel rather than plain XLA — program_rows tags these so a bench (or a
+# /statusz reader) can attribute an achieved_fraction delta to the kernel
+# instead of eyeballing program names. The paged decode program compiles
+# under "decode_step_paged" exactly when InferenceEngine(paged_kernel=...)
+# is on.
+FUSED_PROGRAM_PREFIXES = ("decode_step_paged",)
+
 
 def hbm_bandwidth_per_chip(device) -> float:
     """Best-effort peak HBM bytes/sec for a jax device, by kind substring
@@ -191,6 +199,9 @@ class RooflineModel:
             point["flops_source"] = (
                 "cost_analysis" if record.flops > 0.0 else "analytic"
             )
+            point["fused_kernel"] = record.name.startswith(
+                FUSED_PROGRAM_PREFIXES
+            )
             rows.append(point)
         rows.sort(key=lambda r: -r["calls"])
         return rows
@@ -298,6 +309,7 @@ class RooflineModel:
 __all__ = [
     "HBM_BYTES_PER_SEC",
     "DEFAULT_HBM_BW",
+    "FUSED_PROGRAM_PREFIXES",
     "hbm_bandwidth_per_chip",
     "roofline_point",
     "RooflineModel",

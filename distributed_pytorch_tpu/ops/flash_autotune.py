@@ -67,7 +67,26 @@ DEFAULT_TABLE = {
     },
 }
 
+#: Cache-key family tag for the paged flash-decode kernel
+#: (ops/paged_attention.py). Paged entries share the tiered lookup,
+#: disk-cache file, and FLASH_BLOCKS_TABLE schema with the training
+#: kernel's — the family tag rides inside the key's dtype slot
+#: (``"paged_decode:<dtype>:p<page_size>"``), so the two families can
+#: never collide and existing table files keep decoding unchanged.
+PAGED_FAMILY = "paged_decode"
+
+# device_kind -> pages-per-block for the paged decode kernel. The "cpu"
+# entry is the SEEDED interpret/CI value: the test rig resolves its block
+# size from here, so CI never runs a sweep. TPU entries follow the same
+# grow-the-tile direction the flash sweeps measured (fewer grid steps,
+# longer contractions); re-measure with ``main(--paged)`` per device kind.
+PAGED_DEFAULT_TABLE = {
+    "cpu": 2,
+    "tpu v5 lite": 8,
+}
+
 _FALLBACK = (512, 1024)
+_PAGED_FALLBACK = 4  # pages per block when nothing is known about the chip
 _runtime_cache: dict = {}
 # Keys whose measured sweep failed outright (no candidate compiled) in THIS
 # process: memoized so the live FLASH_AUTOTUNE=1 path doesn't re-pay the
@@ -185,6 +204,166 @@ def lookup(
     # not re-open the disk cache file.
     _runtime_cache[key] = blocks
     return blocks
+
+
+def _paged_key(
+    device_kind: str, kv_len: int, page_size: int, head_dim: int,
+    dtype_name: str,
+):
+    """Key for the ``paged_decode`` family: same 5-tuple shape as
+    :func:`_key` (so every cache tier and table file works unchanged) with
+    the family tag and page size folded into the dtype slot."""
+    return _key(
+        device_kind, int(kv_len), int(head_dim),
+        f"{PAGED_FAMILY}:{dtype_name}:p{int(page_size)}", False,
+    )
+
+
+def paged_candidates(pages_per_seq: int, page_size: int):
+    """Legal pages-per-block choices for the paged decode kernel: powers of
+    two up to the table width, KV-block span bounded so the per-step page
+    tiles (2 pools x fp32 worst case) stay comfortably inside VMEM."""
+    out, c = [], 1
+    while c <= max(1, int(pages_per_seq)):
+        if c * page_size <= 4096:
+            out.append(c)
+        c *= 2
+    return out or [1]
+
+
+def lookup_paged(
+    kv_len: int,
+    page_size: int,
+    head_dim: int,
+    dtype_name: str = "float32",
+    device_kind: Optional[str] = None,
+) -> int:
+    """Best-known pages-per-block for a paged decode shape, tiered exactly
+    like :func:`lookup`: runtime cache -> FLASH_BLOCKS_TABLE ->
+    disk cache -> seeded :data:`PAGED_DEFAULT_TABLE` -> fallback. Entries
+    store ``(pages_per_block, pages_per_block * page_size)`` to keep the
+    two-int JSON schema shared with the flash family."""
+    if device_kind is None:
+        device_kind = _device_kind()
+    key = _paged_key(device_kind, kv_len, page_size, head_dim, dtype_name)
+    if key in _runtime_cache:
+        return int(_runtime_cache[key][0])
+    table_path = os.environ.get("FLASH_BLOCKS_TABLE")
+    if table_path:
+        shipped = _load_table_file(table_path)
+        if key in shipped:
+            _runtime_cache[key] = shipped[key]
+            return int(shipped[key][0])
+    disk = _load_disk_cache()
+    if key in disk:
+        _runtime_cache[key] = disk[key]
+        return int(disk[key][0])
+    npb = PAGED_DEFAULT_TABLE.get(device_kind.lower(), _PAGED_FALLBACK)
+    pages_per_seq = max(1, int(kv_len) // max(1, int(page_size)))
+    legal = paged_candidates(pages_per_seq, page_size)
+    fitting = [c for c in legal if c <= npb]
+    npb = max(fitting) if fitting else legal[0]
+    _runtime_cache[key] = (npb, npb * int(page_size))
+    return npb
+
+
+def autotune_paged(
+    kv_len: int,
+    page_size: int,
+    head_dim: int,
+    *,
+    slots: int = 8,
+    kv_heads: int = 8,
+    group: int = 1,
+    dtype=None,
+    steps: int = 20,
+    verbose: bool = False,
+    force: bool = False,
+    interpret: Optional[bool] = None,
+) -> int:
+    """Measured sweep for the paged decode kernel: times every legal
+    pages-per-block over a synthetic full-pool decode batch and caches the
+    winner under the ``paged_decode`` family key (in-process + on disk,
+    same persistence rules as :func:`autotune`). Offline tool — the serving
+    path only ever reads :func:`lookup_paged`."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_pytorch_tpu.ops.paged_attention import paged_attention
+    from distributed_pytorch_tpu.utils.platform import on_tpu
+
+    dtype = dtype or jnp.float32
+    dtype_name = jnp.dtype(dtype).name
+    device_kind = _device_kind()
+    key = _paged_key(device_kind, kv_len, page_size, head_dim, dtype_name)
+    if not force:
+        if key in _runtime_cache:
+            return int(_runtime_cache[key][0])
+        disk = _load_disk_cache()
+        if key in disk:
+            _runtime_cache[key] = disk[key]
+            return int(disk[key][0])
+    if interpret is None:
+        interpret = not on_tpu()
+    mode = "interpret" if interpret else "pallas"
+
+    pages_per_seq = max(1, kv_len // page_size)
+    num_pages = slots * pages_per_seq + 1  # + the reserved null page
+    rng = np.random.default_rng(0)
+    h = kv_heads * group
+    q = jnp.asarray(
+        rng.standard_normal((slots, 1, h, head_dim)), dtype
+    )
+    pool = (num_pages, page_size, kv_heads, head_dim)
+    k_pool = jnp.asarray(rng.standard_normal(pool), dtype)
+    v_pool = jnp.asarray(rng.standard_normal(pool), dtype)
+    tables = jnp.asarray(
+        1 + np.arange(slots * pages_per_seq).reshape(slots, pages_per_seq),
+        jnp.int32,
+    )
+    lens = jnp.full((slots,), kv_len - 1, jnp.int32)
+
+    best, best_dt = None, float("inf")
+    for npb in paged_candidates(pages_per_seq, page_size):
+        try:
+            fn = jax.jit(
+                functools.partial(
+                    paged_attention, kernel=mode, pages_per_block=npb
+                )
+            )
+            fn(q, k_pool, v_pool, tables, lens).block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = fn(q, k_pool, v_pool, tables, lens)
+            out.block_until_ready()
+            dt = (time.perf_counter() - t0) / steps
+        except Exception as e:  # lowering failure for this blocking: skip
+            if verbose:
+                print(f"  npb={npb:3d}: failed ({type(e).__name__})")
+            continue
+        if verbose:
+            print(f"  npb={npb:3d}: {dt * 1e6:9.1f} us")
+        if dt < best_dt:
+            best, best_dt = npb, dt
+    if best is None:
+        import warnings
+
+        warnings.warn(
+            f"paged autotune: no pages-per-block candidate ran for "
+            f"kv_len={kv_len} page={page_size} d={head_dim} on "
+            f"{device_kind!r}; using fallback {_PAGED_FALLBACK} "
+            "(not persisted)"
+        )
+        _runtime_cache[key] = (_PAGED_FALLBACK, _PAGED_FALLBACK * page_size)
+        _failed_sweeps.add(key)
+        return _PAGED_FALLBACK
+    _runtime_cache[key] = (best, best * page_size)
+    _failed_sweeps.discard(key)
+    disk = _load_disk_cache()
+    disk[key] = (best, best * page_size)
+    _save_disk_cache(disk)
+    return best
 
 
 def _device_kind() -> str:
@@ -322,6 +501,22 @@ def main(argv=None) -> None:
         help="re-measure even when a cached winner exists (use after a "
         "compiler/runtime upgrade or with a different --bh)",
     )
+    parser.add_argument(
+        "--paged", action="store_true",
+        help="sweep the paged_decode family (pages-per-block for the "
+        "serving decode kernel) instead of the training flash family",
+    )
+    parser.add_argument(
+        "--kv_lens", default="512,2048,8192",
+        help="paged sweep: per-sequence KV capacities (block-table width "
+        "x page size)",
+    )
+    parser.add_argument(
+        "--page_sizes", default="16,64",
+        help="paged sweep: KV page sizes to tune for",
+    )
+    parser.add_argument("--slots", default=8, type=int,
+                        help="paged sweep: decode batch size")
     args = parser.parse_args(argv)
     kind = _device_kind()
     if kind == "unknown":
@@ -330,6 +525,46 @@ def main(argv=None) -> None:
     entries = {}  # (t, d) -> measured blocks
     shipped = {}  # full key -> blocks, for --export
     failed = []
+
+    if args.paged:
+        paged_entries = []  # (kv_len, page, d) -> npb
+        for kv_len in (int(x) for x in args.kv_lens.split(",")):
+            for page in (int(x) for x in args.page_sizes.split(",")):
+                for d in (int(x) for x in args.head_dims.split(",")):
+                    print(f"kv={kv_len} page={page} d={d}:", flush=True)
+                    npb = autotune_paged(
+                        kv_len, page, d, slots=args.slots, verbose=True,
+                        force=args.force,
+                    )
+                    key = _paged_key(kind, kv_len, page, d, "float32")
+                    if key in _failed_sweeps:
+                        print("  -> MEASUREMENT FAILED (excluded)", flush=True)
+                        failed.append((kv_len, page, d))
+                        continue
+                    print(f"  -> pages_per_block={npb}", flush=True)
+                    paged_entries.append(npb)
+                    shipped[key] = (npb, npb * page)
+        if paged_entries:
+            # The seeded table is one npb per device kind; suggest the
+            # most common measured winner across shapes.
+            best = max(set(paged_entries), key=paged_entries.count)
+            print("\n# Paste into ops/flash_autotune.py PAGED_DEFAULT_TABLE:")
+            print(f'    "{kind.lower()}": {best},', flush=True)
+        if failed:
+            print(f"\n# NOT measured: {failed}", flush=True)
+        if args.export:
+            with open(args.export, "w") as f:
+                json.dump(
+                    {json.dumps(list(k)): list(v) for k, v in shipped.items()},
+                    f,
+                )
+            print(
+                f"exported {len(shipped)} measured entries to {args.export} — "
+                "deploy with FLASH_BLOCKS_TABLE=<path> on every pod host",
+                flush=True,
+            )
+        return
+
     for t in (int(x) for x in args.seq_lens.split(",")):
         for d in (int(x) for x in args.head_dims.split(",")):
             blocks = autotune(t, d, bh=args.bh, verbose=True, force=args.force)

@@ -148,6 +148,13 @@ class EngineSnapshot:
     # Defaulted-last for wire compatibility: version-1 snapshots written
     # before mesh sharding existed decode as unsharded.
     mesh: str = "1x1"
+    # KV-page dtype fingerprint ("fp" | "int8"): int8 pages round every
+    # written K/V through quantization, so a request recovered across the
+    # boundary would re-prefill into a numerically different cache and
+    # sampled streams could silently diverge — same refusal logic as
+    # ``mesh``. Defaulted so snapshots written before KV quantization
+    # decode as fp.
+    kv: str = "fp"
 
     # --------------------------------------------------------------- codec
 
@@ -164,6 +171,7 @@ class EngineSnapshot:
                 f"{SNAPSHOT_VERSION}"
             )
         doc.setdefault("mesh", "1x1")
+        doc.setdefault("kv", "fp")
         reqs = []
         for entry in doc["requests"]:
             entry = dict(entry)
@@ -313,6 +321,7 @@ def snapshot_engine(engine) -> EngineSnapshot:
         next_id=engine._next_id,
         requests=tuple(recs),
         mesh=engine.mesh_fingerprint,
+        kv=engine.kv_fingerprint,
     )
 
 
@@ -384,6 +393,14 @@ def restore_engine(
             f"target is {engine.mesh_fingerprint} — sharded reductions "
             "reorder float accumulation, so recovered sampled streams "
             "could silently diverge; restore onto matching geometry"
+        )
+    if snapshot.kv != engine.kv_fingerprint:
+        raise ValueError(
+            f"snapshot was taken with {snapshot.kv} KV pages, restore "
+            f"target uses {engine.kv_fingerprint} — int8 pages quantize "
+            "every written K/V, so a request re-prefilled across the "
+            "boundary could silently diverge; restore onto a matching "
+            "KV configuration"
         )
     now = time.perf_counter()
     restored: List[int] = []

@@ -250,6 +250,8 @@ class InferenceEngine:
         timeseries=None,
         max_live_adapters: int = 4,
         host_pages: Optional[int] = None,
+        paged_kernel=False,
+        kv_quant: Optional[str] = None,
     ):
         if max_seq_len % page_size:
             raise ValueError(
@@ -296,8 +298,37 @@ class InferenceEngine:
             if self.speculative:
                 validate_kv_heads(draft_model, mesh, role="draft")
 
+        # Fused paged-attention read path + int8 KV pages (ops/
+        # paged_attention.py). ``paged_kernel`` accepts False/None (off),
+        # True/"auto" (Pallas on TPU, XLA reference elsewhere), or an
+        # explicit mode ("pallas" | "interpret" | "xla"). ``kv_quant``
+        # accepts None/"" (fp pages) or "int8". Both are engine-static like
+        # the mesh: compiled into every program and fingerprinted into
+        # elastic snapshots (kv_fingerprint). The clone kwargs are added
+        # ONLY when set so the kernel-off engine's decode model — and its
+        # compiled programs — stay byte-identical to before.
+        if kv_quant not in (None, "", "int8"):
+            raise ValueError(
+                f"unknown kv_quant {kv_quant!r} (expected None or 'int8')"
+            )
+        self.kv_quant = kv_quant or ""
+        self.kv_fingerprint = "int8" if self.kv_quant else "fp"
+        self.paged_kernel = (
+            "" if not paged_kernel
+            else ("auto" if paged_kernel is True else str(paged_kernel))
+        )
+        clone_kw = {}
+        if self.paged_kernel:
+            clone_kw["paged_kernel"] = self.paged_kernel
+            if mesh is not None:
+                # The kernel shard_maps its head dim over the mesh's
+                # "model" axis — the same split KV_POOL_SPEC already gives
+                # the pools — so it runs per-shard under the pjit programs.
+                clone_kw["mesh"] = mesh
+        if self.kv_quant:
+            clone_kw["kv_quant"] = self.kv_quant
         self.decode_model = model.clone(
-            decode=True, page_size=page_size, num_pages=num_pages
+            decode=True, page_size=page_size, num_pages=num_pages, **clone_kw
         )
         # Size the paged pool from abstract shapes only (eval_shape traces
         # init without running it); token length 1 — pool shapes depend only
@@ -319,7 +350,8 @@ class InferenceEngine:
         pools = {"target": _zero_cache(self.decode_model)}
         if self.speculative:
             self.draft_decode_model = draft_model.clone(
-                decode=True, page_size=page_size, num_pages=num_pages
+                decode=True, page_size=page_size, num_pages=num_pages,
+                **clone_kw,
             )
             pools["draft"] = _zero_cache(self.draft_decode_model)
         self.pools = PagePoolGroup(**pools)
@@ -833,9 +865,14 @@ class InferenceEngine:
             nxt = row_sample(last_logits, temps, keys, bias)
             return nxt, cache
 
+        # The fused-kernel decode compiles under its own ledger name so the
+        # roofline attributes the before/after to two distinct programs
+        # (both keep the "decode_step" prefix the analytic FLOPs model and
+        # roofline tagging key on).
+        name = "decode_step_paged" if self.paged_kernel else "decode_step"
         if self.mesh is None:
             return self._ledgered(
-                "decode_step", jax.jit(run, donate_argnums=(1,))
+                name, jax.jit(run, donate_argnums=(1,))
             )
         rep = self._replicated
         pool = self._pool_shardings["target"]
@@ -843,7 +880,7 @@ class InferenceEngine:
         # sharding below) and is consumed replicated, so the overlapped
         # splice never adds a collective.
         return self._ledgered(
-            "decode_step",
+            name,
             self._sharded_jit(
                 run,
                 donate=(1,),

@@ -1236,6 +1236,7 @@ class FleetRouter:
             next_id=0,
             requests=tuple(recs),
             mesh=fp["mesh"],
+            kv=fp.get("kv", "fp"),
         )
 
     # ------------------------------------------------------------- hedging

@@ -63,6 +63,8 @@ SERVING_PARAM_RULES = rules_on_axis(TRANSFORMER_TP_RULES, "model")
 #: page id must name the same token span on every shard — the host
 #: allocator hands out ids with no idea a mesh exists).
 KV_POOL_SPEC = P(None, None, "model", None)
+# Int8 KV scale pools [num_pages, page_size, Hkv] shard the same Hkv axis.
+KV_SCALE_SPEC = P(None, None, "model")
 
 
 def make_serving_mesh(
@@ -154,18 +156,24 @@ def serving_param_shardings(mesh: Mesh, params):
 
 
 def kv_pool_shardings(mesh: Mesh, cache):
-    """NamedSharding pytree for one paged cache collection: every leaf is
-    a per-layer pool ``[num_pages, page_size, Hkv, D]`` and gets
-    :data:`KV_POOL_SPEC` (KV heads on ``model``)."""
+    """NamedSharding pytree for one paged cache collection: every 4-d leaf
+    is a per-layer pool ``[num_pages, page_size, Hkv, D]`` and gets
+    :data:`KV_POOL_SPEC`; every 3-d leaf is an int8 scale pool
+    ``[num_pages, page_size, Hkv]`` and gets :data:`KV_SCALE_SPEC` — both
+    put KV heads on ``model``."""
 
     def sharding(leaf):
-        if getattr(leaf, "ndim", 0) != 4:
-            raise ValueError(
-                "paged cache leaf has shape "
-                f"{getattr(leaf, 'shape', None)}; expected a 4-d "
-                "[num_pages, page_size, Hkv, D] pool"
-            )
-        return NamedSharding(mesh, KV_POOL_SPEC)
+        ndim = getattr(leaf, "ndim", 0)
+        if ndim == 4:
+            return NamedSharding(mesh, KV_POOL_SPEC)
+        if ndim == 3:
+            return NamedSharding(mesh, KV_SCALE_SPEC)
+        raise ValueError(
+            "paged cache leaf has shape "
+            f"{getattr(leaf, 'shape', None)}; expected a 4-d "
+            "[num_pages, page_size, Hkv, D] pool or a 3-d "
+            "[num_pages, page_size, Hkv] scale pool"
+        )
 
     return jtu.tree_map(sharding, cache)
 

@@ -356,6 +356,7 @@ class LocalReplicaClient(ReplicaClient):
             "top_p": e._top_p,
             "speculative": e.speculative,
             "mesh": e.mesh_fingerprint,
+            "kv": e.kv_fingerprint,
         }
 
     def reserve_ids(self, base: int) -> None:

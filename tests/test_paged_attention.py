@@ -1,0 +1,531 @@
+"""Paged-attention kernel + int8 KV pages: the ISSUE-19 contract.
+
+Op level: the Pallas flash-decode kernel (run in interpret mode on the
+CPU rig) must match the pure-XLA reference within float tolerance, the
+reference must match the engine's inline gather math BITWISE (that is
+what makes `paged_kernel="xla"` a no-op toggle), NULL-page (page 0)
+garbage must never survive the visibility mask, and the int8 path must
+dequantize to the same numbers the int8 reference computes.
+
+Engine level: greedy tokens across the full toggle matrix (kernel
+on/off x prefix_cache x overlap x speculative x mesh (1,1)/(1,8)) must
+be identical to the kernel-off baseline on the fp path; the int8 path
+is bounded by a perplexity tolerance instead (quantization legitimately
+moves logits). Elastic snapshots carry a KV fingerprint and refuse
+int8<->fp restores exactly like the mesh-geometry refusal.
+"""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu.models.transformer import TransformerLM
+from distributed_pytorch_tpu.ops import flash_autotune as fa
+from distributed_pytorch_tpu.ops.paged_attention import (
+    paged_attention,
+    paged_attention_reference,
+    resolve_kernel,
+)
+from distributed_pytorch_tpu.ops.quant import quantize_int8
+from distributed_pytorch_tpu.serving import (
+    EngineSnapshot,
+    InferenceEngine,
+    SamplingParams,
+    drain_engine,
+    make_serving_mesh,
+    restore_engine,
+)
+
+# ----------------------------------------------------------------- op level
+
+
+def make_problem(seed=0, s=3, h=4, kv_heads=2, d=8, page=4, pages_per_seq=4,
+                 dtype=jnp.float32):
+    """Mixed-liveness decode batch: row 0 mid-sequence, row 1 one token
+    short of full, row 2 inactive (all-NULL table, len 0)."""
+    rng = np.random.default_rng(seed)
+    num_pages = 8
+    q = jnp.asarray(rng.standard_normal((s, 1, h, d)), dtype)
+    pool = (num_pages, page, kv_heads, d)
+    k_pool = jnp.asarray(rng.standard_normal(pool), dtype)
+    v_pool = jnp.asarray(rng.standard_normal(pool), dtype)
+    bt = jnp.asarray([[3, 5, 0, 0], [1, 2, 4, 6], [0, 0, 0, 0]], jnp.int32)
+    lens = jnp.asarray([6, 15, 0], jnp.int32)
+    return q, k_pool, v_pool, bt[:s], lens[:s]
+
+
+def quantize_pool(pool):
+    qt = quantize_int8(pool, (3,))
+    return qt.q, jnp.squeeze(qt.scale, -1)
+
+
+class TestPagedAttentionOp:
+    @pytest.mark.parametrize("npb", [1, 2, 4])
+    def test_kernel_matches_reference_fp(self, npb):
+        q, kp, vp, bt, lens = make_problem()
+        ref = paged_attention_reference(q, kp, vp, bt, lens)
+        out = paged_attention(
+            q, kp, vp, bt, lens, kernel="interpret", pages_per_block=npb
+        )
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(out, ref, atol=2e-6, rtol=2e-6)
+
+    def test_xla_mode_is_reference_bitwise(self):
+        q, kp, vp, bt, lens = make_problem()
+        ref = paged_attention_reference(q, kp, vp, bt, lens)
+        out = paged_attention(q, kp, vp, bt, lens, kernel="xla")
+        assert (np.asarray(out) == np.asarray(ref)).all()
+
+    def test_null_page_garbage_never_survives(self):
+        """Property: page 0 contents are invisible. Poisoning the NULL
+        page with huge finite garbage changes NOTHING for live rows, in
+        both the reference and the kernel."""
+        q, kp, vp, bt, lens = make_problem()
+        ref = paged_attention_reference(q, kp, vp, bt, lens)
+        out = paged_attention(q, kp, vp, bt, lens, kernel="interpret")
+        poison_k = kp.at[0].set(1e4)
+        poison_v = vp.at[0].set(-1e4)
+        ref_p = paged_attention_reference(q, poison_k, poison_v, bt, lens)
+        out_p = paged_attention(
+            q, poison_k, poison_v, bt, lens, kernel="interpret"
+        )
+        live = slice(0, 2)  # row 2 is inactive; only live rows must hold
+        assert (np.asarray(ref_p)[live] == np.asarray(ref)[live]).all()
+        assert (np.asarray(out_p)[live] == np.asarray(out)[live]).all()
+        # Inactive rows still produce FINITE (discarded) output.
+        assert np.isfinite(np.asarray(out_p)).all()
+        assert np.isfinite(np.asarray(ref_p)).all()
+
+    def test_padded_table_tail_is_masked(self):
+        """Rows whose table is wider than their length read their padded
+        NULL entries as masked positions: growing the table with NULL
+        pages never changes the output."""
+        q, kp, vp, bt, lens = make_problem()
+        ref = paged_attention_reference(q, kp, vp, bt, lens)
+        wide_bt = jnp.concatenate(
+            [bt, jnp.zeros((bt.shape[0], 2), jnp.int32)], axis=1
+        )
+        ref_w = paged_attention_reference(q, kp, vp, wide_bt, lens)
+        out_w = paged_attention(q, kp, vp, wide_bt, lens, kernel="interpret")
+        np.testing.assert_allclose(ref_w, ref, atol=0, rtol=0)
+        np.testing.assert_allclose(out_w, ref, atol=2e-6, rtol=2e-6)
+
+    def test_int8_kernel_matches_int8_reference(self):
+        q, kp, vp, bt, lens = make_problem()
+        k8, ks = quantize_pool(kp)
+        v8, vs = quantize_pool(vp)
+        ref = paged_attention_reference(
+            q, k8, v8, bt, lens, k_scale=ks, v_scale=vs
+        )
+        out = paged_attention(
+            q, k8, v8, bt, lens, k_scale=ks, v_scale=vs, kernel="interpret"
+        )
+        np.testing.assert_allclose(out, ref, atol=2e-6, rtol=2e-6)
+        # And the quantized result is close to (not equal to) the fp one.
+        fp = paged_attention_reference(q, kp, vp, bt, lens)
+        err = np.abs(np.asarray(ref) - np.asarray(fp)).max()
+        assert 0 < err < 0.1
+
+    def test_grouped_query_mapping(self):
+        """GQA group mapping: with Hkv=2, H=8, each KV head serves 4 query
+        heads; a per-kv-head perturbation must move exactly its group."""
+        q, kp, vp, bt, lens = make_problem(h=8, kv_heads=2)
+        ref = paged_attention_reference(q, kp, vp, bt, lens)
+        out = paged_attention(q, kp, vp, bt, lens, kernel="interpret")
+        np.testing.assert_allclose(out, ref, atol=2e-6, rtol=2e-6)
+        bumped = paged_attention_reference(
+            q, kp, vp.at[:, :, 0, :].add(1.0), bt, lens
+        )
+        delta = np.abs(np.asarray(bumped) - np.asarray(ref))
+        # Query heads 0..3 read kv head 0 (moved); 4..7 read kv head 1.
+        assert delta[0, :, :4, :].max() > 0
+        assert delta[0, :, 4:, :].max() == 0
+
+    def test_t_step_gt1_falls_back_to_reference(self):
+        q, kp, vp, bt, lens = make_problem()
+        q2 = jnp.concatenate([q, q], axis=1)  # t_step = 2 (prefill chunk)
+        ref = paged_attention_reference(q2, kp, vp, bt, lens)
+        out = paged_attention(q2, kp, vp, bt, lens, kernel="interpret")
+        assert (np.asarray(out) == np.asarray(ref)).all()
+
+    def test_resolve_kernel_validates(self):
+        assert resolve_kernel("xla") == "xla"
+        assert resolve_kernel("interpret") == "interpret"
+        assert resolve_kernel(True) in ("pallas", "xla")
+        assert resolve_kernel("auto") == resolve_kernel(None)
+        with pytest.raises(ValueError, match="kernel"):
+            resolve_kernel("cuda")
+
+    def test_scale_pairing_validated(self):
+        q, kp, vp, bt, lens = make_problem()
+        k8, ks = quantize_pool(kp)
+        with pytest.raises(ValueError, match="scale"):
+            paged_attention(q, k8, vp, bt, lens, k_scale=ks, kernel="xla")
+
+    def test_mesh_shard_map_parity(self):
+        """The kernel under shard_map over the 'model' axis (the
+        KV_POOL_SPEC head split) matches the unsharded reference on a
+        (1,8) mesh, fp and int8."""
+        q, kp, vp, bt, lens = make_problem(h=8, kv_heads=8)
+        mesh = make_serving_mesh(1, 8)
+        ref = paged_attention_reference(q, kp, vp, bt, lens)
+        out = paged_attention(
+            q, kp, vp, bt, lens, kernel="interpret", mesh=mesh
+        )
+        np.testing.assert_allclose(out, ref, atol=2e-6, rtol=2e-6)
+        k8, ks = quantize_pool(kp)
+        v8, vs = quantize_pool(vp)
+        ref8 = paged_attention_reference(
+            q, k8, v8, bt, lens, k_scale=ks, v_scale=vs
+        )
+        out8 = paged_attention(
+            q, k8, v8, bt, lens, k_scale=ks, v_scale=vs,
+            kernel="interpret", mesh=mesh,
+        )
+        np.testing.assert_allclose(out8, ref8, atol=2e-6, rtol=2e-6)
+
+    def test_jit_composes(self):
+        q, kp, vp, bt, lens = make_problem()
+        fn = jax.jit(lambda *a: paged_attention(*a, kernel="interpret"))
+        out = fn(q, kp, vp, bt, lens)
+        ref = paged_attention_reference(q, kp, vp, bt, lens)
+        np.testing.assert_allclose(out, ref, atol=2e-6, rtol=2e-6)
+
+
+# ------------------------------------------------------- autotune family
+
+
+@pytest.fixture
+def _isolated_caches(tmp_path, monkeypatch):
+    """Redirect every cache tier at empty temp state (same idiom as
+    test_flash_autotune.py) so paged lookups hit the seeded table."""
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path / "xdg"))
+    monkeypatch.delenv("FLASH_BLOCKS_TABLE", raising=False)
+    monkeypatch.delenv("FLASH_AUTOTUNE", raising=False)
+    monkeypatch.setattr(fa, "_runtime_cache", {})
+    fa._load_table_file.cache_clear()
+    yield
+    fa._load_table_file.cache_clear()
+
+
+class TestPagedAutotune:
+    def test_candidates_are_bounded_powers_of_two(self):
+        cands = fa.paged_candidates(64, 16)
+        assert cands[0] == 1
+        for c in cands:
+            assert c & (c - 1) == 0
+            assert c * 16 <= 4096
+        assert fa.paged_candidates(1, 8) == [1]
+
+    def test_seeded_cpu_entry_no_sweep(self, _isolated_caches):
+        """CI never autotunes: the shipped PAGED_DEFAULT_TABLE entry for
+        'cpu' answers lookups directly."""
+        npb = fa.lookup_paged(256, 16, 64, device_kind="cpu")
+        assert npb == fa.PAGED_DEFAULT_TABLE["cpu"]
+        # And nothing was swept or persisted to disk.
+        assert fa._load_disk_cache() == {}
+
+    def test_family_key_disjoint_from_flash(self):
+        pk = fa._paged_key("cpu", 2048, 16, 64, "float32")
+        flash = fa._key("cpu", 2048, 64, "float32", False)
+        assert pk != flash
+        assert fa.PAGED_FAMILY in pk[3] and "p16" in pk[3]
+
+    def test_lookup_clips_to_legal_candidates(self, _isolated_caches):
+        # Table width 2 pages: the seeded npb must clip down to <= 2.
+        npb = fa.lookup_paged(16, 8, 8, device_kind="tpu v5 lite")
+        assert npb in fa.paged_candidates(2, 8)
+
+    def test_table_file_tier_wins(self, _isolated_caches, tmp_path,
+                                  monkeypatch):
+        key = fa._paged_key("cpu", 256, 16, 64, "float32")
+        path = tmp_path / "table.json"
+        path.write_text(json.dumps({json.dumps(list(key)): [8, 128]}))
+        monkeypatch.setenv("FLASH_BLOCKS_TABLE", str(path))
+        assert fa.lookup_paged(256, 16, 64, device_kind="cpu") == 8
+
+    def test_autotune_paged_persists_winner(self, _isolated_caches):
+        npb = fa.autotune_paged(16, 4, 8, slots=2, kv_heads=2, steps=1)
+        assert npb in fa.paged_candidates(4, 4)
+        # Cached: a second call returns without sweeping (runtime tier).
+        assert fa.lookup_paged(16, 4, 8) == npb
+        disk = fa._load_disk_cache()
+        key = fa._paged_key(fa._device_kind(), 16, 4, 8, "float32")
+        assert disk[key] == (npb, npb * 4)
+
+
+# -------------------------------------------------- engine parity matrix
+
+MESH_LM = dict(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=8, d_ff=64,
+    dtype=jnp.float32,
+)
+PROMPTS = [[1, 2, 3, 4], [5, 6, 7], [1, 2, 3, 9, 10]]
+MAX_NEW = 5
+ENGINE_KW = dict(
+    max_slots=4, max_seq_len=32, page_size=8, token_budget=32,
+    max_prefill_chunk=16,
+)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = TransformerLM(**MESH_LM)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def draft_and_params():
+    draft = TransformerLM(
+        vocab_size=64, d_model=16, n_layers=1, n_heads=8, d_ff=32,
+        dtype=jnp.float32,
+    )
+    dparams = draft.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return draft, dparams
+
+
+def run_engine(model, params, *, mesh=None, prefix=True, overlap=True,
+               spec=None, **extra):
+    kw = dict(ENGINE_KW)
+    if spec is not None:
+        draft, dparams = spec
+        kw.update(draft_model=draft, draft_params=dparams, gamma=3)
+    eng = InferenceEngine(
+        model, params, mesh=mesh, prefix_cache=prefix, overlap=overlap,
+        **kw, **extra,
+    )
+    ids = [
+        eng.submit(p, SamplingParams(max_new_tokens=MAX_NEW))
+        for p in PROMPTS
+    ]
+    eng.run()
+    out = [eng.poll(i).generated for i in ids]
+    eng.close()
+    return out, eng
+
+
+@pytest.fixture(scope="module")
+def baseline_greedy(model_and_params):
+    out, _ = run_engine(*model_and_params)
+    return out
+
+
+class TestEngineKernelParity:
+    """fp path: kernel on/off must be token-identical everywhere. On the
+    CPU rig paged_kernel=True resolves to the XLA reference (bitwise by
+    the op tests above); "interpret" runs the actual kernel math."""
+
+    @pytest.mark.parametrize("kernel", [True, "xla", "interpret"])
+    @pytest.mark.parametrize("prefix", [True, False])
+    def test_kernel_matrix_unsharded(self, model_and_params,
+                                     baseline_greedy, kernel, prefix):
+        out, eng = run_engine(
+            *model_and_params, prefix=prefix, paged_kernel=kernel
+        )
+        assert out == baseline_greedy
+        assert eng.paged_kernel in ("auto", "xla", "interpret")
+
+    @pytest.mark.parametrize("overlap", [True, False])
+    def test_kernel_overlap_toggle(self, model_and_params,
+                                   baseline_greedy, overlap):
+        out, _ = run_engine(
+            *model_and_params, overlap=overlap, paged_kernel=True
+        )
+        assert out == baseline_greedy
+
+    def test_kernel_speculative(self, model_and_params, draft_and_params,
+                                baseline_greedy):
+        out, _ = run_engine(
+            *model_and_params, spec=draft_and_params, paged_kernel=True
+        )
+        assert out == baseline_greedy
+
+    @pytest.mark.parametrize("shape", [(1, 1), (1, 8)])
+    def test_kernel_mesh(self, model_and_params, baseline_greedy, shape):
+        out, eng = run_engine(
+            *model_and_params, mesh=make_serving_mesh(*shape),
+            paged_kernel=True,
+        )
+        assert out == baseline_greedy
+        assert eng._sharded_programs >= 3
+
+    @pytest.mark.parametrize("shape", [(1, 1), (1, 8)])
+    def test_kernel_interpret_mesh(self, model_and_params,
+                                   baseline_greedy, shape):
+        out, _ = run_engine(
+            *model_and_params, mesh=make_serving_mesh(*shape),
+            paged_kernel="interpret",
+        )
+        assert out == baseline_greedy
+
+    def test_paged_program_name(self, model_and_params):
+        model, params = model_and_params
+        eng = InferenceEngine(
+            model, params, xla_ledger=True, paged_kernel=True, **ENGINE_KW
+        )
+        rid = eng.submit([1, 2, 3], SamplingParams(max_new_tokens=3))
+        eng.run()
+        assert eng.poll(rid).finished
+        names = {r.name for r in eng.xla.programs.values()}
+        eng.close()
+        assert any(n.startswith("decode_step_paged") for n in names)
+        assert not any(n == "decode_step" for n in names)
+
+    def test_bad_kernel_mode_fails_at_init(self, model_and_params):
+        model, params = model_and_params
+        with pytest.raises(ValueError, match="kernel"):
+            InferenceEngine(
+                model, params, paged_kernel="cuda", **ENGINE_KW
+            )
+
+
+# ------------------------------------------------------------ int8 path
+
+
+class TestInt8KV:
+    def test_cache_layout(self, model_and_params):
+        model, params = model_and_params
+        eng = InferenceEngine(
+            model, params, kv_quant="int8", **ENGINE_KW
+        )
+        leaves = jax.tree_util.tree_leaves(eng.pools["target"])
+        dtypes = sorted({str(x.dtype) for x in leaves})
+        assert dtypes == ["float32", "int8"]
+        for x in leaves:
+            assert x.ndim in (3, 4)  # scale pools ride alongside
+        assert eng.kv_fingerprint == "int8"
+        eng.close()
+
+    def test_rejects_unknown_quant(self, model_and_params):
+        model, params = model_and_params
+        with pytest.raises(ValueError, match="kv_quant"):
+            InferenceEngine(model, params, kv_quant="int4", **ENGINE_KW)
+
+    @pytest.mark.parametrize("kernel", [False, True])
+    def test_int8_perplexity_tolerance(self, model_and_params, kernel):
+        """Teacher-forced decode through the paged cache: the int8 path's
+        per-token NLL over a fixed stream must stay within 2% of the fp
+        path's (greedy tokens may legitimately differ under quantization;
+        the distribution must not move materially)."""
+        model, params = model_and_params
+        toks = np.asarray(
+            np.random.default_rng(7).integers(1, 64, (2, 12))
+        )
+
+        def mean_nll(kv_quant):
+            m = model.clone(
+                decode=True, page_size=4, num_pages=17, kv_quant=kv_quant,
+                paged_kernel="interpret" if kernel else "",
+            )
+            cache = m.init(
+                jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32)
+            )["cache"]
+            bt = jnp.asarray(
+                [[1, 2, 3, 4], [5, 6, 7, 8]], jnp.int32
+            )
+            nll = []
+            for t in range(toks.shape[1] - 1):
+                lens = jnp.full((2,), t, jnp.int32)
+                logits, mut = m.apply(
+                    {"params": params, "cache": cache},
+                    jnp.asarray(toks[:, t:t + 1]),
+                    block_tables=bt, seq_lens=lens, mutable=["cache"],
+                )
+                cache = mut["cache"]
+                logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))
+                nll.append(
+                    -np.asarray(logp)[np.arange(2), toks[:, t + 1]].mean()
+                )
+            return float(np.mean(nll))
+
+        fp = mean_nll("")
+        q8 = mean_nll("int8")
+        assert abs(q8 - fp) / fp < 0.02, (fp, q8)
+
+    def test_int8_halves_page_bytes(self, model_and_params):
+        model, params = model_and_params
+        fp = InferenceEngine(model, params, **ENGINE_KW)
+        q8 = InferenceEngine(model, params, kv_quant="int8", **ENGINE_KW)
+        bytes_fp = sum(
+            x.nbytes for x in jax.tree_util.tree_leaves(fp.pools["target"])
+        )
+        bytes_q8 = sum(
+            x.nbytes for x in jax.tree_util.tree_leaves(q8.pools["target"])
+        )
+        fp.close()
+        q8.close()
+        # fp32 pools: int8 payload = 1/4, f32 scales add 1/D = 1/8.
+        d = MESH_LM["d_model"] // MESH_LM["n_heads"]
+        assert bytes_q8 * 8 == bytes_fp * (2 + 8 // d * 1)
+
+    def test_int8_engine_runs_all_toggles(self, model_and_params,
+                                          draft_and_params):
+        """int8 output is engine-path-invariant: kernel modes, prefix,
+        speculative, and mesh all agree with the int8 gather baseline."""
+        base, _ = run_engine(*model_and_params, kv_quant="int8")
+        for extra in (
+            dict(paged_kernel=True),
+            dict(paged_kernel="interpret"),
+            dict(prefix=False),
+            dict(spec=draft_and_params),
+            dict(mesh=make_serving_mesh(1, 8), paged_kernel=True),
+        ):
+            out, _ = run_engine(*model_and_params, kv_quant="int8", **extra)
+            assert out == base, extra
+
+
+# --------------------------------------------------- elastic fingerprint
+
+
+class TestKvFingerprint:
+    def _snap(self, model, params, **ekw):
+        eng = InferenceEngine(model, params, **ENGINE_KW, **ekw)
+        eng.submit([1, 2, 3, 4, 5], SamplingParams(max_new_tokens=8))
+        eng.step()
+        snap = drain_engine(eng)
+        eng.close()
+        return snap
+
+    def test_snapshot_carries_kv_fingerprint(self, model_and_params):
+        model, params = model_and_params
+        assert self._snap(model, params).kv == "fp"
+        assert self._snap(model, params, kv_quant="int8").kv == "int8"
+
+    def test_restore_refuses_kv_mismatch(self, model_and_params):
+        model, params = model_and_params
+        snap = self._snap(model, params, kv_quant="int8")
+        fp_engine = InferenceEngine(model, params, **ENGINE_KW)
+        with pytest.raises(ValueError, match="int8"):
+            restore_engine(fp_engine, snap)
+        fp_engine.close()
+
+    def test_restore_matching_int8_round_trips(self, model_and_params):
+        model, params = model_and_params
+        snap = self._snap(model, params, kv_quant="int8")
+        target = InferenceEngine(
+            model, params, kv_quant="int8", **ENGINE_KW
+        )
+        ids = restore_engine(target, snap)
+        target.run()
+        assert all(target.poll(i).finished for i in ids)
+        target.close()
+
+    def test_old_snapshots_decode_as_fp(self, model_and_params):
+        """Wire backcompat: snapshots written before the kv field decode
+        with kv='fp' (mirrors the mesh-field default)."""
+        model, params = model_and_params
+        snap = self._snap(model, params)
+        doc = json.loads(snap.to_json())
+        del doc["kv"]
+        old = EngineSnapshot.from_json(json.dumps(doc))
+        assert old.kv == "fp"
+        assert dataclasses.replace(old, kv=snap.kv) == snap

@@ -237,6 +237,69 @@ class TestHostTierEngineParity:
         eng.close()
 
 
+class TestQuantizedHostTier:
+    """ISSUE 19: int8 KV pages ride spill/fetch unmodified — the tier is
+    tree_map-generic, so the int8 pools and their 3-d float32 scale pools
+    round-trip host RAM together, at the quantized byte size."""
+
+    def test_int8_spill_fetch_round_trip(self, model_and_params):
+        model, params = model_and_params
+        off = _engine(model, params, host_pages=None, kv_quant="int8")
+        outs_off = _run_working_set(off)
+        off.close()
+
+        on = _engine(
+            model, params, host_pages=32, kv_quant="int8",
+            paged_kernel=True, xla_ledger=True,
+        )
+        outs_on = _run_working_set(on)
+        s_on = on.stats()
+        on.close()
+
+        # The tier must not change a token (the fetched int8 payload +
+        # scales are the same content a re-prefill would re-quantize to).
+        assert outs_on == outs_off, "host tier changed int8 tokens"
+        assert s_on["prefix_tokens_hit_host"] > 0
+        assert s_on["hostkv_spills"] > 0 and s_on["hostkv_fetches"] > 0
+        # Double-entry bookkeeping stays exact at the quantized sizes.
+        md = on.xla.metadata()
+        assert (
+            md["bytes_d2h_by_tag"].get("hostkv_spill", 0)
+            == on.hostkv.spill_bytes_total
+        )
+        assert (
+            md["bytes_h2d_by_tag"].get("hostkv_fetch", 0)
+            == on.hostkv.fetch_bytes_total
+        )
+        assert s_on["pages_allocated"] == 0
+        on.allocator.check_invariants()
+        on.hostkv.check_invariants()
+
+    def test_int8_page_bytes_are_quantized(self, model_and_params):
+        """Per-page spill bytes = int8 payload + f32 scales, to the byte:
+        layers x {K,V} x (page*Hkv*D x 1B + page*Hkv x 4B)."""
+        model, params = model_and_params
+        fp = _engine(model, params, host_pages=16)
+        q8 = _engine(model, params, host_pages=16, kv_quant="int8")
+        for eng in (fp, q8):
+            _run_working_set(eng)
+        n_layers = model.n_layers
+        kv_heads = model.n_kv_heads or model.n_heads
+        d = model.d_model // model.n_heads
+        page = fp.page_size
+        fp_page = n_layers * 2 * page * kv_heads * d * 4
+        q8_page = n_layers * 2 * (page * kv_heads * d + page * kv_heads * 4)
+        assert fp.hostkv.spill_bytes_total == fp.hostkv.counters()[
+            "hostkv_spills"
+        ] * fp_page
+        assert q8.hostkv.spill_bytes_total == q8.hostkv.counters()[
+            "hostkv_spills"
+        ] * q8_page
+        assert q8_page < fp_page / 2
+        fp.close()
+        q8.close()
+
+
 # ------------------------------------------------- restore via host fetch
 
 
